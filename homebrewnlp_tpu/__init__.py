@@ -1,0 +1,10 @@
+"""homebrewnlp_tpu: a TPU-native (JAX/XLA/pjit/pallas) training and inference
+framework with the capabilities of ClashLuke/HomebrewNLP-MTF.
+
+See SURVEY.md at the repo root for the structural analysis of the reference
+and the mapping from its Mesh-TensorFlow stack to this JAX design.
+"""
+from .config import Config, ModelParameter  # noqa: F401
+from .nd import NT  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,319 @@
+"""Configuration system: JSON -> typed model parameters + derived named dimensions.
+
+Reproduces the semantics of the reference's ``ModelParameter`` god-object
+(/root/reference/src/dataclass.py:34-372) as a plain dataclass-style config with
+explicit derivation, without the dict-compat shims.  The whole parallelism
+configuration of the reference is two integers (``tpu_size``, ``heads``) that
+synthesize a (mesh_shape, layout) pair (dataclass.py:247-252); here the same two
+integers synthesize a `jax.sharding.Mesh` axis layout (see parallel/mesh.py),
+extended with optional sequence-parallel and pipeline axes the reference lacks.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+
+import jax.numpy as jnp
+
+# Canonical logical axis (dimension) names used across the framework.
+BATCH = "batch"
+SEQUENCE = "sequence"
+HEADS = "heads"
+KEY = "features_per_head"
+INTERMEDIATE = "intermediate"
+VOCAB = "vocab"
+TOKEN_PATCH = "language_token_patch"
+HEIGHT = "height"
+WIDTH = "width"
+COLOR_CHANNELS = "color_channels"
+EXPERTS = "experts"
+PKM_AXES = "pkm_axes"
+PKM_VALUES = "product_key_value_dim"
+
+ANON_PREFIX = "_"
+
+
+def anonymize_name(name: str) -> str:
+    """Leading underscore marks a replicated twin of an axis (reference
+    utils_mtf.py:37-54); two tensors may carry both ``sequence`` and
+    ``_sequence`` simultaneously (e.g. attention logits)."""
+    return name if name.startswith(ANON_PREFIX) else ANON_PREFIX + name
+
+
+DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float64": jnp.float64,
+}
+
+
+@dataclasses.dataclass
+class BlockConfig:
+    """One block = list of layer-DSL strings (reference dataclass.py:12-19)."""
+    layer: typing.List[str] = dataclasses.field(default_factory=list)
+    skip: bool = False
+    memory_reduction_strategy: str = "none"
+
+    @classmethod
+    def make(cls, conf, strategy: str) -> "BlockConfig":
+        if isinstance(conf, BlockConfig):
+            return conf
+        out = cls(memory_reduction_strategy=strategy)
+        for k, v in conf.items():
+            setattr(out, k, v)
+        return out
+
+
+@dataclasses.dataclass
+class LearningRateConfig:
+    start_step: int = 0
+    final_step: int = 0
+    factor: float = 1.0
+
+
+_DEFAULTS: typing.Dict[str, typing.Any] = dict(
+    # embeddings (reference dataclass.py:38-41)
+    position_embedding="absolute",
+    token_embedding="absolute",
+    empty_frame_embedding="absolute",
+    output_embedding="absolute-orthogonal",
+    # modes
+    use_video=True,
+    use_language=True,
+    model_mode="jannet",
+    contrastive_across_samples=False,
+    contrastive_across_token_embeddings=False,
+    # io/model shape
+    input_dropout=0.0,
+    output_offset=1,
+    time_patch=1,
+    patch_size=16,
+    frame_width=320,
+    frame_height=176,
+    vocab_size=256,
+    color_channels=3,
+    three_axes=True,
+    sequence_length=32,
+    heads=8,
+    features=None,
+    features_per_head=None,
+    depth=16,
+    token_patch_size=1,
+    language_token_per_frame=0,
+    padding_token=0,
+    concat_token=4,
+    # data
+    dataset_configs=(),
+    data_seed=456772,
+    use_random_dataloader=False,
+    shuffle_buffer=256,
+    interleaved_datasets=256,
+    buffer_size=4,
+    parallel_batch=None,
+    parallel_interleave=None,
+    shuffle_input_filenames=True,
+    use_bit_fold_input_pipeline=False,
+    bit_fold_value=4,
+    color_quantization_value=256,
+    prefix="datasets/full_hd_video",
+    # training
+    train=True,
+    train_batch_size=1,
+    grad_accumulation=1,
+    macro_batching=1,
+    macro_batch_loss_smoothing=False,
+    learning_rate=5e-5,
+    learning_rate_config=(),
+    opt_beta1=0.9,
+    opt_beta2=0.999,
+    momentum=0.95,
+    optimizer="learning_rate",
+    weight_decay=0.001,
+    weight_centralisation=True,
+    weight_standardisation=True,
+    rezero_lr_multiplier=0.1,
+    train_steps=2 ** 30,
+    warmup_steps=3000,
+    z_loss=1e-4,
+    calc_accuracy=False,
+    multi_loss_strategy="linear",
+    memory_reduction_strategy="revnet",
+    momentumnet_alpha=0.99,
+    debug_train_step=False,
+    debug_gradients=False,
+    current_step=0,
+    iterations=2500,
+    steps_per_checkpoint=100_000,
+    use_checkpointing=False,
+    max_checkpoints_keep=1,
+    model_path="runs/default",
+    # dtypes (storage/compute/optimizer policy; reference dataclass.py:82-86)
+    storage_dtype="float32",
+    slice_dtype="float32",
+    calculation_dtype="float32",
+    optimizer_slice_dtype="float32",
+    optimizer_calculation_dtype="float32",
+    # architecture knobs
+    group_linear_factor=2,
+    intermediate_feed_forward_multiplier=None,
+    intermediate_feed_forward_multiplier_multiplier=None,
+    embedding_stddev=0.04,
+    experts=64,
+    pkm_axes=2,
+    convolution_size=16,
+    scale_by_depth=True,
+    use_initial_position_embedding=False,
+    vocab_weight_factorization=0.125,
+    masked_attention_dimensions=(0,),
+    block_config=(
+        {"layer": ["norm-group-shift-scale", "feed_forward-in_relu-group-in_glu_add-in_norm"]},
+        {"layer": ["norm-group-std-shift-scale", "attention-in_relu-embedded-relative"]},
+    ),
+    input_block_config=(),
+    output_block_config=(),
+    # parallelism (the reference's two knobs, plus TPU-native extensions)
+    tpu_size=32,
+    sequence_parallel=1,  # extension: size of the sequence-parallel mesh axis
+    pipeline_parallel=1,  # extension: pipeline stages (1 = off)
+    # sampling / serving
+    initial_autoregressive_position=128,
+    use_autoregressive_sampling=False,
+    sampling_temperature=0.0,
+    num_of_sample=10,
+    web_workers=1,
+    equal_debugging_items_per_check=16,
+    debug_sample=False,
+    default_sleep_duration=0.1,
+)
+
+
+class Config:
+    """Typed hyperparameter store with validation + derived dimension registry.
+
+    ``dims`` maps logical axis names to sizes — the JAX-side replacement for the
+    reference's mtf.Dimension zoo (dataclass.py:273-341)."""
+
+    def __init__(self, config: typing.Optional[dict] = None):
+        self.__dict__.update(_DEFAULTS)
+        config = dict(config or {})
+        for k, v in config.items():
+            if k not in _DEFAULTS and k not in ("mesh_shape", "layout"):
+                print(f"WARNING: Unknown Config parameter {k}={v!r}")
+            setattr(self, k, v)
+        self._validate_and_derive()
+
+    @classmethod
+    def from_json(cls, path: str) -> "Config":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    # -- derivation ---------------------------------------------------------
+    def _validate_and_derive(self) -> None:
+        if self.grad_accumulation > 1 and self.macro_batching % self.grad_accumulation:
+            raise ValueError("macro_batching must be divisible by grad_accumulation")
+        assert self.macro_batching > 0
+
+        for attr in ("position_embedding", "token_embedding", "output_embedding",
+                     "empty_frame_embedding"):
+            v = getattr(self, attr)
+            if isinstance(v, str):
+                setattr(self, attr, v.split("-"))
+
+        self.learning_rate_config = {
+            k: v if isinstance(v, LearningRateConfig) else LearningRateConfig(**v)
+            for k, v in dict(self.learning_rate_config).items()}
+
+        for attr in ("storage_dtype", "slice_dtype", "calculation_dtype",
+                     "optimizer_slice_dtype", "optimizer_calculation_dtype"):
+            v = getattr(self, attr)
+            if isinstance(v, str):
+                setattr(self, attr, DTYPES[v])
+
+        self.multi_loss_strategy = self.multi_loss_strategy.lower()
+        if self.multi_loss_strategy not in ("linear", "pcgrad", "mgda"):
+            print(f"unknown multi_loss_strategy {self.multi_loss_strategy}; using linear")
+            self.multi_loss_strategy = "linear"
+        if not self.use_language and not self.use_video:
+            raise ValueError("Language and video mode are both disabled")
+        if self.weight_standardisation and not self.weight_centralisation:
+            self.weight_centralisation = True
+        if self.features is None and self.features_per_head is None:
+            raise ValueError("Either features or features_per_head must be given")
+        if self.features is None:
+            self.features = self.features_per_head * self.heads
+        if self.features_per_head is None:
+            self.features_per_head = self.features // self.heads
+        if self.use_video and (self.frame_width * self.frame_height // self.patch_size) % self.experts:
+            raise ValueError("Frame size must be divisible by expert count")
+        if self.intermediate_feed_forward_multiplier_multiplier is not None:
+            self.intermediate_feed_forward_multiplier = (
+                self.group_linear_factor
+                * self.intermediate_feed_forward_multiplier_multiplier / self.heads)
+        if self.intermediate_feed_forward_multiplier is None:
+            self.intermediate_feed_forward_multiplier = self.group_linear_factor / self.heads
+        if not self.use_video and self.language_token_per_frame != self.sequence_length:
+            self.language_token_per_frame = self.sequence_length
+
+        self.masked_attention_dimensions = list(self.masked_attention_dimensions)
+        self.block_config = [BlockConfig.make(c, self.memory_reduction_strategy)
+                             for c in self.block_config]
+        self.input_block_config = [BlockConfig.make(c, "checkpoint")
+                                   for c in self.input_block_config]
+        self.output_block_config = [BlockConfig.make(c, "checkpoint")
+                                    for c in self.output_block_config]
+
+        # video patch arithmetic (reference dataclass.py:262-271)
+        self.time_patch_size = self.sequence_length // self.time_patch
+        self.frame_height_patch = self.frame_height // self.patch_size
+        self.frame_width_patch = self.frame_width // self.patch_size
+        self.channel_color_size = self.color_channels * self.time_patch * self.patch_size ** 2
+        self.fold_count = 32 // self.bit_fold_value
+        if self.use_bit_fold_input_pipeline and 2 ** self.bit_fold_value < self.color_quantization_value:
+            raise ValueError("bit-fold value too small for color quantization")
+        if self.use_bit_fold_input_pipeline:
+            self.channel_color_size //= self.fold_count
+        self.language_token_patch = self.language_token_per_frame // self.token_patch_size
+
+        self.intermediate_size = int(
+            self.heads * self.features_per_head * self.intermediate_feed_forward_multiplier)
+        self.product_key_value_vectors = self.features_per_head ** 2
+
+        # dimension registry
+        self.dims: typing.Dict[str, int] = {
+            BATCH: self.train_batch_size,
+            SEQUENCE: self.time_patch_size,
+            HEADS: self.heads,
+            KEY: self.features_per_head,
+            INTERMEDIATE: self.intermediate_size,
+            VOCAB: self.vocab_size,
+            TOKEN_PATCH: self.token_patch_size,
+            EXPERTS: self.experts,
+            PKM_AXES: self.pkm_axes,
+            PKM_VALUES: self.product_key_value_vectors,
+            HEIGHT: self.frame_height_patch,
+            WIDTH: self.frame_width_patch,
+            COLOR_CHANNELS: self.channel_color_size,
+            anonymize_name(KEY): self.features_per_head * self.group_linear_factor,
+        }
+        self.feature_dims = (HEADS, KEY)
+
+        # parallelism synthesis: reference maps batch->b, heads->h
+        # (dataclass.py:247-252); we extend with sequence/pipeline axes.
+        self.mesh_data = max(1, self.tpu_size // (
+            self.heads * self.sequence_parallel * self.pipeline_parallel))
+        self.mesh_model = self.heads if self.heads > 1 else 1
+
+    # -- convenience --------------------------------------------------------
+    def dim_size(self, name: str) -> int:
+        return self.dims[name]
+
+    def dict(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if not k.startswith("_")}
+
+    def __repr__(self) -> str:
+        return f"Config({self.model_mode}, d={self.features}, L={self.depth})"
+
+
+ModelParameter = Config  # reference-compatible alias

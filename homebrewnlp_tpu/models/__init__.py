@@ -1,0 +1,320 @@
+"""Top-level model assembly: input -> body -> output -> loss.
+
+Mirrors the reference's build pipeline (/root/reference/src/model/__init__.py:
+_input :32-91, _body :94-130, _output :133-156, _loss :159-200, build :231-259)
+re-designed for JAX: the "graph build" is tracing, memory-reduction strategies
+map to jax.checkpoint / custom_vjp reversible chains, and all parallelism is
+deferred to sharding constraints applied by the caller (parallel/apply.py).
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from .. import nd
+from ..config import (BATCH, COLOR_CHANNELS, Config, HEADS, HEIGHT, INTERMEDIATE,
+                      KEY, SEQUENCE, TOKEN_PATCH, VOCAB, WIDTH)
+from ..nd import NT
+from ..ops.losses import accuracy as _accuracy_fn
+from ..ops.losses import softmax_cross_entropy_with_logits, video_l1_loss
+from ..ops.reversible import make_reversible_chain
+from .ctx import Args, Ctx, DEPTH_TOKEN
+from .embedding import embed, gather, gather_embed
+from .linear import linear, linear_from_features, linear_to_features
+from .registry import block_part_fn
+
+
+class ModelOutput(typing.NamedTuple):
+    loss: jnp.ndarray
+    loss_list: typing.Tuple[jnp.ndarray, ...]
+    video_loss: typing.Optional[jnp.ndarray]
+    accuracy: typing.Optional[jnp.ndarray]
+    token_loss: typing.Optional[jnp.ndarray]
+    frame_out: typing.Optional[NT]
+    token_out: typing.Optional[NT]
+
+
+# -- input ------------------------------------------------------------------
+
+def _input(ctx: Ctx, batch: typing.Dict[str, NT], spatial_ctx: str
+           ) -> typing.Tuple[NT, typing.Optional[NT]]:
+    cfg = ctx.cfg
+    tgt = None
+    src = None
+    if cfg.use_video:
+        vid = batch["frame"].astype(cfg.calculation_dtype)
+        base_args = Args(ctx, vid, [""])
+        vid = ctx.dropout(vid, cfg.input_dropout)
+        if cfg.use_bit_fold_input_pipeline:
+            # unpack fold_count low-bit color values per packed int
+            # (reference src/model/__init__.py:45-56); uint32 keeps all 32
+            # packed bits without requiring jax x64
+            folded = vid.x.astype(jnp.uint32)
+            parts = []
+            for unfold_idx in range(cfg.fold_count):
+                part = (folded // (2 ** cfg.bit_fold_value) ** unfold_idx
+                        ) % (2 ** cfg.bit_fold_value)
+                parts.append(part.astype(jnp.uint8))
+            vid = NT(jnp.concatenate(parts, vid.names.index(COLOR_CHANNELS)),
+                     vid.names)
+        vid = vid.astype(cfg.calculation_dtype) / 255
+        ctx_dim = vid.names[1]  # "_sequence", length seq+1
+        n = vid.dim_size(ctx_dim)
+        tgt = nd.nt_slice(vid, ctx_dim, 1, n).rename(ctx_dim, SEQUENCE)
+        src = nd.nt_slice(vid, ctx_dim, 0, n - 1).rename(ctx_dim, SEQUENCE)
+
+        if cfg.empty_frame_embedding is not None:
+            embed_args = base_args(list(cfg.empty_frame_embedding))
+            frame_dims = [(name, src.dim_size(name)) for name in src.names[2:]]
+            empty = embed(embed_args, frame_dims)
+            for msk_name in ("vid_msk_src", "cat_mask_x"):
+                msk = batch.get(msk_name)
+                if msk is not None:
+                    m = msk.astype(cfg.calculation_dtype)
+                    src = src * m + empty * (1 - m)
+
+        src = linear_to_features(base_args(src),
+                                 [(COLOR_CHANNELS, src.dim_size(COLOR_CHANNELS))])
+        for config_idx, config in enumerate(cfg.input_block_config):
+            src = block_part_fn(ctx, config, src, f"vid_inp{config_idx}")
+
+    if cfg.use_language:
+        txt_src = batch["token_x"]
+        base_args = Args(ctx, txt_src, [""])
+        small = int(cfg.intermediate_size * cfg.vocab_weight_factorization)
+        txt, table = gather_embed(base_args(list(cfg.token_embedding)),
+                                  [(VOCAB, cfg.vocab_size), (INTERMEDIATE, small)])
+        ctx.text_input_embedding = table
+        txt = ctx.dropout(txt, cfg.input_dropout)
+        txt = linear_to_features(
+            base_args(txt), [(TOKEN_PATCH, cfg.token_patch_size), (INTERMEDIATE, small)])
+        for config_idx, config in enumerate(cfg.input_block_config):
+            txt = block_part_fn(ctx, config, txt, f"lang_inp{config_idx}")
+        if not cfg.use_video:
+            return txt, tgt
+        return nd.concat([src, txt], spatial_ctx), tgt
+    return src, tgt
+
+
+# -- body -------------------------------------------------------------------
+
+def _attn_layers(conf) -> int:
+    return sum(l.split("-")[0] == "attention" for l in conf.layer)
+
+
+def _block_scope(i: int, c: int) -> str:
+    return f"{DEPTH_TOKEN}{i}_{c}"
+
+
+def _body(ctx: Ctx, src: NT) -> NT:
+    cfg = ctx.cfg
+    with ctx.scope("body"):
+        if cfg.use_initial_position_embedding:
+            base_args = Args(ctx, src, [""])
+            for dim in [n for n in src.names if n not in cfg.feature_dims][1:]:
+                fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+                src = src + embed(base_args(list(cfg.position_embedding)),
+                                  [(dim, src.dim_size(dim))] + fdims)
+
+        strategy = cfg.memory_reduction_strategy
+        seq = [(i, c) for i in range(cfg.depth) for c in range(len(cfg.block_config))]
+        attn_starts = []
+        acc = ctx.attention_idx
+        for i, c in seq:
+            attn_starts.append(acc)
+            acc += _attn_layers(cfg.block_config[c])
+
+        if ctx.params is None:
+            # init / collect mode: run the plain chain so parameters materialize
+            if strategy in ("revnet", "momentum"):
+                x1, x2 = (src, src) if strategy == "revnet" else (src, nd.zeros_like(src))
+                for k, (i, c) in enumerate(seq):
+                    ctx.attention_idx = attn_starts[k]
+                    with ctx.scope(_block_scope(i, c)):
+                        fx = block_part_fn(
+                            ctx, cfg.block_config[c],
+                            x2 if strategy == "revnet" else x1)
+                    if strategy == "revnet":
+                        x1, x2 = x2, x1 + fx
+                    else:
+                        x2 = x2 * cfg.momentumnet_alpha + fx * (1 - cfg.momentumnet_alpha)
+                        x1 = x1 + x2
+                ctx.attention_idx = acc
+                return x1 + x2
+            out = src
+            for k, (i, c) in enumerate(seq):
+                ctx.attention_idx = attn_starts[k]
+                with ctx.scope(_block_scope(i, c)):
+                    out = block_part_fn(ctx, cfg.block_config[c], out)
+            ctx.attention_idx = acc
+            return out
+
+        # apply mode: each block runs in its own Ctx over a param subdict so
+        # the reversible chain can take explicit per-block parameters.
+        mode_scope = ctx._scope[0]
+        root = f"{mode_scope}/body"
+        all_keys = list(ctx.params.keys())
+
+        def keys_for(i: int, c: int) -> typing.List[str]:
+            p1 = f"{root}/{_block_scope(i, c)}/"
+            p2 = f"{root}/shared_{c}/"
+            return [k for k in all_keys if k.startswith(p1) or k.startswith(p2)]
+
+        def make_f(k: int, i: int, c: int):
+            conf = cfg.block_config[c]
+            a_start = attn_starts[k]
+            rng = None if ctx.rng is None else jax.random.fold_in(ctx.rng, 1000 + k)
+
+            def f(subparams: dict, x: NT) -> NT:
+                bctx = Ctx(cfg, params=subparams, train=ctx.train, seed=ctx.seed,
+                           rng=rng)
+                bctx._scope = [mode_scope, "body"]
+                bctx.attention_idx = a_start
+                with bctx.scope(_block_scope(i, c)):
+                    return block_part_fn(bctx, conf, x)
+
+            return f
+
+        fs = [make_f(k, i, c) for k, (i, c) in enumerate(seq)]
+        subparams = tuple({k: ctx.params[k] for k in keys_for(i, c)} for i, c in seq)
+        ctx.attention_idx = acc
+
+        if strategy in ("revnet", "momentum"):
+            chain = make_reversible_chain(fs, mode=strategy, alpha=cfg.momentumnet_alpha)
+            if strategy == "revnet":
+                y1, y2 = chain(subparams, src, src)
+            else:
+                y1, y2 = chain(subparams, src, nd.zeros_like(src))
+            return y1 + y2
+        out = src
+        for f, p in zip(fs, subparams):
+            if strategy == "checkpoint":
+                out = jax.checkpoint(f)(p, out)
+            else:
+                out = f(p, out)
+        return out
+
+
+# -- output -----------------------------------------------------------------
+
+def _output(ctx: Ctx, out: NT, spatial_ctx: str
+            ) -> typing.Tuple[typing.Optional[NT], typing.Optional[NT]]:
+    cfg = ctx.cfg
+    base_args = Args(ctx, out, [""])
+    token_out = frame_out = None
+    contrastive = cfg.contrastive_across_samples or cfg.contrastive_across_token_embeddings
+
+    if cfg.use_language:
+        token_out = out
+        if cfg.use_video:
+            token_out = nd.nt_slice(out, spatial_ctx, 0, cfg.language_token_patch)
+        for config_idx, config in enumerate(cfg.output_block_config):
+            token_out = block_part_fn(ctx, config, token_out, f"lang_out{config_idx}")
+        if not contrastive:
+            old = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+            new = [(TOKEN_PATCH, cfg.token_patch_size), (VOCAB, cfg.vocab_size)]
+            table = embed(base_args(list(cfg.output_embedding)), old + new)
+            out_names = tuple(n for n in token_out.names if n not in cfg.feature_dims
+                              ) + (TOKEN_PATCH, VOCAB)
+            token_out = nd.einsum([token_out, table], out_names)
+
+    if cfg.use_video:
+        start = cfg.language_token_patch * cfg.use_language
+        frame_out = nd.nt_slice(out, spatial_ctx, start, out.dim_size(spatial_ctx))
+        for config_idx, config in enumerate(cfg.output_block_config):
+            frame_out = block_part_fn(ctx, config, frame_out, f"vid_out{config_idx}")
+        frame_out = linear_from_features(
+            Args(ctx, frame_out, [""]),
+            [(COLOR_CHANNELS, cfg.channel_color_size)])
+        frame_out = NT(jax.nn.sigmoid(frame_out.x), frame_out.names)
+
+    return frame_out, token_out
+
+
+# -- loss -------------------------------------------------------------------
+
+def _loss(ctx: Ctx, frame_out, token_out, batch, vid_tgt):
+    cfg = ctx.cfg
+    loss_list: typing.List[jnp.ndarray] = []
+    token_loss = acc = video_loss = None
+    if cfg.use_language:
+        txt_tgt = batch["token_y"]
+        if cfg.contrastive_across_samples or cfg.contrastive_across_token_embeddings:
+            sq = nd.reduce_sum(token_out * token_out, reduced=list(cfg.feature_dims))
+            token_out = token_out / NT(jnp.sqrt(sq.x), sq.names)
+        if cfg.contrastive_across_samples:
+            sum_samples = nd.reduce_sum(token_out, reduced=[SEQUENCE])
+            sum_batch = nd.reduce_sum(token_out, reduced=[BATCH])
+            t1 = nd.einsum([sum_batch, sum_batch], []).x / cfg.train_batch_size
+            t2 = nd.einsum([sum_samples, sum_samples], []).x / cfg.sequence_length
+            token_loss = (t1 - t2) / (cfg.train_batch_size * cfg.sequence_length)
+            token_loss = token_loss.astype(jnp.float32)
+        elif cfg.contrastive_across_token_embeddings:
+            table = ctx.text_input_embedding
+            token_loss = nd.einsum([token_out, table], []).x.astype(jnp.float32)
+            gathered = gather(Args(ctx, txt_tgt, [""]), table, [HEADS])
+            token_loss = token_loss - 2 * nd.einsum(
+                [token_out, gathered], []).x.astype(jnp.float32)
+            token_loss = token_loss / (token_out.size * cfg.vocab_size)
+        else:
+            token_loss = softmax_cross_entropy_with_logits(token_out, txt_tgt, cfg.z_loss)
+            if cfg.calc_accuracy:
+                acc = _accuracy_fn(token_out, txt_tgt)
+        loss_list.append(token_loss)
+
+    if cfg.use_video:
+        vid_msk = batch.get("vid_msk_tgt")
+        cat_msk = batch.get("cat_mask_y")
+        vmsk = vid_msk.astype(jnp.float32) if vid_msk is not None else None
+        cmsk = cat_msk.astype(jnp.float32) if cat_msk is not None else None
+        train_vl, video_loss = video_l1_loss(frame_out, vid_tgt, vmsk, cmsk)
+        loss_list.append(train_vl)
+
+    return loss_list, token_loss, acc, video_loss
+
+
+# -- top level --------------------------------------------------------------
+
+def build(ctx: Ctx, batch: typing.Dict[str, NT]) -> ModelOutput:
+    """Assemble the full model and return losses/outputs.
+
+    ``batch`` maps input names (token_x/token_y/frame/...masks) to NTs,
+    mirroring the reference input pipeline shapes (dataclass.py:310-337)."""
+    cfg = ctx.cfg
+    with ctx.scope(cfg.model_mode):
+        if cfg.use_language:
+            spatial_ctx = batch["token_y"].names[-2]
+        else:
+            spatial_ctx = batch["frame"].names[2]
+        src, vid_tgt = ctx.scoped("input", _input, ctx, batch, spatial_ctx)
+        out = _body(ctx, src)  # pushes its own "body" scope
+        frame_out, token_out = ctx.scoped("output", _output, ctx, out, spatial_ctx)
+        loss_list, token_loss, acc, video_loss = ctx.scoped(
+            "loss", _loss, ctx, frame_out, token_out, batch, vid_tgt)
+    total = loss_list[0]
+    for l in loss_list[1:]:
+        total = total + l
+    return ModelOutput(total, tuple(loss_list), video_loss, acc, token_loss,
+                       frame_out, token_out)
+
+
+def init_params(cfg: Config, batch: typing.Dict[str, NT], seed: int = 0
+                ) -> typing.Tuple[typing.Dict[str, jnp.ndarray],
+                                  typing.Dict[str, typing.Tuple[str, ...]]]:
+    """Run the model in collect mode; returns (params, name->axis-names).
+
+    The collect pass is jitted: parameter names/axes are Python-level side
+    effects gathered at trace time, values come back as one fused XLA
+    computation (all the QR inits compile together)."""
+    meta: typing.Dict[str, typing.Tuple[str, ...]] = {}
+
+    def _collect():
+        ctx = Ctx(cfg, params=None, seed=seed, train=False)
+        build(ctx, batch)
+        meta.update(ctx.axis_names)
+        return ctx.collected
+
+    params = jax.jit(_collect)()
+    return dict(params), dict(meta)

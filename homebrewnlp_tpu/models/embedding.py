@@ -1,0 +1,160 @@
+"""Embeddings: absolute / axial / relative(-learned), plus gather lookup.
+
+Reference: /root/reference/src/model/embedding.py.  The reference lowers
+embedding lookup to a custom per-slice tf.gather with a hand-written
+ScatterAdd gradient (embedding.py:39-125); in JAX the same thing is a plain
+indexed gather whose VJP is XLA's scatter-add, so no custom op is needed.  The
+sinusoidal relative embedding (embedding.py:128-172) is computed on-device at
+trace time (stop-gradient) instead of host-side per slice.
+"""
+from __future__ import annotations
+
+import math
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from .. import nd
+from ..config import INTERMEDIATE
+from ..nd import NT
+from .ctx import Args
+from .linear import Dim, linear_shapes, normal_var, orthogonal_var
+
+
+def _embed_var(args: Args, dims: typing.Sequence[Dim]) -> NT:
+    if "orthogonal" in args:
+        return orthogonal_var(args, dims, name="embed_orth")
+    return normal_var(args, dims, args.cfg.embedding_stddev, name="embed_var")
+
+
+def _multi_dim_flat_index(sizes: typing.Sequence[int], dtype) -> jnp.ndarray:
+    """Flattened linear index over a multi-axis grid, as a dense grid array
+    (reference embedding.py:16-22)."""
+    total_shape = tuple(sizes)
+    out = jnp.zeros(total_shape, dtype)
+    stride = 1
+    for idx, size in enumerate(sizes):
+        r = jnp.arange(0, size * stride, stride, dtype=dtype)
+        out = out + r.reshape([1] * idx + [size] + [1] * (len(sizes) - idx - 1))
+        stride *= size
+    return out
+
+
+def relative_embedding(args: Args, position_dims: typing.Sequence[Dim],
+                       feature_dims: typing.Sequence[Dim], out_dims: typing.Sequence[Dim]
+                       ) -> NT:
+    """Sinusoidal position embedding (reference embedding.py:140-172):
+    sin(pos_index * exp(flat_feature_index + 4/n_feat - log(n_pos/2pi))) * std."""
+    cfg = args.cfg
+    dtype = cfg.calculation_dtype
+    pos_sizes = [s for _, s in position_dims]
+    feat_sizes = [s for _, s in feature_dims]
+    position_count = 1
+    for s in pos_sizes:
+        position_count *= s
+    feature_count = 1.0
+    for s in feat_sizes:
+        feature_count *= s
+
+    positions = _multi_dim_flat_index(pos_sizes, jnp.float32)
+    features = _multi_dim_flat_index(feat_sizes, jnp.float32)
+    additive = 0.0
+    if "cosine" in cfg.position_embedding:
+        additive = jnp.mod(features, 2)
+        features = (features - additive) / 2
+        additive = additive * math.pi
+        feature_count /= 2
+
+    features = features + 4.0 / feature_count
+    features = features - math.log(position_count / 2.0 / math.pi)
+    features = jnp.exp(features) + additive
+
+    pos_nt = NT(positions, tuple(n for n, _ in position_dims))
+    feat_nt = NT(features, tuple(n for n, _ in feature_dims))
+    out_names = tuple(n for n, _ in out_dims)
+    out = nd.einsum([pos_nt, feat_nt], nd.dedup(pos_nt.names + feat_nt.names))
+    out = NT(jnp.sin(out.x) * cfg.embedding_stddev, out.names).transpose_to(out_names)
+    return nd.stop_gradient(out.astype(dtype))
+
+
+def _embed(args: Args, dims: typing.Sequence[Dim]) -> NT:
+    cfg = args.cfg
+    feature_in_tensor = dict(linear_shapes(args)[0]) if args.tensor is not None else {}
+    feat_names = set(feature_in_tensor) | set(cfg.feature_dims) | {INTERMEDIATE}
+    position_dims = [d for d in dims if d[0] not in feat_names]
+    feature_dims = [d for d in dims if d[0] in feat_names]
+
+    if "absolute" in args:
+        return _embed_var(args, dims)
+    if "axial" in args:
+        splits = 2
+        for a in args:
+            if a.isdigit():
+                splits = int(a)
+                break
+        tmp_dims: typing.List[Dim] = []
+        variables: typing.List[NT] = []
+
+        def _new_part(size: int):
+            d = (f"_axial{len(tmp_dims)}", size)
+            tmp_dims.append(d)
+            variables.append(_embed_var(args, [d] + feature_dims))
+
+        for _, size in position_dims:
+            base = int(size ** (1 / splits))
+            while size % base != 0:
+                base -= 1
+            _new_part(size // base ** (splits - 1))
+            for _ in range(1, splits):
+                _new_part(base)
+        prod = nd.einsum(variables, [n for n, _ in tmp_dims] + [n for n, _ in feature_dims])
+        tgt_names = tuple(n for n, _ in dims)
+        flat = prod.x.reshape([s for _, s in position_dims] + [s for _, s in feature_dims])
+        out = NT(flat, tuple(n for n, _ in position_dims + feature_dims))
+        return out.transpose_to(tgt_names)
+    if "relative" in args:
+        out = relative_embedding(args, position_dims, feature_dims, dims)
+        if "learned" in args:
+            out = out * _embed_var(args, feature_dims)
+        return out
+    raise ValueError(f"unsupported embedding kind {args.name_extras}: "
+                     "use relative(-learned) / absolute / axial")
+
+
+def embed(args: Args, dims: typing.Sequence[Dim]) -> NT:
+    return args.ctx.scoped("embed", _embed, args, dims)
+
+
+def gather(args: Args, table: NT, squeeze_dims: typing.Sequence[str] = ()) -> NT:
+    """Embedding lookup: ids (int NT) index axis 0 of ``table``.
+
+    ``squeeze_dims`` are axes shared between ids and table that must be
+    looked up pointwise (the PKM per-head case, reference embedding.py:91-125
+    where mesh-splitting makes the head axis per-slice size 1)."""
+    ids = args.tensor
+    squeeze = [n for n in squeeze_dims if n in ids.names and n in table.names]
+    if not squeeze:
+        out = table.x[ids.x.astype(jnp.int32)]
+        return NT(out.astype(args.cfg.calculation_dtype),
+                  ids.names + table.names[1:])
+    if len(squeeze) != 1:
+        raise NotImplementedError("only one shared gather axis supported")
+    (ax,) = squeeze
+    # table [V, ax, ...rest]; ids [..., ax] -> out [..., ax, ...rest]
+    t = table.transpose_to((table.names[0], ax) + tuple(
+        n for n in table.names[1:] if n != ax))
+    i = ids.transpose_to(tuple(n for n in ids.names if n != ax) + (ax,))
+    gathered = jax.vmap(lambda tab, idx: tab[idx], in_axes=(1, -1), out_axes=-1)(
+        t.x, i.x.astype(jnp.int32))
+    # gathered: [*ids_without_ax, *rest, ax] -> reorder
+    names = tuple(n for n in i.names[:-1]) + t.names[2:] + (ax,)
+    out = NT(gathered.astype(args.cfg.calculation_dtype), names)
+    return out.transpose_to(tuple(n for n in i.names[:-1]) + (ax,) + t.names[2:])
+
+
+def gather_embed(args: Args, dims: typing.Sequence[Dim],
+                 squeeze_dims: typing.Sequence[str] = ()) -> NT:
+    table = args.ctx.scoped("gather", embed, args, dims)
+    out = gather(args, table, squeeze_dims)
+    return out, table

@@ -1,0 +1,346 @@
+"""The layer library behind the block DSL.
+
+Covers every entry of the reference registry (/root/reference/src/model/
+frontend.py:58-75): feed_forward, attention, cummean, cumsum, norm, rezero,
+activation, convolution, dropout, group_linear, split_path, product-key
+memories, reduced_half_linear, transpose_sequence_features,
+bottleneck_group_linear, sum_heads — re-expressed over named jnp axes.
+"""
+from __future__ import annotations
+
+import math
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from .. import nd
+from ..config import (HEADS, INTERMEDIATE, KEY, PKM_AXES, PKM_VALUES, SEQUENCE,
+                      anonymize_name)
+from ..nd import NT
+from ..ops.activations import ACTIVATIONS, activate
+from .ctx import Args
+from .embedding import embed, gather_embed
+from .linear import (Dim, get_intermediate, linear, linear_shapes, normal_var,
+                     orthogonal_var, scalar_var, wrapped_linear)
+
+ATTENTION_DIM = typing.NamedTuple("AttentionDim", (("index", int), ("dim", str)))
+
+
+# -- shape helpers ----------------------------------------------------------
+
+def get_attention_dim(args: Args) -> ATTENTION_DIM:
+    """Attention rotates over all non-feature, non-batch axes by a global
+    counter — multi-axis attention for video (reference utils_mtf.py:418-422)."""
+    cfg = args.cfg
+    skip = set(cfg.feature_dims) | {INTERMEDIATE}
+    dims = [n for n in args.tensor.names if n not in skip][1:]
+    idx = args.ctx.attention_idx % len(dims)
+    return ATTENTION_DIM(idx, dims[idx])
+
+
+def is_masked(args: Args) -> bool:
+    return get_attention_dim(args).index in args.cfg.masked_attention_dimensions
+
+
+# -- simple layers ----------------------------------------------------------
+
+def rezero(args: Args) -> NT:
+    return args.tensor * scalar_var(args, 0.0, name="rezero_var")
+
+
+def dropout(args: Args) -> NT:
+    rate = 0.0
+    for extra in args.name_extras:
+        if extra.startswith("dropout_rate"):
+            rate = float(extra[len("dropout_rate"):])
+    return args.ctx.dropout(args.tensor, rate)
+
+
+def norm(args: Args, feature_shape: typing.Optional[typing.List[Dim]] = None) -> NT:
+    """Group/layer norm via named reductions (reference normalization.py:22-34).
+    'group' keeps the head axis inside the normalized set; 'scale'/'shift' add
+    learned affine parameters over the feature dims."""
+    t = args.tensor
+    if feature_shape is None:
+        feature_shape = linear_shapes(args)[0]
+    fnames = [n for n, _ in feature_shape]
+    reduced = [n for n in fnames if not (n == HEADS and "group" in args)]
+    mean = nd.reduce_mean(t, reduced=reduced)
+    t = t - mean
+    var = nd.reduce_mean(t * t, reduced=reduced)
+    scale = NT(jax.lax.rsqrt(var.x + 1e-5), var.names)
+    factors = [scale, t]
+    if "scale" in args:
+        factors.append(normal_var(args, feature_shape, mean=1.0, name="scale"))
+    out = nd.einsum(factors, t.names)
+    if "shift" in args:
+        out = out + normal_var(args, feature_shape, mean=0.0, name="shift")
+    return out
+
+
+# -- feed-forward family ----------------------------------------------------
+
+def mixture_of_experts(args: Args) -> NT:
+    """Dense soft-MoE: softmax gate over the expert axis contracted into a
+    per-expert linear (reference basic.py:37-44)."""
+    cfg = args.cfg
+    old, new = linear_shapes(args)
+    expert = (anonymize_name("experts") if "experts" in [n for n, _ in old + new]
+              else "experts")
+    gate = linear(args, old, [(expert, cfg.experts)])
+    gate = gate - nd.stop_gradient(nd.reduce_max(gate, reduced=[expert]))
+    gate = NT(jnp.exp(gate.x), gate.names)
+    w = args.ctx.scoped("orthogonal_var", orthogonal_var, args,
+                        list(old) + list(new) + [(expert, cfg.experts)], old)
+    denom = NT(jnp.reciprocal(nd.reduce_sum(gate, reduced=[expert]).x),
+               tuple(n for n in gate.names if n != expert))
+    out_names = nd.dedup([n for n in args.tensor.names
+                          if n not in {o for o, _ in old} - {f for f, _ in new}]
+                         + [f for f, _ in new])
+    return nd.einsum([denom, args.tensor, gate, w], out_names)
+
+
+def activated_linear(args: Args, prefix: str) -> NT:
+    args = args([a[len(prefix):] for a in args if a.startswith(prefix)])
+    ff = mixture_of_experts if "mixture_of_experts" in args else wrapped_linear
+    out = dropout(args(activate(args(ff(args)))))
+    if "glu" in args or "glu_add" in args:
+        out = out * NT(jax.nn.sigmoid(ff(args).x), out.names)
+    if "glu_add" in args:
+        out = out + activate(args(ff(args)))
+    if "norm" in args:
+        out = norm(args(out))
+    return out
+
+
+def activated_linear_in(args: Args) -> NT:
+    return activated_linear(args, "in:")
+
+
+def activated_linear_out(args: Args) -> NT:
+    return activated_linear(args, "out:")
+
+
+def feed_forward(args: Args) -> NT:
+    return activated_linear_out(args(activated_linear_in(args)))
+
+
+def group_linear(args: Args) -> NT:
+    """Per-head square linear (reference basic.py:72-74)."""
+    cfg = args.cfg
+    fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+    anon = [(HEADS, cfg.heads), (anonymize_name(KEY), cfg.features_per_head)]
+    out = linear(args("group"), fdims, anon)
+    return out.rename(anonymize_name(KEY), KEY).transpose_to(args.tensor.names)
+
+
+def sum_heads(args: Args) -> NT:
+    return nd.reduce_sum(args.tensor, reduced=[HEADS])
+
+
+def transpose_sequence_features(args: Args) -> NT:
+    """Token-mixing transpose: swap sequence and feature axes (reference
+    basic.py:81-86; requires seq == features_per_head)."""
+    cfg = args.cfg
+    assert cfg.features_per_head == cfg.sequence_length, "seq must equal features_per_head"
+    t = args.tensor
+    swapped = tuple(KEY if n == SEQUENCE else SEQUENCE if n == KEY else n
+                    for n in t.names)
+    return NT(t.x, swapped).transpose_to(t.names)
+
+
+def reduced_half_linear(args: Args) -> NT:
+    """Head-summed input passed through a per-head linear back to feature
+    shape (reference basic.py:89-90; the reference's trailing reshape is
+    shape-inconsistent there, so we re-expand via a features linear)."""
+    cfg = args.cfg
+    reduced = nd.reduce_sum(args.tensor, reduced=[HEADS])
+    fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+    return linear(args(reduced), [(KEY, cfg.features_per_head)], fdims
+                  ).transpose_to(args.tensor.names)
+
+
+def product_key_memory(args: Args) -> NT:
+    """PKM sparse memory: per-axis key assignment, stable softmax normalizer,
+    top-1 per axis, gather from a f^2-entry value table (reference
+    basic.py:93-115).  The reference does the normalizer in fp64; TPUs have no
+    native f64 so we use f32 (documented divergence)."""
+    cfg = args.cfg
+    anon_key = anonymize_name(KEY)
+    features = [(PKM_AXES, cfg.pkm_axes), (anon_key, cfg.features_per_head)]
+    old, _ = linear_shapes(args)
+    assignment = linear(args, old, [(HEADS, cfg.heads)] + features)
+    assignment = norm(args(assignment), features)
+    assignment = assignment.astype(jnp.float32)
+    normalizer = nd.reduce_max(assignment, reduced=[anon_key])
+    normalizer = nd.reduce_sum(normalizer, reduced=[PKM_AXES])
+    assignment = assignment - nd.stop_gradient(normalizer)
+    assignment = NT(jnp.exp(assignment.x), assignment.names)
+    norm_sum = nd.reduce_sum(assignment, reduced=[anon_key])  # [..., pkm]
+    ax = norm_sum.names.index(PKM_AXES)
+    normalizer = NT(jnp.prod(norm_sum.x, axis=ax),
+                    tuple(n for n in norm_sum.names if n != PKM_AXES))
+
+    pk_ax = assignment.names.index(anon_key)
+    val = jnp.max(assignment.x, axis=pk_ax)
+    idx = jnp.argmax(assignment.x, axis=pk_ax)
+    val_nt = NT(val, tuple(n for n in assignment.names if n != anon_key))
+    idx_nt = NT(idx, val_nt.names)
+    # combine per-axis indices into one flat value index: sum idx_i * f**i
+    powers = (cfg.features_per_head ** jnp.arange(cfg.pkm_axes)).astype(jnp.int32)
+    ax2 = idx_nt.names.index(PKM_AXES)
+    flat_idx = jnp.tensordot(idx_nt.x.astype(jnp.int32),
+                             powers, axes=([ax2], [0]))
+    flat_idx_nt = NT(flat_idx, tuple(n for n in idx_nt.names if n != PKM_AXES))
+    val_prod = NT(jnp.prod(val_nt.x, axis=ax2), flat_idx_nt.names)
+    val_final = (val_prod / normalizer).astype(cfg.calculation_dtype)
+
+    fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+    out, _ = gather_embed(args(flat_idx_nt),
+                          [(PKM_VALUES, cfg.product_key_value_vectors)] + fdims,
+                          squeeze_dims=[HEADS])
+    return out * val_final
+
+
+def feed_forward_product_key_memory(args: Args) -> NT:
+    return product_key_memory(args(activated_linear_in(args)))
+
+
+def bottleneck_group_linear(args: Args) -> NT:
+    """3-stage grouped MLP: dense bottleneck in, per-head widened mid, per-head
+    out (reference basic.py:122-126)."""
+    args = args(activated_linear_in(args))
+    args.name_extras.extend(["group", "mid:group", "out:group"])
+    args = args(activated_linear(args, "mid:"))
+    return activated_linear_out(args)
+
+
+# -- attention / spatial mixing --------------------------------------------
+
+def _causal_mask(args: Args, dim: str, tmp: str, keep_ge: bool) -> NT:
+    size = args.tensor.dim_size(dim)
+    op = jnp.greater_equal if keep_ge else jnp.less
+    return nd.compare_range(dim, size, tmp, size, op, args.cfg.calculation_dtype)
+
+
+def _masked_map(args: Args) -> typing.Tuple[NT, typing.Union[NT, int]]:
+    """Learned per-head position-pair bias map, optionally causal-masked
+    (reference spatial.py:19-23)."""
+    cfg = args.cfg
+    dim = get_attention_dim(args).dim
+    tmp = anonymize_name(dim)
+    size = args.tensor.dim_size(dim)
+    bias = embed(args, [(HEADS, cfg.heads), (dim, size), (tmp, size)])
+    mask = _causal_mask(args, dim, tmp, keep_ge=True) if is_masked(args) else 1
+    return bias, mask
+
+
+def attention(args: Args) -> NT:
+    """Composable attention (reference spatial.py:42-81): optional QK^T
+    softmax path, learned bias/scale attention maps, causal masking, and
+    value source selection.  The product ``logit @ value`` and ``q @ k^T``
+    are plain einsums -> MXU."""
+    ctx = args.ctx
+    cfg = args.cfg
+    ctx.attention_idx += 1
+    base = None
+    if "dot_product" in args or "input_as_value" not in args:
+        base = args(activated_linear_in(args))
+
+    dim = get_attention_dim(args).dim
+    tmp = anonymize_name(dim)
+    t = args.tensor
+    shape_names = t.names
+
+    logit: typing.Optional[NT] = None
+    val: typing.Optional[NT] = None
+    key: typing.Optional[NT] = None
+
+    def _biased(a: Args) -> NT:
+        bias, mask = _masked_map(a)
+        return bias * mask if isinstance(mask, NT) else bias
+
+    if "dot_product" in args:
+        if "embedded" in args or "context" in args:
+            key = activated_linear_out(base)
+        if "embedded" in args or "positional" in args:
+            fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+            pos = embed(args, [(dim, t.dim_size(dim))] + fdims)
+            key = pos if key is None else key + pos
+        qry = activated_linear_out(base)
+        qry = qry * (t.dim_size(dim) ** -0.5)
+        old, _ = linear_shapes(args)
+        contracted = [n for n, _ in old if n != HEADS]
+        logit_names = tuple(n for n in shape_names if n not in contracted) + (tmp,)
+        key_anon = key.rename(dim, tmp)
+        logit = nd.einsum([qry, key_anon], logit_names)
+        if "shared_key_value" in args:
+            val = key.rename(dim, tmp)
+    if "biased_softmax" in args:
+        b = _biased(args)
+        logit = b if logit is None else logit + b
+    if logit is not None:
+        # the reference masks every softmax logit causally, regardless of
+        # masked_attention_dimensions (spatial.py:68)
+        logit = logit + _causal_mask(args, dim, tmp, keep_ge=False) * -2e38
+        logit = logit - nd.stop_gradient(nd.reduce_max(logit, reduced=[tmp]))
+        logit = NT(jnp.exp(logit.x), logit.names)
+        logit = logit / nd.reduce_sum(logit, reduced=[tmp])
+    if "biased_attention_map" in args:
+        b = _biased(args)
+        logit = b if logit is None else logit + b
+    if "scale_attention_map" in args:
+        b = _biased(args)
+        logit = b if logit is None else logit * b
+    if val is None:
+        src = t if "input_as_value" in args else activated_linear_out(base)
+        val = src.rename(dim, tmp)
+    if logit is None:
+        raise UserWarning(f"no spatial mixing in attention: {args.name_extras}")
+    return nd.einsum([logit, val], shape_names)
+
+
+def _cumsum_axis(args: Args) -> int:
+    return args.tensor.names.index(get_attention_dim(args).dim)
+
+
+def cumsum(args: Args) -> NT:
+    return NT(jnp.cumsum(args.tensor.x, axis=_cumsum_axis(args)), args.tensor.names)
+
+
+def cummean(args: Args) -> NT:
+    dim = get_attention_dim(args).dim
+    out = cumsum(args)
+    denom = 1 + nd.arange(dim, args.tensor.dim_size(dim),
+                          dtype=args.tensor.dtype)
+    return out / denom
+
+
+def convolution(args: Args) -> NT:
+    """Causal 1D convolution over the rotating attention axis.  The
+    reference's custom conv op is disabled in-tree ("Convolution is currently
+    broken", reference convolution.py:129); this is a working TPU-native
+    causal depthwise-style conv via lax.conv_general_dilated."""
+    cfg = args.cfg
+    dim = get_attention_dim(args).dim
+    t = args.tensor
+    ksize = cfg.convolution_size
+    fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+    w = orthogonal_var(args, [("_conv_kernel", ksize)] + fdims, name="conv_kernel")
+    # causal depthwise conv: channels = all feature dims, window over `dim`
+    feat_names = [n for n, _ in fdims if n in t.names]
+    other = [n for n in t.names if n != dim and n not in feat_names]
+    xt = t.transpose_to(other + [dim] + feat_names)
+    lead = xt.x.shape[:len(other)]
+    length = xt.x.shape[len(other)]
+    chans = 1
+    for s in xt.x.shape[len(other) + 1:]:
+        chans *= s
+    x2 = xt.x.reshape((-1, length, chans))  # N, W, C
+    k = w.x.astype(t.dtype).reshape(ksize, 1, chans)  # W, I/group=1, C
+    y = jax.lax.conv_general_dilated(
+        x2, k, (1,), [(ksize - 1, 0)], feature_group_count=chans,
+        dimension_numbers=("NWC", "WIO", "NWC"))
+    y = y.reshape(lead + xt.x.shape[len(other):])
+    return NT(y, tuple(other + [dim] + feat_names)).transpose_to(t.names)

@@ -1,0 +1,111 @@
+"""Linear primitives + the DSL's dimension-selection rules.
+
+Reproduces the semantics of ``linear_shapes``/``get_intermediate``
+(/root/reference/src/utils_mtf.py:376-391) and ``linear``/``orthogonal_var``/
+``normal_var`` (/root/reference/src/model/backend.py:97-118) over named jnp
+axes.  A "Dim" here is a (name, size) pair; tensors carry names and sizes
+directly, so no mtf Shape objects are needed.
+"""
+from __future__ import annotations
+
+import typing
+
+from .. import nd
+from ..config import HEADS, INTERMEDIATE, KEY, anonymize_name
+from ..nd import NT
+from ..ops.init import constant_init, default_fan_in, normal_init, orthogonal_init
+from .ctx import Args
+
+Dim = typing.Tuple[str, int]
+
+
+def get_intermediate(args: Args) -> typing.List[Dim]:
+    """Intermediate dims for a linear: plain -> [intermediate]; group -> a
+    per-head widened key axis (reference utils_mtf.py:376-380)."""
+    cfg = args.cfg
+    if "group" not in args:
+        return [(INTERMEDIATE, cfg.intermediate_size)]
+    return [(HEADS, cfg.heads),
+            (anonymize_name(KEY), cfg.features_per_head * cfg.group_linear_factor)]
+
+
+def linear_shapes(args: Args) -> typing.Tuple[typing.List[Dim], typing.List[Dim]]:
+    """(old, new) dim lists for a DSL linear (reference utils_mtf.py:383-391).
+
+    old = dims shared between the tensor and the feature set (contracted);
+    new = remaining feature dims (produced); in group mode the head dim stays
+    on both sides (per-head block-diagonal linear)."""
+    cfg = args.cfg
+    t = args.tensor
+    features: typing.List[Dim] = list(get_intermediate(args))
+    for name in cfg.feature_dims:
+        if name not in [f[0] for f in features]:
+            features.append((name, cfg.dims[name]))
+    if "group" in args and INTERMEDIATE in t.names:
+        features = [f for f in features if f[0] != KEY]
+        features.append((INTERMEDIATE, cfg.intermediate_size))
+    fnames = [f[0] for f in features]
+    # crossection ordered by (tensor names ++ features)
+    old = [(n, t.dim_size(n)) for n in t.names if n in fnames]
+    old_names = [n for n, _ in old]
+    keep = {HEADS} if ("group" in args and HEADS in old_names) else set()
+    new = [f for f in features if f[0] not in (set(old_names) - keep)]
+    return old, new
+
+
+def orthogonal_var(args: Args, dims: typing.Sequence[Dim],
+                   fan_in: typing.Optional[typing.Sequence[Dim]] = None,
+                   name: str = "orthogonal_var") -> NT:
+    cfg = args.cfg
+    names = nd.dedup([d[0] for d in dims])
+    size_of = dict(dims)
+    sizes = [size_of[n] for n in names]
+    if fan_in is None:
+        fan_names = default_fan_in(names, cfg.feature_dims)
+        fan_sizes = [size_of[n] for n in fan_names]
+    else:
+        fan_sizes = [s for _, s in fan_in]
+    scale = (cfg.depth ** -0.5) if (cfg.scale_by_depth and args.is_last) else 1.0
+    init = orthogonal_init(sizes, fan_sizes, scale)
+    return args.ctx.param(name, names, sizes, init, shared="shared" in args)
+
+
+def normal_var(args: Args, dims: typing.Sequence[Dim], stddev: float = 0.02,
+               mean: float = 0.0, name: str = "normal_var") -> NT:
+    names = nd.dedup([d[0] for d in dims])
+    size_of = dict(dims)
+    sizes = [size_of[n] for n in names]
+    return args.ctx.param(name, names, sizes, normal_init(stddev, mean),
+                          shared="shared" in args)
+
+
+def scalar_var(args: Args, value: float = 0.0, name: str = "rezero_var") -> NT:
+    return args.ctx.param(name, (), (), constant_init(value), shared="shared" in args)
+
+
+def linear(args: Args, old: typing.Sequence[Dim], new: typing.Sequence[Dim]) -> NT:
+    """y = einsum(x, W[old+new]) contracting ``old`` (reference backend.py:108-110)."""
+    w = args.ctx.scoped("orthogonal_var", orthogonal_var, args, list(old) + list(new), old)
+    out_names = nd.dedup([n for n in args.tensor.names if n not in
+                          {o[0] for o in old} - {f[0] for f in new}]
+                         + [f[0] for f in new])
+    return nd.einsum([args.tensor, w], out_names)
+
+
+def linear_to_features(args: Args, old: typing.Optional[typing.Sequence[Dim]] = None) -> NT:
+    cfg = args.cfg
+    new = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+    if old is None:
+        old, _ = linear_shapes(args)
+    return linear(args, old, new)
+
+
+def linear_from_features(args: Args, new: typing.Optional[typing.Sequence[Dim]] = None) -> NT:
+    cfg = args.cfg
+    old = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+    return linear(args, old, new)
+
+
+def wrapped_linear(args: Args) -> NT:
+    old, new = linear_shapes(args)
+    return linear(args, old, new)

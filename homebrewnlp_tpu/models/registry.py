@@ -1,0 +1,76 @@
+"""Layer-DSL registry + block assembly.
+
+Layer spec strings are ``"name-extra1-extra2"`` (reference
+src/model/frontend.py:21-36); ``split_path`` builds add/multiply parallel
+branches from ``;``/``,``-separated sub-configs (frontend.py:39-55).
+"""
+from __future__ import annotations
+
+import typing
+
+from ..config import BlockConfig
+from ..nd import NT
+from ..ops.activations import activate
+from .ctx import Args, Ctx
+from . import layers
+
+
+def _get_block_part(block_part_config: BlockConfig, ctx: Ctx, block_input: NT) -> NT:
+    out = block_input
+    for idx, layer in enumerate(block_part_config.layer, 1):
+        name, *extras = layer.split("-")
+        if name not in LAYER_FUNCTIONS:
+            raise ValueError(f"unknown layer {name!r} in spec {layer!r}; "
+                             f"known layers: {sorted(LAYER_FUNCTIONS)}")
+        args = Args(ctx, out, extras, idx == len(block_part_config.layer))
+        out = ctx.scoped(name + "_", LAYER_FUNCTIONS[name], args)
+    if block_part_config.skip and block_part_config.memory_reduction_strategy in ("none", "checkpoint"):
+        out = out + block_input
+    return out
+
+
+def block_part_fn(ctx: Ctx, block_part_config: BlockConfig, block_input: NT,
+                  name_prefix: str = "block") -> NT:
+    return ctx.scoped(f"{name_prefix}_", _get_block_part, block_part_config, ctx,
+                      block_input)
+
+
+def split_path(args: Args) -> NT:
+    base, *branch_confs = "-".join(args.name_extras).split(";")
+    base = base.split("-")
+    if "add" in base:
+        out: typing.Union[NT, int] = 0
+        combine = lambda a, b: b if isinstance(a, int) else a + b
+    elif "multiply" in base:
+        out = 1
+        combine = lambda a, b: b if isinstance(a, int) else a * b
+    else:
+        raise ValueError(f"split_path needs add/multiply base, got {base}")
+    for conf in branch_confs:
+        branch = _get_block_part(
+            BlockConfig(layer=conf.split(","), skip=False,
+                        memory_reduction_strategy=""),
+            args.ctx, args.tensor)
+        out = combine(out, branch)
+    return out
+
+
+LAYER_FUNCTIONS: typing.Dict[str, typing.Callable[[Args], NT]] = {
+    "feed_forward": layers.feed_forward,
+    "attention": layers.attention,
+    "cummean": layers.cummean,
+    "cumsum": layers.cumsum,
+    "norm": layers.norm,
+    "rezero": layers.rezero,
+    "activation": activate,
+    "convolution": layers.convolution,
+    "dropout": layers.dropout,
+    "group_linear": layers.group_linear,
+    "split_path": split_path,
+    "feed_forward_product_key_memory": layers.feed_forward_product_key_memory,
+    "product_key_memory": layers.product_key_memory,
+    "reduced_half_linear": layers.reduced_half_linear,
+    "transpose_sequence_features": layers.transpose_sequence_features,
+    "bottleneck_group_linear": layers.bottleneck_group_linear,
+    "sum_heads": layers.sum_heads,
+}

@@ -1,0 +1,58 @@
+"""Activation registry.
+
+The reference hand-writes forward/backward slicewise op pairs for Mish, SiLU,
+LeCunTanh and Softsign purely to avoid storing activations in Mesh-TF
+(/root/reference/src/model/activation.py:13-145).  On TPU/XLA that machinery is
+counter-productive: elementwise chains fuse into the surrounding matmuls and
+`jax.checkpoint` governs what is stored, so these are plain jnp functions.
+LeCunTanh keeps the reference's (nonstandard) ``tanh(x) + 0.1 x`` definition
+(activation.py:96).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nd import NT
+
+
+def _wrap(fn):
+    def inner(t: NT) -> NT:
+        return NT(fn(t.x), t.names)
+
+    return inner
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def lecun_tanh(x):
+    return jnp.tanh(x) + x * 0.1
+
+
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+ACTIVATIONS = {
+    "relu": _wrap(jax.nn.relu),
+    "sigmoid": _wrap(jax.nn.sigmoid),
+    "tanh": _wrap(jnp.tanh),
+    "gelu": _wrap(jax.nn.gelu),
+    "lecun_tanh": _wrap(lecun_tanh),
+    "silu": _wrap(jax.nn.silu),
+    "mish": _wrap(mish),
+    "mtf_mish": _wrap(mish),
+    "softsign": _wrap(softsign),
+    "exp": _wrap(jnp.exp),
+}
+
+
+def activate(args) -> NT:
+    """Dispatch on the first known activation name in the DSL extras
+    (reference activation.py:201-211); identity fallback."""
+    for fn_name in args:
+        if fn_name in ACTIVATIONS:
+            return ACTIVATIONS[fn_name](args.tensor)
+    return args.tensor

@@ -1,0 +1,69 @@
+"""Parameter initializers.
+
+Orthogonal init reproduces the reference's fan computation, sign-corrected QR
+and ``1/sqrt(depth)`` last-layer scaling (/root/reference/src/model/backend.py:18-40);
+normal init mirrors ``normal_var`` (backend.py:103-105).
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+
+def feature_dims_used(names: typing.Sequence[str],
+                      feature_names: typing.Sequence[str]) -> bool:
+    """True when at least half of {heads, key, _heads, _key} appear
+    (reference utils_mtf.py:354-361)."""
+    anon = ["_" + n for n in feature_names]
+    return sum(n in names for n in list(feature_names) + anon) // 2 > 0
+
+
+def default_fan_in(names: typing.Sequence[str], feature_names: typing.Sequence[str]
+                   ) -> typing.Sequence[str]:
+    """Fan-in dims when not explicitly given (reference utils_mtf.py:429-436)."""
+    if feature_dims_used(names, feature_names):
+        return names[:2]
+    return names[:1]
+
+
+def orthogonal_init(sizes: typing.Sequence[int], fan_in_sizes: typing.Sequence[int],
+                    scale: float = 1.0):
+    """Returns init_fn(key, shape)->f32 with sign-corrected QR orthogonality."""
+    fan_in = 1
+    for s in fan_in_sizes:
+        fan_in *= int(s)
+    total = 1
+    for s in sizes:
+        total *= int(s)
+    fan_out = total // max(fan_in, 1)
+    transpose = fan_out > fan_in
+    qr_shape = (fan_out, fan_in) if transpose else (fan_in, fan_out)
+
+    def init(key, shape):
+        del shape
+        a = jax.random.normal(key, qr_shape, dtype=jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if transpose:
+            q = q.T
+        out = q.reshape(tuple(int(s) for s in sizes))
+        return out * scale
+
+    return init
+
+
+def normal_init(stddev: float = 0.02, mean: float = 0.0):
+    def init(key, shape):
+        return jax.random.normal(key, shape, dtype=jnp.float32) * stddev + mean
+
+    return init
+
+
+def constant_init(value: float = 0.0):
+    def init(key, shape):
+        del key
+        return jnp.full(shape, value, dtype=jnp.float32)
+
+    return init

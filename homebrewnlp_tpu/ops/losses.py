@@ -1,0 +1,67 @@
+"""Loss functions.
+
+z-loss-regularized softmax cross-entropy follows the reference's stable
+formulation (/root/reference/src/mtf_wrapper.py:64-75): loss =
+-mean(logit_target - log_z) + z_loss * mean(log_z^2).  Accumulation happens in
+float32 (the reference sums in the bf16 activation dtype; on TPU the f32
+accumulation is free via the MXU/VPU accumulators and strictly better
+numerically).
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from .. import nd
+from ..config import VOCAB
+from ..nd import NT
+
+
+def softmax_cross_entropy_with_logits(logits: NT, targets: NT, z_loss: float
+                                      ) -> jnp.ndarray:
+    """logits [..., vocab] f32/bf16; targets [...] int; returns scalar f32."""
+    x = logits.x.astype(jnp.float32)
+    vocab_axis = logits.names.index(VOCAB)
+    max_logit = jax.lax.stop_gradient(jnp.max(x, axis=vocab_axis, keepdims=True))
+    log_z = jnp.log(jnp.sum(jnp.exp(x - max_logit), axis=vocab_axis,
+                            keepdims=True)) + max_logit
+    tgt = jnp.expand_dims(targets.x.astype(jnp.int32), vocab_axis)
+    logit_tgt = jnp.take_along_axis(x, tgt, axis=vocab_axis)
+    size = targets.size
+    loss = -jnp.sum(logit_tgt - log_z) / size
+    if z_loss:
+        loss = loss + jnp.sum(jnp.square(log_z)) * (z_loss / size)
+    return loss
+
+
+def accuracy(logits: NT, targets: NT) -> jnp.ndarray:
+    vocab_axis = logits.names.index(VOCAB)
+    pred = jnp.argmax(logits.x, axis=vocab_axis)
+    return jnp.mean((pred == targets.x.astype(pred.dtype)).astype(jnp.float32))
+
+
+def video_l1_loss(frame_out: NT, vid_tgt: NT, vid_msk: typing.Optional[NT],
+                  cat_msk: typing.Optional[NT]) -> typing.Tuple[jnp.ndarray, jnp.ndarray]:
+    """Masked L1 via sign-einsum (reference src/model/__init__.py:189-199).
+    Returns (train_loss, display_loss) — display is renormalized by mask
+    density."""
+    diff = frame_out - vid_tgt
+    factors = [diff, nd.stop_gradient(NT(jnp.sign(diff.x), diff.names))]
+    if vid_msk is not None:
+        factors.append(vid_msk)
+    if cat_msk is not None:
+        factors.append(cat_msk)
+    prod = factors[0]
+    for f in factors[1:]:
+        prod = prod * f
+    loss = jnp.sum(prod.x.astype(jnp.float32)) / frame_out.size
+    display = loss
+    if vid_msk is not None:
+        display = display * (vid_msk.size / jnp.maximum(
+            jnp.sum(vid_msk.x.astype(jnp.float32)), 1.0))
+    if cat_msk is not None:
+        display = display * (cat_msk.size / jnp.maximum(
+            jnp.sum(cat_msk.x.astype(jnp.float32)), 1.0))
+    return loss, display

@@ -1,0 +1,102 @@
+"""Shared test harness: tiny configs + forward/grad helpers.
+
+Plays the role of the reference's tests/backend.py (BaseTest/OperationTest
+over a CPU PlacementMeshImpl) for the JAX framework.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from homebrewnlp_tpu.config import Config
+from homebrewnlp_tpu.models import build, init_params
+from homebrewnlp_tpu.models.ctx import Args, Ctx
+from homebrewnlp_tpu.nd import NT
+
+RELU_STD = 1 / 1.42
+
+
+def tiny_config(**overrides) -> Config:
+    base = dict(
+        model_mode="gpt", use_video=False, use_language=True,
+        sequence_length=16, features_per_head=32, heads=4, depth=2,
+        vocab_size=64, train_batch_size=2,
+        memory_reduction_strategy="none",
+        embedding_stddev=0.04,
+        intermediate_feed_forward_multiplier_multiplier=0.5,
+        block_config=[{"layer": ["norm-shift-scale", "feed_forward-in:relu"]}],
+    )
+    base.update(overrides)
+    return Config(base)
+
+
+def mixer_config(**overrides) -> Config:
+    """Shrunk 32big_mixer.json architecture (same DSL strings)."""
+    base = dict(
+        model_mode="gpt", use_video=False, use_language=True,
+        sequence_length=16, features_per_head=32, heads=4, depth=2,
+        vocab_size=64, train_batch_size=2, calc_accuracy=True,
+        memory_reduction_strategy="revnet",
+        group_linear_factor=2,
+        intermediate_feed_forward_multiplier_multiplier=0.5,
+        block_config=[
+            {"layer": ["norm-shift-scale-features-group",
+                       "bottleneck_group_linear-in:relu-mid:relu-mid:norm-mid:shift-mid:scale-mid:features"]},
+            {"layer": ["norm-shift-scale-features-group",
+                       "attention-biased_attention_map-absolute-input_as_value-shared",
+                       "norm-shift-scale-features-group",
+                       "activation-gelu",
+                       "attention-biased_attention_map-absolute-input_as_value-shared"]},
+        ],
+    )
+    base.update(overrides)
+    return Config(base)
+
+
+def text_batch(cfg: Config, seed: int = 0) -> typing.Dict[str, NT]:
+    key = jax.random.key(seed)
+    shape = (cfg.train_batch_size, cfg.sequence_length, cfg.token_patch_size)
+    names = ("batch", "sequence", "language_token_patch")
+    kx, ky = jax.random.split(key)
+    return {
+        "token_x": NT(jax.random.randint(kx, shape, 0, cfg.vocab_size), names),
+        "token_y": NT(jax.random.randint(ky, shape, 0, cfg.vocab_size), names),
+    }
+
+
+def init_and_loss(cfg: Config, seed: int = 0):
+    batch = text_batch(cfg, seed)
+    params, axes = init_params(cfg, batch, seed=seed)
+
+    def loss_fn(p, rng):
+        ctx = Ctx(cfg, params=p, train=True, rng=rng)
+        return build(ctx, batch).loss
+
+    return params, axes, batch, loss_fn
+
+
+def feature_tensor(cfg: Config, seed: int = 0, std: float = 1.0) -> NT:
+    shape = (cfg.train_batch_size, cfg.sequence_length, cfg.heads,
+             cfg.features_per_head)
+    x = jax.random.normal(jax.random.key(seed), shape, jnp.float32) * std
+    return NT(x, ("batch", "sequence", "heads", "features_per_head"))
+
+
+def run_layer(cfg: Config, layer_spec: str, x: NT, seed: int = 0,
+              train: bool = False) -> NT:
+    """Init + apply a single DSL layer on tensor x."""
+    from homebrewnlp_tpu.models.registry import LAYER_FUNCTIONS
+
+    name, *extras = layer_spec.split("-")
+
+    def _run(ctx):
+        args = Args(ctx, x, extras, is_last=False)
+        return LAYER_FUNCTIONS[name](args)
+
+    ctx = Ctx(cfg, params=None, seed=seed, train=train)
+    _run(ctx)
+    ctx2 = Ctx(cfg, params=dict(ctx.collected), train=train,
+               rng=jax.random.key(seed))
+    return _run(ctx2)
