@@ -1,0 +1,142 @@
+"""Optimizer layer: DSL-chained per-variable transforms + schedules + decay.
+
+The reference's ``get_optimizer`` (/root/reference/src/optimizer/__init__.py:
+69-186) hand-walks the mtf graph in reverse and emits assign ops; in JAX the
+backward pass is ``jax.grad`` and this module is a pure function
+``(params, grads, state, step) -> (new_params, new_state, lr)`` compiled into
+the train step.  Reproduced semantics:
+
+- optimizer string-DSL chain (``"adaptive_clip:0.003-sm3-momentum:0.9:1:1-
+  learning_rate"``) — see transforms.py
+- rezero LR multiplier (reference __init__.py:46-47)
+- selective weight decay on "large" tensors via the name/shape heuristic
+  (reference __init__.py:49-61), applied *after* the chain so it is not
+  adaptively normalized, scaled by lr * weight_decay
+- optimizer state in ``optimizer_slice_dtype``, math in
+  ``optimizer_calculation_dtype`` (reference dataclass.py:200-204)
+- final update: ``w -= transformed_grad`` (reference __init__.py:63-66)
+"""
+from __future__ import annotations
+
+import typing
+
+import jax.numpy as jnp
+
+from ..config import Config
+from ..ops.init import feature_dims_used
+from .multiloss import STRATEGIES
+from .schedule import learning_rate as learning_rate_fn
+from .transforms import VarCtx, apply_chain, chain_slot_shapes, parse_chain
+
+Params = typing.Dict[str, jnp.ndarray]
+OptState = typing.Dict[str, typing.Dict[str, jnp.ndarray]]
+
+
+def is_large_tensor(name: str, axis_names: typing.Sequence[str],
+                    size: int, cfg: Config) -> bool:
+    """Weight-decay eligibility heuristic (reference __init__.py:52-61)."""
+    features_used = feature_dims_used(axis_names, cfg.feature_dims)
+    ndims = len(axis_names)
+    large = (features_used and ndims > len(cfg.feature_dims)) or (
+        not features_used and ndims >= 2)
+    large &= size > 1
+    large &= "norm" not in name
+    large &= "rezero" not in name
+    large &= "embed" not in name
+    large &= "input" not in name or "lang_in" in name or "vid_in" in name
+    large &= "output" not in name or "lang_out" in name or "vid_out" in name
+    return large
+
+
+def _parse_global_clip(spec: str) -> float:
+    for name, args in parse_chain(spec):
+        if name == "global_l2norm_clip":
+            return float(args[0])
+    raise ValueError("global_l2norm_clip not in spec")
+
+
+class Optimizer:
+    """DSL-chain optimizer over a flat param dict.
+
+    ``axes`` maps param name -> axis-name tuple (from ``init_params``) and
+    drives both the decay heuristic and state sharding specs."""
+
+    def __init__(self, cfg: Config, axes: typing.Dict[str, typing.Tuple[str, ...]]):
+        self.cfg = cfg
+        self.axes = dict(axes)
+        self.spec = cfg.optimizer
+
+    # -- state ---------------------------------------------------------------
+    def init(self, params: Params) -> OptState:
+        dtype = self.cfg.optimizer_slice_dtype
+        state: OptState = {}
+        for name, value in params.items():
+            shapes = chain_slot_shapes(self.spec, value.shape)
+            state[name] = {k: jnp.zeros(s, dtype) for k, s in shapes.items()}
+        return state
+
+    def slot_axis_names(self) -> typing.Dict[str, typing.Dict[str, typing.Tuple[str, ...]]]:
+        """Axis names for every slot (for sharding): full-shape slots inherit
+        the variable's axes; per-dim sm3 buffers keep that one axis; scalar
+        slots get ()."""
+        out: typing.Dict[str, typing.Dict[str, typing.Tuple[str, ...]]] = {}
+        for name, axis_names in self.axes.items():
+            shapes = chain_slot_shapes(self.spec, [1] * len(axis_names))
+            slot_axes = {}
+            for k, shape in shapes.items():
+                leaf = k.rsplit("/", 1)[-1]
+                if leaf.startswith("dim") and leaf[3:].isdigit():
+                    slot_axes[k] = (axis_names[int(leaf[3:])],)
+                elif len(shape) == len(axis_names):
+                    slot_axes[k] = tuple(axis_names)
+                else:
+                    slot_axes[k] = tuple(axis_names[:len(shape)])
+            out[name] = slot_axes
+        return out
+
+    # -- update --------------------------------------------------------------
+    def update(self, params: Params, grads: Params, state: OptState,
+               step: jnp.ndarray
+               ) -> typing.Tuple[Params, OptState, jnp.ndarray]:
+        """One optimizer application.  ``step`` is the 0-indexed global update
+        counter; debiasing uses step+1."""
+        cfg = self.cfg
+        cdtype = cfg.optimizer_calculation_dtype
+        lr = learning_rate_fn(cfg, step)
+        step_count = (step + 1).astype(jnp.float32)
+
+        global_norm_recip = None
+        if "global_l2norm_clip" in self.spec:
+            clip = _parse_global_clip(self.spec)
+            gsum = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                       for g in grads.values())
+            global_norm_recip = jnp.reciprocal(
+                jnp.sqrt(jnp.maximum(gsum, clip ** -2))).astype(cdtype)
+
+        new_params: Params = {}
+        new_state: OptState = {}
+        for name, value in params.items():
+            grad = grads[name].astype(cdtype)
+            val = value.astype(cdtype)
+            ctx = VarCtx(grad=grad, value=val, lr=lr,
+                         beta1=cfg.opt_beta1, beta2=cfg.opt_beta2,
+                         step_count=step_count,
+                         global_norm_reciprocal=global_norm_recip)
+            slots = {k: v.astype(cdtype) for k, v in state[name].items()}
+            out, slots = apply_chain(self.spec, ctx, slots)
+            if "rezero" in name:
+                out = out * cfg.rezero_lr_multiplier
+            if cfg.weight_decay > 0 and is_large_tensor(
+                    name, self.axes.get(name, ()), int(value.size), cfg):
+                out = out + val * (lr.astype(cdtype) * cfg.weight_decay)
+            new_params[name] = (val - out).astype(value.dtype)
+            new_state[name] = {k: v.astype(cfg.optimizer_slice_dtype)
+                               for k, v in slots.items()}
+        return new_params, new_state, lr
+
+    # -- multi-loss ----------------------------------------------------------
+    def combine_losses(self, grads_per_loss: typing.Sequence[Params]) -> Params:
+        return STRATEGIES[self.cfg.multi_loss_strategy](list(grads_per_loss))
+
+
+__all__ = ["Optimizer", "is_large_tensor", "learning_rate_fn", "STRATEGIES"]

@@ -1,0 +1,58 @@
+"""Learning-rate schedules.
+
+Reproduces the reference's composable schedule modules
+(/root/reference/src/optimizer/learning_rate.py:28-72): each named module in
+``learning_rate_config`` transforms the running LR in order.  Host-side tf ops
+there become a pure jnp function of the step here — it traces into the train
+step so the schedule lives on-device.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import Config
+
+
+def _linear_warmup(lr, step, c):
+    final = jnp.float32(c.final_step)
+    warm = step / jnp.maximum(final, 1.0)
+    return lr * jnp.where(step < final, warm, 1.0)
+
+
+def _exponential_decay(lr, step, c):
+    exp = jnp.maximum(step - c.start_step, 0.0)
+    return lr * jnp.power(jnp.float32(c.factor), exp)
+
+
+def _linear_decay(lr, step, c):
+    span = jnp.maximum(jnp.float32(c.final_step - c.start_step), 1.0)
+    decay = 1.0 - (step - c.start_step) / span
+    return lr * jnp.clip(decay, 0.0, 1.0)
+
+
+def _lower_bound(lr, step, c):
+    return jnp.maximum(lr, jnp.float32(c.factor))
+
+
+def _upper_bound(lr, step, c):
+    return jnp.minimum(lr, jnp.float32(c.factor))
+
+
+MODULES = {
+    "linear_warmup": _linear_warmup,
+    "exponential_decay": _exponential_decay,
+    "linear_decay": _linear_decay,
+    "lower_bound": _lower_bound,
+    "upper_bound": _upper_bound,
+}
+
+
+def learning_rate(cfg: Config, step: jnp.ndarray) -> jnp.ndarray:
+    """Scheduled LR as a scalar f32 traced from the (f32-cast) global step."""
+    lr = jnp.float32(cfg.learning_rate)
+    stepf = step.astype(jnp.float32)
+    for name, conf in cfg.learning_rate_config.items():
+        if name not in MODULES:
+            raise ValueError(f"unknown LR schedule module {name!r}")
+        lr = MODULES[name](lr, stepf, conf)
+    return lr

@@ -1,0 +1,255 @@
+"""Per-variable optimizer transforms behind the optimizer string-DSL.
+
+Matches the numerics of the reference DSL entries
+(/root/reference/src/optimizer/optimizers.py): ``adam``, ``novograd``, ``sm3``,
+``adaptive_clip`` (AGC), ``l2norm_clip``, ``global_l2norm_clip``,
+``value_clip``, ``gradient_centralisation``, ``weight_centralisation``,
+``learning_rate``, ``momentum`` (incl. nesterov) and ``graft``.  The reference
+threads a mutable OptimizerCtx through mtf assign ops; here each transform is a
+pure function ``(ctx, slots, *args) -> (new_grad, new_slots)`` over jnp arrays,
+chained functionally — the whole update compiles into the train step.
+
+Slot layout is declared separately (``slot_shapes``) so the optimizer state
+pytree can be initialized (and sharded) ahead of time.
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+import jax.numpy as jnp
+
+Slots = typing.Dict[str, jnp.ndarray]
+
+
+@dataclasses.dataclass
+class VarCtx:
+    """Per-variable context: gradient being transformed plus hyperparams."""
+    grad: jnp.ndarray          # in optimizer_calculation_dtype
+    value: jnp.ndarray         # current weight, optimizer_calculation_dtype
+    lr: jnp.ndarray            # scheduled learning rate (scalar)
+    beta1: float
+    beta2: float
+    step_count: jnp.ndarray    # 1-indexed update count, for debiasing
+    global_norm_reciprocal: typing.Optional[jnp.ndarray]  # set by the driver
+
+
+def _opt_rsqrt(x: jnp.ndarray) -> jnp.ndarray:
+    # reciprocal(max(sqrt(x), 1e-5)) — reference optimizers.py:14-15
+    return jnp.reciprocal(jnp.maximum(jnp.sqrt(x), 1e-5))
+
+
+def _debias_factor(beta: float, step_count: jnp.ndarray) -> jnp.ndarray:
+    return jnp.reciprocal(1.0 - jnp.power(jnp.float32(beta), step_count))
+
+
+def _sumsq(x: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(jnp.square(x))
+
+
+# -- stateful optimizers -----------------------------------------------------
+
+def adam_slots(shape: typing.Sequence[int]) -> typing.Dict[str, tuple]:
+    return {"exp_avg_p1": tuple(shape), "exp_avg_p2": tuple(shape)}
+
+
+def adam(ctx: VarCtx, slots: Slots) -> typing.Tuple[jnp.ndarray, Slots]:
+    p2 = slots["exp_avg_p2"] * ctx.beta2 + jnp.square(ctx.grad) * (1 - ctx.beta2)
+    p1 = slots["exp_avg_p1"] * ctx.beta1 + ctx.grad * (1 - ctx.beta1)
+    out = (_opt_rsqrt(p2 * _debias_factor(ctx.beta2, ctx.step_count)) * p1
+           * _debias_factor(ctx.beta1, ctx.step_count))
+    return out, {"exp_avg_p1": p1, "exp_avg_p2": p2}
+
+
+def novograd_slots(shape: typing.Sequence[int]) -> typing.Dict[str, tuple]:
+    if len(shape) == 0:
+        return adam_slots(shape)
+    return {"exp_avg_p1": tuple(shape), "exp_avg_p2": ()}
+
+
+def novograd(ctx: VarCtx, slots: Slots) -> typing.Tuple[jnp.ndarray, Slots]:
+    if ctx.grad.ndim == 0:  # scalars fall back to adam (reference :46-47)
+        return adam(ctx, slots)
+    p2_old = slots["exp_avg_p2"]
+    # p1 uses the *previous* second moment; p2 then updates; the returned
+    # update debiases the *new* p2 (reference optimizers.py:49-57).
+    p1 = ctx.beta1 * slots["exp_avg_p1"] + ctx.grad * _opt_rsqrt(p2_old)
+    p2 = p2_old * ctx.beta2 + _sumsq(ctx.grad) * (1 - ctx.beta2)
+    out = ctx.beta1 * p1 + ctx.grad * _opt_rsqrt(
+        p2 * _debias_factor(ctx.beta2, ctx.step_count))
+    return out, {"exp_avg_p1": p1, "exp_avg_p2": p2}
+
+
+def sm3_slots(shape: typing.Sequence[int]) -> typing.Dict[str, tuple]:
+    if len(shape) == 0:
+        return adam_slots(shape)
+    return {f"dim{i}": (s,) for i, s in enumerate(shape)}
+
+
+def sm3(ctx: VarCtx, slots: Slots) -> typing.Tuple[jnp.ndarray, Slots]:
+    """SM3: rank-1 factored second moment — per-axis max buffers whose
+    broadcast min approximates the full accumulator (reference :60-76)."""
+    if ctx.grad.ndim == 0:
+        return adam(ctx, slots)
+    ndim = ctx.grad.ndim
+
+    def _expand(buf: jnp.ndarray, axis: int) -> jnp.ndarray:
+        shape = [1] * ndim
+        shape[axis] = buf.shape[0]
+        return buf.reshape(shape)
+
+    acc = _expand(slots["dim0"], 0)
+    for i in range(1, ndim):
+        acc = jnp.minimum(acc, _expand(slots[f"dim{i}"], i))
+    acc = acc + jnp.square(ctx.grad)
+    new_slots = {
+        f"dim{i}": jnp.max(acc, axis=tuple(a for a in range(ndim) if a != i))
+        for i in range(ndim)}
+    return ctx.grad * _opt_rsqrt(acc), new_slots
+
+
+def momentum_slots(shape: typing.Sequence[int]) -> typing.Dict[str, tuple]:
+    return {"momentum": tuple(shape)}
+
+
+def momentum(ctx: VarCtx, slots: Slots, momentum_multiplier: str = "0.9",
+             gradient_multiplier: str = "1", nesterov: str = "0"
+             ) -> typing.Tuple[jnp.ndarray, Slots]:
+    mul = float(momentum_multiplier)
+    gmul = float(gradient_multiplier)
+    state = mul * slots["momentum"] + ctx.grad * gmul
+    out = ctx.grad + mul * state if bool(int(nesterov)) else state
+    return out, {"momentum": state}
+
+
+# -- stateless transforms ----------------------------------------------------
+
+def adaptive_clip(ctx: VarCtx, slots: Slots, clip: str
+                  ) -> typing.Tuple[jnp.ndarray, Slots]:
+    """AGC: scale the gradient so ||g|| <= clip * ||w|| (reference :79-84)."""
+    c = float(clip)
+    grd_norm_recip = jnp.minimum(jnp.reciprocal(jnp.sqrt(_sumsq(ctx.grad))), 1e6)
+    wgt_norm = jnp.maximum(jnp.sqrt(_sumsq(ctx.value)), 1e-3)
+    return ctx.grad * jnp.minimum(wgt_norm * grd_norm_recip * c, 1.0), slots
+
+
+def l2norm_clip(ctx: VarCtx, slots: Slots, clip: str
+                ) -> typing.Tuple[jnp.ndarray, Slots]:
+    c = float(clip)
+    scale = c * jnp.reciprocal(jnp.sqrt(jnp.maximum(_sumsq(ctx.grad), c ** -2)))
+    return ctx.grad * scale, slots
+
+
+def global_l2norm_clip(ctx: VarCtx, slots: Slots, clip: str
+                       ) -> typing.Tuple[jnp.ndarray, Slots]:
+    c = float(clip)
+    assert ctx.global_norm_reciprocal is not None
+    return ctx.grad * (c * ctx.global_norm_reciprocal), slots
+
+
+def value_clip(ctx: VarCtx, slots: Slots, clip: str
+               ) -> typing.Tuple[jnp.ndarray, Slots]:
+    c = float(clip)
+    return jnp.clip(ctx.grad, -c, c), slots
+
+
+def gradient_centralisation(ctx: VarCtx, slots: Slots
+                            ) -> typing.Tuple[jnp.ndarray, Slots]:
+    return ctx.grad - jnp.mean(ctx.grad), slots
+
+
+def weight_centralisation(ctx: VarCtx, slots: Slots
+                          ) -> typing.Tuple[jnp.ndarray, Slots]:
+    return ctx.grad + jnp.mean(ctx.value), slots
+
+
+def multiply_learning_rate(ctx: VarCtx, slots: Slots
+                           ) -> typing.Tuple[jnp.ndarray, Slots]:
+    return ctx.grad * ctx.lr.astype(ctx.grad.dtype), slots
+
+
+TRANSFORMS: typing.Dict[str, typing.Callable] = {
+    "adam": adam,
+    "novograd": novograd,
+    "sm3": sm3,
+    "momentum": momentum,
+    "adaptive_clip": adaptive_clip,
+    "l2norm_clip": l2norm_clip,
+    "global_l2norm_clip": global_l2norm_clip,
+    "value_clip": value_clip,
+    "gradient_centralisation": gradient_centralisation,
+    "weight_centralisation": weight_centralisation,
+    "learning_rate": multiply_learning_rate,
+}
+
+SLOT_FNS: typing.Dict[str, typing.Callable] = {
+    "adam": adam_slots,
+    "novograd": novograd_slots,
+    "sm3": sm3_slots,
+    "momentum": momentum_slots,
+}
+
+
+def graft(ctx: VarCtx, slots: Slots, inner: str, *args: str
+          ) -> typing.Tuple[jnp.ndarray, Slots]:
+    """Norm-graft: direction of the incoming gradient, magnitude of the inner
+    optimizer's step (reference optimizers.py:145-151)."""
+    inner_out, new_slots = TRANSFORMS[inner](ctx, slots, *args)
+    scale = (jnp.reciprocal(jnp.sqrt(_sumsq(ctx.grad)))
+             * jnp.sqrt(_sumsq(inner_out)))
+    return ctx.grad * scale, new_slots
+
+
+def graft_slots(shape: typing.Sequence[int], inner: str, *args: str
+                ) -> typing.Dict[str, tuple]:
+    return SLOT_FNS.get(inner, lambda s: {})(shape)
+
+
+TRANSFORMS["graft"] = graft
+
+
+def parse_chain(spec: str) -> typing.List[typing.Tuple[str, typing.Tuple[str, ...]]]:
+    """``"adaptive_clip:0.003-sm3-momentum:0.9:1:1-learning_rate"`` ->
+    [(name, args), ...] (reference __init__.py:42-44)."""
+    out = []
+    for part in spec.split("-"):
+        name, *args = part.split(":")
+        if name not in TRANSFORMS:
+            raise ValueError(f"unknown optimizer DSL entry {name!r}; "
+                             f"known: {sorted(TRANSFORMS)}")
+        out.append((name, tuple(args)))
+    return out
+
+
+def chain_slot_shapes(spec: str, shape: typing.Sequence[int]
+                      ) -> typing.Dict[str, tuple]:
+    """Slot name -> shape for one variable under the full DSL chain.  Slot
+    names are prefixed by chain position so repeated entries don't collide."""
+    shapes: typing.Dict[str, tuple] = {}
+    for i, (name, args) in enumerate(parse_chain(spec)):
+        if name == "graft":
+            sub = graft_slots(shape, *args)
+        elif name in SLOT_FNS:
+            sub = SLOT_FNS[name](shape)
+        else:
+            continue
+        for k, v in sub.items():
+            shapes[f"{i}/{name}/{k}"] = v
+    return shapes
+
+
+def apply_chain(spec: str, ctx: VarCtx, slots: Slots
+                ) -> typing.Tuple[jnp.ndarray, Slots]:
+    """Run the DSL chain over one variable's gradient."""
+    new_slots: Slots = {}
+    for i, (name, args) in enumerate(parse_chain(spec)):
+        prefix = f"{i}/{name}/"
+        sub = {k[len(prefix):]: v for k, v in slots.items()
+               if k.startswith(prefix)}
+        ctx.grad, sub = TRANSFORMS[name](ctx, sub, *args)
+        for k, v in sub.items():
+            new_slots[prefix + k] = v
+    for k, v in slots.items():
+        if k not in new_slots:
+            new_slots[k] = v
+    return ctx.grad, new_slots
