@@ -1,0 +1,87 @@
+"""Benchmark: tokens/sec/chip on the 32big_mixer architecture (BASELINE.md).
+
+Runs the flagship mixer LM (full 32big_mixer DSL/optimizer/dtype config,
+batch shrunk to fit one chip) for a timed window of train steps on whatever
+accelerator JAX selects, and prints ONE JSON line:
+
+    {"metric": "tokens_per_sec_per_chip", "value": N, "unit": "tok/s/chip",
+     "vs_baseline": R}
+
+The MTF reference publishes no numbers (see BASELINE.md), so ``vs_baseline``
+is computed against the first value this repo ever recorded
+(bench_baseline.json, written on first run) — i.e. round-over-round speedup.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bench_baseline.json")
+
+
+def main() -> None:
+    from homebrewnlp_tpu.config import Config
+    from homebrewnlp_tpu.train import Trainer
+    from homebrewnlp_tpu.nd import NT
+
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "configs/32big_mixer.json")) as f:
+        raw = json.load(f)
+    # full 32big_mixer architecture (d_model 4096, depth 32x2 blocks, seq 512,
+    # bf16, revnet, AGC+SM3+momentum); batch shrunk from the pod-scale 1024 to
+    # fit a single chip — tokens/sec/chip is per-chip throughput either way.
+    raw.update(dict(train_batch_size=8, use_checkpointing=False,
+                    calc_accuracy=False, tpu_size=1))
+    cfg = Config(raw)
+
+    trainer = Trainer(cfg)
+    shape = (cfg.train_batch_size, cfg.sequence_length, cfg.token_patch_size)
+    names = ("batch", "sequence", "language_token_patch")
+    kx, ky = jax.random.split(jax.random.key(0))
+    batch = {
+        "token_x": NT(jax.random.randint(kx, shape, 0, cfg.vocab_size), names),
+        "token_y": NT(jax.random.randint(ky, shape, 0, cfg.vocab_size), names),
+    }
+
+    state = trainer.init(batch)
+    rng = jax.random.key(1)
+
+    # warmup/compile
+    state, metrics = trainer.step(state, batch, rng)
+    jax.block_until_ready(metrics["loss"])
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        state, metrics = trainer.step(state, batch, jax.random.fold_in(rng, i))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = cfg.train_batch_size * cfg.sequence_length * n_steps
+    n_chips = max(1, len(jax.devices()))
+    value = tokens / dt / n_chips
+
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            baseline = json.load(f)["value"]
+    else:
+        baseline = value
+        with open(BASELINE_FILE, "w") as f:
+            json.dump({"value": value, "recorded": time.time(),
+                       "device": str(jax.devices()[0])}, f)
+
+    print(json.dumps({
+        "metric": "tokens_per_sec_per_chip",
+        "value": round(value, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(value / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -231,6 +231,10 @@ class Config:
             if isinstance(v, str):
                 setattr(self, attr, DTYPES[v])
 
+        if self.model_mode == "gpt":
+            # text-only path: language on, video off (reference src/main.py:85-92)
+            self.use_video = False
+            self.use_language = True
         self.multi_loss_strategy = self.multi_loss_strategy.lower()
         if self.multi_loss_strategy not in ("linear", "pcgrad", "mgda"):
             print(f"unknown multi_loss_strategy {self.multi_loss_strategy}; using linear")

@@ -1,0 +1,14 @@
+"""Parallelism layer: device mesh + named-axis sharding rules.
+
+The reference's entire parallelism config is two integers — ``tpu_size`` and
+``heads`` — synthesized into ``mesh_shape="b:N,h:H"`` / ``layout="batch:b,
+heads:h"`` and materialized by Mesh-TensorFlow's SimdMeshImpl
+(/root/reference/src/dataclass.py:247-252, src/main.py:144-147).  Here the
+same two integers build a `jax.sharding.Mesh` and the layout becomes a
+logical-axis -> mesh-axis rule table; GSPMD inserts the collectives the MTF
+lowering used to emit.  Extensions the reference lacks: a sequence-parallel
+axis (ring attention) and a pipeline axis knob.
+"""
+from .mesh import make_mesh  # noqa: F401
+from .sharding import (constraint, nt_spec, param_shardings, spec_for,  # noqa: F401
+                       tree_shardings)

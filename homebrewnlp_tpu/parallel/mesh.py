@@ -1,0 +1,57 @@
+"""Device-mesh construction from the config's parallelism knobs.
+
+Axis order is (data, sequence, pipeline, model): model innermost so tensor-
+parallel collectives ride the fastest ICI links, data outermost so gradient
+all-reduce tolerates DCN hops on multi-host — the same intent as the
+reference's ``mesh_shape="b:N,h:H"`` ordering (dataclass.py:247-252) where the
+head axis maps to the minor mesh dimension.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config import Config
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "sequence_parallel"
+PIPE_AXIS = "pipeline"
+
+
+def axis_sizes(cfg: Config, n_devices: int) -> typing.Dict[str, int]:
+    """Resolve mesh axis sizes for ``n_devices``.  ``heads`` bounds the model
+    axis; remaining devices fold into data parallelism (reference behavior:
+    b = tpu_size / heads)."""
+    model = cfg.mesh_model
+    seq = cfg.sequence_parallel
+    pipe = cfg.pipeline_parallel
+    denom = model * seq * pipe
+    if n_devices % denom:
+        # shrink the model axis to the largest divisor that fits
+        model = 1
+        for cand in range(min(cfg.mesh_model, n_devices), 0, -1):
+            if n_devices % (cand * seq * pipe) == 0:
+                model = cand
+                break
+        denom = model * seq * pipe
+        if n_devices % denom:
+            raise ValueError(
+                f"cannot factor {n_devices} devices into seq={seq} pipe={pipe}")
+        print(f"WARNING: model axis shrunk from {cfg.mesh_model} to {model} "
+              f"to factor {n_devices} devices (seq={seq}, pipe={pipe})")
+    return {DATA_AXIS: n_devices // denom, SEQ_AXIS: seq,
+            PIPE_AXIS: pipe, MODEL_AXIS: model}
+
+
+def make_mesh(cfg: Config,
+              devices: typing.Optional[typing.Sequence[jax.Device]] = None
+              ) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = axis_sizes(cfg, len(devices))
+    names = (DATA_AXIS, SEQ_AXIS, PIPE_AXIS, MODEL_AXIS)
+    grid = np.asarray(devices).reshape([sizes[n] for n in names])
+    return Mesh(grid, names)

@@ -1,0 +1,75 @@
+"""Logical-axis -> mesh-axis sharding rules.
+
+The reference's ``layout="batch:b,heads:h"`` (dataclass.py:249-252) becomes a
+rule table over the named axes that :mod:`homebrewnlp_tpu.nd` tensors and the
+parameter metadata already carry.  Anonymized axes (leading ``_``) are
+replicated — the exact JAX meaning of the reference's anonymize protocol
+(utils_mtf.py:207-232): a ``_``-named twin of an axis is the all-gathered
+copy.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import ANON_PREFIX, BATCH, HEADS, SEQUENCE
+from ..nd import NT
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+# logical axis -> mesh axis.  Everything else is replicated, matching the
+# reference layout which splits only batch and heads (SURVEY.md §2.12).
+RULES: typing.Dict[str, str] = {
+    BATCH: DATA_AXIS,
+    HEADS: MODEL_AXIS,
+    SEQUENCE: SEQ_AXIS,
+}
+
+
+def spec_for(names: typing.Sequence[str], mesh: Mesh,
+             rules: typing.Optional[typing.Dict[str, str]] = None
+             ) -> PartitionSpec:
+    """PartitionSpec for a tuple of logical axis names.  Mesh axes of size 1
+    are omitted (XLA treats them as replicated anyway, and omitting keeps
+    specs valid on smaller meshes)."""
+    rules = RULES if rules is None else rules
+    parts = []
+    for n in names:
+        mesh_axis = None if n.startswith(ANON_PREFIX) else rules.get(n)
+        if mesh_axis is not None and mesh.shape.get(mesh_axis, 1) > 1:
+            parts.append(mesh_axis)
+        else:
+            parts.append(None)
+    while parts and parts[-1] is None:
+        parts.pop()
+    return PartitionSpec(*parts)
+
+
+def nt_spec(t: NT, mesh: Mesh) -> PartitionSpec:
+    return spec_for(t.names, mesh)
+
+
+def constraint(t: NT, mesh: Mesh) -> NT:
+    """Apply a sharding constraint to an NT inside jit (the replacement for
+    the reference's anonymize/unanonymize resharding reshapes)."""
+    sharding = NamedSharding(mesh, nt_spec(t, mesh))
+    return NT(jax.lax.with_sharding_constraint(t.x, sharding), t.names)
+
+
+def param_shardings(axes: typing.Dict[str, typing.Tuple[str, ...]], mesh: Mesh
+                    ) -> typing.Dict[str, NamedSharding]:
+    """NamedShardings for a flat param dict from its axis-name metadata.
+    Head-sharded parameters land split over the model axis; everything else
+    is replicated — mirroring MTF's variable placement under the reference
+    layout."""
+    return {name: NamedSharding(mesh, spec_for(n, mesh))
+            for name, n in axes.items()}
+
+
+def tree_shardings(axes_tree, mesh: Mesh):
+    """Shardings for an arbitrary pytree of axis-name tuples (used for
+    optimizer slot states)."""
+    return jax.tree_util.tree_map(
+        lambda names: NamedSharding(mesh, spec_for(names, mesh)),
+        axes_tree, is_leaf=lambda x: isinstance(x, tuple))

@@ -1,0 +1,10 @@
+"""Run/orchestration layer: mesh-sharded train step, checkpointing, metrics.
+
+The JAX re-design of the reference's run layer (/root/reference/src/run/):
+graph build + lowering + session loop collapse into one jitted step function
+(state.py); TF Saver checkpoints become orbax (checkpoint.py);
+outside-compilation summaries become ordinary step outputs (metrics.py).
+"""
+from .state import Trainer, TrainState  # noqa: F401
+from .checkpoint import Checkpointer, current_step  # noqa: F401
+from .metrics import MetricWriter, color_print  # noqa: F401

@@ -1,0 +1,88 @@
+"""Sharded checkpointing + deterministic resume metadata.
+
+The reference uses TF1 ``Saver(sharded=True)`` + hooks copying mesh-sharded
+slices (/root/reference/src/run/run.py:158-176) and recovers ``current_step``
+by parsing the checkpoint dir (src/main.py:71); the data stream resumes via a
+separate run-log replay (src/inputs.py:33-128).  Here: orbax sharded
+checkpoints for {params, opt_state, step}, and the data-pipeline state rides
+along as JSON next to the checkpoint — same separation of concerns, without
+the replay arithmetic fragility (the reader checkpoints its cursor
+directly; see data/resume.py which also keeps the replay option).
+"""
+from __future__ import annotations
+
+import json
+import os
+import typing
+
+import jax
+import jax.numpy as jnp
+import orbax.checkpoint as ocp
+
+from .state import TrainState
+
+
+class Checkpointer:
+    def __init__(self, path: str, max_to_keep: int = 1):
+        self.path = os.path.abspath(os.path.expanduser(path))
+        os.makedirs(self.path, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.path,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    # -- save ----------------------------------------------------------------
+    def save(self, state: TrainState,
+             data_state: typing.Optional[dict] = None) -> None:
+        step = int(state.step)
+        tree = {"params": state.params, "opt_state": state.opt_state,
+                "step": state.step}
+        self.manager.save(step, args=ocp.args.StandardSave(tree))
+        if data_state is not None:
+            with open(os.path.join(self.path, f"data_state_{step}.json"), "w") as f:
+                json.dump(data_state, f)
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    # -- restore -------------------------------------------------------------
+    def latest_step(self) -> typing.Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, template: TrainState
+                ) -> typing.Tuple[TrainState, typing.Optional[dict]]:
+        """Restore the latest checkpoint onto the template's shardings."""
+        step = self.latest_step()
+        if step is None:
+            return template, None
+        tree = {"params": template.params, "opt_state": template.opt_state,
+                "step": template.step}
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            tree)
+        restored = self.manager.restore(
+            step, args=ocp.args.StandardRestore(abstract))
+        data_state = None
+        data_path = os.path.join(self.path, f"data_state_{step}.json")
+        if os.path.exists(data_path):
+            with open(data_path) as f:
+                data_state = json.load(f)
+        return TrainState(restored["params"], restored["opt_state"],
+                          restored["step"]), data_state
+
+
+def current_step(model_path: str) -> int:
+    """Recover the global step from a checkpoint dir at startup (the
+    reference reads TF estimator internals, src/main.py:71)."""
+    path = os.path.abspath(model_path)
+    if not os.path.isdir(path):
+        return 0
+    try:
+        step = ocp.CheckpointManager(path).latest_step()
+        return 0 if step is None else int(step)
+    except Exception as e:  # pragma: no cover - corrupt metadata etc.
+        # surface the problem rather than silently restarting: with
+        # max_to_keep=1 a fresh run can overwrite the real checkpoint
+        print(f"WARNING: failed to read checkpoint state from {path}: {e!r}; "
+              "assuming step 0")
+        return 0

@@ -1,0 +1,67 @@
+"""Metrics logging: colored stdout + JSONL scalars (+ optional TensorBoard).
+
+The reference emits TensorBoard scalars from inside the TPU program via
+``tpu.outside_compilation`` host calls flushed every step
+(/root/reference/src/run/utils_run.py:32-58, run.py:123-153) and prints
+timestamped ANSI-colored phase logs (src/utils_core.py:43-48).  In JAX the
+metrics come back as ordinary step outputs, so logging is plain host code; a
+TensorBoard event writer is used when the `tensorboardX`/`tf` stack exists,
+else JSONL only (works everywhere, greppable, and what bench.py parses).
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import time
+import typing
+
+import numpy as np
+
+
+def color_print(*args, color: str = "\x1b[32;1m") -> None:
+    now = datetime.datetime.now().strftime("%H:%M:%S.%f")[:-3]
+    print(f"{color}[{now}]\x1b[0m", *args, flush=True)
+
+
+class MetricWriter:
+    def __init__(self, model_path: str, flush_every: int = 1):
+        self.path = model_path
+        os.makedirs(model_path, exist_ok=True)
+        self._f = open(os.path.join(model_path, "metrics.jsonl"), "a")
+        self.flush_every = flush_every
+        self._n = 0
+        self._t0 = time.time()
+        self._last_step_time = self._t0
+        self._tb = None
+        try:  # optional TensorBoard backend
+            from torch.utils.tensorboard import SummaryWriter  # noqa
+            self._tb = SummaryWriter(os.path.join(model_path, "tb"))
+        except Exception:
+            pass
+
+    def write(self, step: int, metrics: typing.Dict[str, typing.Any]) -> None:
+        now = time.time()
+        scalars = {}
+        for k, v in metrics.items():
+            try:
+                scalars[k] = float(np.asarray(v))
+            except Exception:
+                continue
+        scalars["step"] = int(step)
+        scalars["wall_time"] = now
+        scalars["step_seconds"] = now - self._last_step_time
+        self._last_step_time = now
+        self._f.write(json.dumps(scalars) + "\n")
+        self._n += 1
+        if self._n % self.flush_every == 0:
+            self._f.flush()
+        if self._tb is not None:
+            for k, v in scalars.items():
+                if k not in ("step", "wall_time"):
+                    self._tb.add_scalar(k, v, step)
+
+    def close(self) -> None:
+        self._f.close()
+        if self._tb is not None:
+            self._tb.close()

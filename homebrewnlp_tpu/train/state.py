@@ -1,0 +1,178 @@
+"""Train state + the pjit-compiled train step.
+
+Replaces the reference's run layer graph assembly (/root/reference/src/run/
+run.py:36-198) and macro-batching wrapper (src/run/train.py:19-77): what MTF
+did with per-micro-batch graph rebuilds, cached variables and fused assign
+ops is here one jitted function — gradient accumulation is a ``lax.scan``
+over micro-batches, the optimizer update is traced inline, and GSPMD shards
+everything according to parallel/sharding.py rules.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import Config
+from ..models import build, init_params
+from ..models.ctx import Ctx
+from ..nd import NT
+from ..optim import Optimizer
+from ..parallel import make_mesh, param_shardings, spec_for
+from ..parallel.sharding import constraint
+
+
+class TrainState(typing.NamedTuple):
+    params: typing.Dict[str, jnp.ndarray]
+    opt_state: typing.Dict[str, typing.Dict[str, jnp.ndarray]]
+    step: jnp.ndarray  # int32 global update counter
+
+
+class Trainer:
+    """Owns mesh, optimizer, and the compiled train step."""
+
+    def __init__(self, cfg: Config, mesh: typing.Optional[Mesh] = None):
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(cfg)
+        self.axes: typing.Dict[str, typing.Tuple[str, ...]] = {}
+        self.optimizer: typing.Optional[Optimizer] = None
+        self._step_fn = None
+
+    # -- initialization ------------------------------------------------------
+    def init(self, batch: typing.Dict[str, NT], seed: int = 0) -> TrainState:
+        """Initialize params on the mesh (sharded per axis rules) and zeroed
+        optimizer state."""
+        micro = self._micro_batch(batch)
+        params, axes = init_params(self.cfg, micro, seed=seed)
+        self.axes = axes
+        self.optimizer = Optimizer(self.cfg, axes)
+        shardings = param_shardings(axes, self.mesh)
+        params = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+        opt_state = self.optimizer.init(params)
+        slot_axes = self.optimizer.slot_axis_names()
+        opt_state = {
+            name: {k: jax.device_put(
+                v, NamedSharding(self.mesh, spec_for(slot_axes[name][k], self.mesh)))
+                for k, v in slots.items()}
+            for name, slots in opt_state.items()}
+        step = jax.device_put(
+            jnp.zeros((), jnp.int32),
+            NamedSharding(self.mesh, PartitionSpec()))
+        return TrainState(params, opt_state, step)
+
+    def _micro_batch(self, batch: typing.Dict[str, NT]) -> typing.Dict[str, NT]:
+        """First micro-batch view of a (possibly accumulated) batch."""
+        accum = self.cfg.grad_accumulation
+        if accum <= 1:
+            return batch
+        out = {}
+        for k, t in batch.items():
+            assert t.x.shape[0] % accum == 0, (
+                f"batch axis {t.x.shape[0]} of {k!r} not divisible by "
+                f"grad_accumulation={accum}")
+            out[k] = NT(t.x[:t.x.shape[0] // accum], t.names)
+        return out
+
+    # -- loss / gradients ----------------------------------------------------
+    def _losses(self, params, batch, rng):
+        ctx = Ctx(self.cfg, params=params, train=True, rng=rng)
+        out = build(ctx, batch)
+        return out
+
+    def _grads(self, params, batch, rng):
+        cfg = self.cfg
+        if cfg.multi_loss_strategy == "linear":
+            def total(p):
+                o = self._losses(p, batch, rng)
+                return o.loss, o
+            (loss, out), grads = jax.value_and_grad(total, has_aux=True)(params)
+            return grads, out
+        # per-loss gradients for pcgrad/mgda (reference gradients.py:65-66):
+        # one forward (vjp) + one backward per loss via one-hot cotangents
+        def losses_only(p):
+            o = self._losses(p, batch, rng)
+            return o.loss_list, o
+        loss_list, vjp_fn, out = jax.vjp(losses_only, params, has_aux=True)
+        n = len(loss_list)
+        grads_per_loss = [
+            vjp_fn(tuple(jnp.float32(1.0) if j == i else jnp.zeros_like(l)
+                         for j, l in enumerate(loss_list)))[0]
+            for i in range(n)]
+        return self.optimizer.combine_losses(grads_per_loss), out
+
+    # -- the step ------------------------------------------------------------
+    def _make_step(self):
+        cfg = self.cfg
+        mesh = self.mesh
+        accum = cfg.grad_accumulation
+        opt = self.optimizer
+
+        def step_fn(state: TrainState, batch: typing.Dict[str, NT],
+                    rng: jax.Array):
+            batch = {k: constraint(t, mesh) for k, t in batch.items()}
+            if accum <= 1:
+                grads, out = self._grads(state.params, batch, rng)
+            else:
+                # scan over micro-batches, averaging gradients — the JAX form
+                # of the reference's graph-stitched macro-batching
+                # (src/run/train.py:19-77).
+                def micro(i, t):
+                    assert t.x.shape[0] % accum == 0, (
+                        f"batch axis {t.x.shape[0]} not divisible by "
+                        f"grad_accumulation={accum}")
+                    bsz = t.x.shape[0] // accum
+                    return NT(jax.lax.dynamic_slice_in_dim(t.x, i * bsz, bsz, 0),
+                              t.names)
+
+                def body(carry, i):
+                    mb = {k: micro(i, t) for k, t in batch.items()}
+                    g, o = self._grads(state.params,
+                                       mb, jax.random.fold_in(rng, i))
+                    acc = jax.tree_util.tree_map(jnp.add, carry, g)
+                    return acc, o.loss
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                grads, losses = jax.lax.scan(body, zeros, jnp.arange(accum))
+                grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+                out = None
+                mean_loss = jnp.mean(losses)
+            new_params, new_opt, lr = opt.update(
+                state.params, grads, state.opt_state, state.step)
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                 for g in grads.values()))
+            metrics = {
+                "loss": out.loss if out is not None else mean_loss,
+                "learning_rate": lr,
+                "grad_norm": gnorm,
+                "step": state.step,
+            }
+            if out is not None:
+                if out.token_loss is not None:
+                    metrics["token_loss"] = out.token_loss
+                if out.video_loss is not None:
+                    metrics["video_loss"] = out.video_loss
+                if out.accuracy is not None:
+                    metrics["accuracy"] = out.accuracy
+            new_state = TrainState(new_params, new_opt, state.step + 1)
+            return new_state, metrics
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def step(self, state: TrainState, batch: typing.Dict[str, NT],
+             rng: jax.Array):
+        if self._step_fn is None:
+            self._step_fn = self._make_step()
+        with self.mesh:
+            return self._step_fn(state, batch, rng)
+
+    # -- reporting -----------------------------------------------------------
+    def param_census(self, params: typing.Dict[str, jnp.ndarray]
+                     ) -> typing.Dict[str, typing.Any]:
+        """Parameter-count report (the reference's ``analyze_model``,
+        src/run/utils_run.py:65-113) — sorted largest-first with a total."""
+        rows = sorted(((k, int(v.size)) for k, v in params.items()),
+                      key=lambda kv: -kv[1])
+        return {"total": sum(s for _, s in rows), "by_variable": dict(rows)}

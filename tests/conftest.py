@@ -4,7 +4,10 @@ single-device PlacementMeshImpl on CPU — see SURVEY.md §4; this is strictly
 stronger)."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient env selects a TPU platform (e.g.
+# JAX_PLATFORMS=axon registered by a sitecustomize PJRT plugin, which wins
+# over the env var): the suite needs the 8-device virtual mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -12,6 +15,8 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import pytest  # noqa: E402
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(scope="session")
