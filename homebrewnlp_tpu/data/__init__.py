@@ -1,0 +1,15 @@
+"""Input pipeline layer: TFRecord IO, windowed text/video pipelines,
+mixtures, host-sharded device feeding, deterministic resume.
+
+JAX re-design of the reference's tf.data stack (/root/reference/src/inputs.py,
+src/run/dataloader_placement.py) — see pipeline.py for the parity map.
+"""
+from .feed import to_global  # noqa: F401
+from .pipeline import (GptPipeline, JannetTextPipeline, MixturePipeline,  # noqa: F401
+                       dataset, split_files)
+from .resume import RunLog, skips_for_restart  # noqa: F401
+from .synthetic import (synthetic_text_batch, write_text_tfrecords,  # noqa: F401
+                        write_video_tfrecords)
+from .tfrecord import (RecordWriter, count_records, decode_example,  # noqa: F401
+                       encode_example, read_records)
+from .video import VideoPipeline  # noqa: F401

@@ -1,0 +1,70 @@
+"""Host -> device feeding: numpy batches to globally-sharded jax Arrays.
+
+Replaces the reference's TPU InfeedQueue + per-host dataloader placement
+(/root/reference/src/run/dataloader_placement.py:17-231): each host runs its
+slice of the pipeline (``slice_index = jax.process_index()``) and
+``jax.make_array_from_callback`` assembles the global batch across the mesh —
+the data axis sharding means each device fetches only its batch rows, giving
+the same host-locality the reference's placement logic hand-computed.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ..config import Config
+from ..nd import NT
+from ..parallel.sharding import spec_for
+
+# input name -> logical axis names (the input_pipeline_shape of the reference,
+# dataclass.py:310-337)
+TEXT_AXES = ("batch", "sequence", "language_token_patch")
+INPUT_AXES: typing.Dict[str, typing.Tuple[str, ...]] = {
+    "token_x": TEXT_AXES,
+    "token_y": TEXT_AXES,
+    "txt_msk": TEXT_AXES,
+    "frame": ("batch", "_sequence", "height", "width", "color_channels"),
+    "vid_msk_src": ("batch", "sequence"),
+    "vid_msk_tgt": ("batch", "sequence"),
+    "cat_mask_x": ("batch", "sequence"),
+    "cat_mask_y": ("batch", "sequence"),
+}
+
+
+def axes_for(name: str, arr: np.ndarray, cfg: Config) -> typing.Tuple[str, ...]:
+    names = INPUT_AXES[name]
+    if name == "frame" and not cfg.three_axes:
+        names = ("batch", "_sequence", "height", "color_channels")
+    if name in ("token_x", "token_y", "txt_msk") and arr.ndim == 4:
+        # jannet token layout [batch, sequence, token_patch, patch_size]
+        names = ("batch", "sequence", "language_token_patch", "_token_patch")
+    return names[:arr.ndim]
+
+
+def to_global(batch: typing.Dict[str, np.ndarray], cfg: Config, mesh: Mesh
+              ) -> typing.Dict[str, NT]:
+    """Assemble the per-host numpy batch into global NT arrays on the mesh.
+
+    The batch passed in is this host's shard (local batch rows); global shape
+    is inferred as local * data-axis-span of this process's devices."""
+    out: typing.Dict[str, NT] = {}
+    n_procs = jax.process_count()
+    for name, arr in batch.items():
+        names = axes_for(name, arr, cfg)
+        sharding = NamedSharding(mesh, spec_for(names, mesh))
+        global_shape = (arr.shape[0] * n_procs,) + arr.shape[1:]
+
+        def cb(index, arr=arr, sharding=sharding):
+            # index is a global slice; translate to local row offsets
+            start = index[0].start or 0
+            stop = index[0].stop if index[0].stop is not None else global_shape[0]
+            local_start = start % arr.shape[0]
+            return arr[(slice(local_start, local_start + (stop - start)),)
+                       + index[1:]]
+
+        x = jax.make_array_from_callback(global_shape, sharding, cb)
+        out[name] = NT(x, names)
+    return out

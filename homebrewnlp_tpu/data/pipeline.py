@@ -1,0 +1,369 @@
+"""Input pipeline: windowed token/video streams from TFRecords, interleaved,
+batched, resumable.
+
+Re-designs the reference tf.data pipeline (/root/reference/src/inputs.py) as
+deterministic pure-Python iterators over numpy arrays — the right shape for
+JAX, where host code assembles global device arrays per step
+(data/feed.py) instead of TF infeed queues.  Parity map:
+
+- ``split_files``          <- inputs.py:15-30 (sorted + seeded shuffle + host slice)
+- ``_FileWindows``         <- ``_text_decoder`` window(size=ctx+patch, shift=ctx)
+                              per record (documents never cross records),
+                              inputs.py:231-251
+- ``GptPipeline``          <- ``gpt_neo_input`` (inputs.py:528-568): interleave
+                              cycle over files, batch, x/y split by output_offset
+- ``JannetTextPipeline``   <- ``dataset_text`` (inputs.py:271-367): padding
+                              frames/masks zipped with token windows
+- ``VideoPipeline``        <- ``dataset_video``+``get_video_decoder``
+                              (inputs.py:131-228,370-483): JPEG decode,
+                              patchify transpose, quantization, bit-fold,
+                              concat/skip masks
+- ``MixturePipeline``      <- weighted ``sample_from_datasets`` (inputs.py:486-525)
+
+Divergences (documented): byte records decode as raw bytes (vocab 256) rather
+than UTF-8 codepoints which can exceed the vocab; every pipeline exposes
+``state_dict``/``load_state_dict`` so resume checkpoints the cursor directly
+(the reference's run-log replay is kept as an alternative in data/resume.py).
+"""
+from __future__ import annotations
+
+import random
+import typing
+
+import numpy as np
+
+from ..config import Config
+from .tfrecord import decode_example, read_records
+
+
+def split_files(filenames: typing.Sequence[str], slice_index: int,
+                slice_count: int, seed: int,
+                runs_log: typing.Optional[typing.Sequence[dict]] = None
+                ) -> typing.Tuple[typing.List[str], typing.List[int]]:
+    """Sorted + seeded shuffle + optional run-log replay + per-host slice
+    (reference inputs.py:15-30): replay runs against the ordered full list,
+    drop depleted files, then slice files and skips together."""
+    if not filenames:
+        raise ValueError("no input files")
+    files = sorted(filenames)
+    if seed != 0:
+        rng = random.Random(seed)
+        rng.shuffle(files)
+    skips = [0] * len(files)
+    if runs_log:
+        from .resume import skips_for_restart
+        files, skips = skips_for_restart(files, runs_log)
+    return files[slice_index::slice_count], skips[slice_index::slice_count]
+
+
+def decode_bytes_record(payload: bytes) -> np.ndarray:
+    ex = decode_example(payload)
+    (raw,) = ex["text"]
+    return np.frombuffer(raw, dtype=np.uint8).astype(np.int32)
+
+
+def decode_int64_record(payload: bytes) -> np.ndarray:
+    ex = decode_example(payload)
+    return np.asarray(ex["text"], dtype=np.int32)
+
+
+def decoder_for(path: str) -> typing.Callable[[bytes], np.ndarray]:
+    # filename convention from the reference (inputs.py:541): int64 in the
+    # name marks BPE-encoded shards, else byte-level
+    return decode_int64_record if "int64" in path else decode_bytes_record
+
+
+class _FileWindows:
+    """Windows of ``window`` tokens, shift ``shift``, per record of one file.
+    ``skip_tokens`` drops leading tokens of the file's concatenated stream
+    (for run-log resume); ``skip_windows`` drops emitted windows (for direct
+    cursor resume)."""
+
+    def __init__(self, path: str, window: int, shift: int,
+                 skip_tokens: int = 0, skip_windows: int = 0):
+        self.path = path
+        self.window = window
+        self.shift = shift
+        self.skip_tokens = skip_tokens
+        self.emitted = 0
+        self._skip_windows = skip_windows
+
+    def __iter__(self) -> typing.Iterator[np.ndarray]:
+        decode = decoder_for(self.path)
+        remaining_skip = self.skip_tokens
+        for payload in read_records(self.path):
+            tokens = decode(payload)
+            if remaining_skip:
+                take = min(remaining_skip, len(tokens))
+                tokens = tokens[take:]
+                remaining_skip -= take
+                if not len(tokens):
+                    continue
+            for start in range(0, len(tokens) - self.window + 1, self.shift):
+                if self._skip_windows:
+                    self._skip_windows -= 1
+                    self.emitted += 1
+                    continue
+                self.emitted += 1
+                yield tokens[start:start + self.window]
+
+
+class _Interleave:
+    """Round-robin over up to ``cycle`` concurrently-open file window streams
+    (tf.data interleave, block_length=1).  Resumable: records per-file window
+    counts for the open slots plus the next file index."""
+
+    def __init__(self, files: typing.Sequence[str], skips: typing.Sequence[int],
+                 window: int, shift: int, cycle: int, repeat: bool):
+        self.files = list(files)
+        self.skips = list(skips)
+        self.window = window
+        self.shift = shift
+        self.cycle = max(1, cycle)
+        self.repeat = repeat
+        self.next_file = 0
+        self._pos = 0
+        self._slots: typing.List[typing.Tuple[int, _FileWindows, typing.Iterator]] = []
+
+    def _open(self, file_idx: int, skip_windows: int = 0
+              ) -> typing.Tuple[int, _FileWindows, typing.Iterator]:
+        src = _FileWindows(self.files[file_idx % len(self.files)],
+                           self.window, self.shift,
+                           skip_tokens=self.skips[file_idx % len(self.files)],
+                           skip_windows=skip_windows)
+        return file_idx, src, iter(src)
+
+    def _fill(self) -> None:
+        limit = len(self.files) if not self.repeat else float("inf")
+        while len(self._slots) < self.cycle and self.next_file < limit:
+            self._slots.append(self._open(self.next_file))
+            self.next_file += 1
+
+    def __iter__(self) -> typing.Iterator[np.ndarray]:
+        self._fill()
+        while self._slots:
+            self._pos %= len(self._slots)
+            _, src, it = self._slots[self._pos]
+            try:
+                item = next(it)
+                self._pos += 1
+                yield item
+            except StopIteration:
+                del self._slots[self._pos]
+                self._fill()
+
+    def state_dict(self) -> dict:
+        return {"next_file": self.next_file, "pos": self._pos,
+                "slots": [[idx, src.emitted] for idx, src, _ in self._slots]}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.next_file = state["next_file"]
+        self._pos = state.get("pos", 0)
+        self._slots = [self._open(idx, skip_windows=emitted)
+                       for idx, emitted in state["slots"]]
+
+
+class _ShuffleBuffer:
+    """Seeded reservoir shuffle (tf.data Dataset.shuffle semantics)."""
+
+    def __init__(self, inner: typing.Iterable, size: int, seed: int):
+        self.inner = inner
+        self.size = size
+        self.rng = np.random.default_rng(seed)
+
+    def __iter__(self):
+        if self.size <= 1:
+            yield from self.inner
+            return
+        buf: typing.List[np.ndarray] = []
+        for item in self.inner:
+            if len(buf) < self.size:
+                buf.append(item)
+                continue
+            idx = int(self.rng.integers(len(buf)))
+            buf[idx], item = item, buf[idx]
+            yield item
+        self.rng.shuffle(buf)  # drain
+        yield from buf
+
+
+class GptPipeline:
+    """Pure-text batches {token_x, token_y} of shape
+    [batch, seq // token_patch, token_patch] (reference inputs.py:528-568)."""
+
+    def __init__(self, cfg: Config, sub_batch_size: int, slice_index: int = 0,
+                 slice_count: int = 1,
+                 paths: typing.Optional[typing.Sequence[str]] = None,
+                 runs_log: typing.Optional[typing.Sequence[dict]] = None):
+        import glob as globlib
+        if paths is None:
+            paths = []
+            for dset in cfg.dataset_configs:
+                paths.extend(globlib.glob(dset["path"]))
+        self.cfg = cfg
+        self.batch = sub_batch_size
+        files, file_skips = split_files(
+            paths, slice_index, slice_count,
+            cfg.data_seed * int(cfg.shuffle_input_filenames), runs_log)
+        window = cfg.sequence_length + cfg.token_patch_size * cfg.output_offset
+        self.rows = cfg.sequence_length // cfg.token_patch_size
+        self.interleave = _Interleave(
+            files, file_skips, window, cfg.sequence_length,
+            cfg.interleaved_datasets, repeat=cfg.use_random_dataloader)
+        self.stream: typing.Iterable = self.interleave
+        if cfg.use_random_dataloader and cfg.shuffle_buffer > 1:
+            self.stream = _ShuffleBuffer(self.interleave, cfg.shuffle_buffer,
+                                         cfg.data_seed)
+
+    def __iter__(self) -> typing.Iterator[typing.Dict[str, np.ndarray]]:
+        cfg = self.cfg
+        patch = cfg.token_patch_size
+        buf: typing.List[np.ndarray] = []
+        for window in self.stream:
+            buf.append(window)
+            if len(buf) < self.batch:
+                continue
+            x = np.stack(buf)
+            buf.clear()
+            x = x.reshape(self.batch, self.rows + cfg.output_offset, patch)
+            if cfg.output_offset > 0:
+                token_x = x[:, :self.rows]
+                token_y = x[:, cfg.output_offset:self.rows + cfg.output_offset]
+            else:
+                token_x = token_y = x
+            yield {"token_x": np.ascontiguousarray(token_x),
+                   "token_y": np.ascontiguousarray(token_y)}
+
+    def state_dict(self) -> dict:
+        return self.interleave.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.interleave.load_state_dict(state)
+
+
+class JannetTextPipeline:
+    """Text windows dressed as video-model inputs: zero frames, padding
+    masks, concat-token text mask (reference dataset_text,
+    inputs.py:271-367)."""
+
+    def __init__(self, cfg: Config, sub_batch_size: int, slice_index: int = 0,
+                 slice_count: int = 1,
+                 paths: typing.Optional[typing.Sequence[str]] = None):
+        import glob as globlib
+        if paths is None:
+            paths = []
+            for dset in cfg.dataset_configs:
+                if dset["type"] == "text":
+                    paths.extend(globlib.glob(dset["path"]))
+        self.cfg = cfg
+        self.batch = sub_batch_size
+        files, skips = split_files(paths, slice_index, slice_count,
+                                   cfg.data_seed * int(cfg.shuffle_input_filenames))
+        per_frame = cfg.language_token_per_frame - 1
+        window = (cfg.time_patch_size + 1) * per_frame
+        self.interleave = _Interleave(files, skips, window, window,
+                                      cfg.interleaved_datasets, repeat=True)
+        self.stream: typing.Iterable = _ShuffleBuffer(
+            self.interleave, cfg.shuffle_buffer, cfg.data_seed)
+
+    def __iter__(self) -> typing.Iterator[typing.Dict[str, np.ndarray]]:
+        cfg = self.cfg
+        t = cfg.time_patch_size
+        per_frame = cfg.language_token_per_frame - 1
+        frame_shape = ((t + 1, cfg.frame_height_patch, cfg.frame_width_patch,
+                        cfg.channel_color_size) if cfg.three_axes else
+                       (t + 1, cfg.frame_height_patch * cfg.frame_width_patch,
+                        cfg.channel_color_size))
+        buf: typing.List[np.ndarray] = []
+        for window in self.stream:
+            buf.append(window)
+            if len(buf) < self.batch:
+                continue
+            x = np.stack(buf).astype(np.int32)
+            buf.clear()
+            x = x.reshape(self.batch, t + 1, per_frame)
+            pad = np.full((self.batch, t + 1, 1), cfg.padding_token, np.int32)
+            x = np.concatenate([x, pad], axis=2)
+            x = x.reshape(self.batch, t + 1, cfg.language_token_patch,
+                          cfg.token_patch_size)
+            token_x, token_y = x[:, :t], x[:, 1:t + 1]
+            yield {
+                "frame": np.zeros((self.batch,) + frame_shape, np.int32),
+                "token_x": token_x, "token_y": token_y,
+                "txt_msk": token_y != cfg.concat_token,
+                "vid_msk_src": np.zeros((self.batch, t), bool),
+                "vid_msk_tgt": np.zeros((self.batch, t), bool),
+                "cat_mask_x": np.ones((self.batch, t), bool),
+                "cat_mask_y": np.ones((self.batch, t), bool),
+            }
+
+    def state_dict(self) -> dict:
+        return self.interleave.state_dict()
+
+    def load_state_dict(self, state: dict) -> None:
+        self.interleave.load_state_dict(state)
+
+
+class MixturePipeline:
+    """Seeded weighted sampling across child pipelines (the reference's
+    ``sample_from_datasets``, inputs.py:517-520)."""
+
+    def __init__(self, children: typing.Sequence[typing.Iterable],
+                 weights: typing.Sequence[float], seed: int):
+        self.children = list(children)
+        self.weights = np.asarray(weights, np.float64)
+        self.weights /= self.weights.sum()
+        self.seed = seed
+        self.drawn = 0
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed)
+        iters = [iter(c) for c in self.children]
+        # replay the choice stream for deterministic resume
+        for _ in range(self.drawn):
+            rng.choice(len(iters), p=self.weights)
+        while iters:
+            idx = int(rng.choice(len(iters), p=self.weights))
+            self.drawn += 1
+            try:
+                yield next(iters[idx])
+            except StopIteration:
+                return
+
+    def state_dict(self) -> dict:
+        return {"drawn": self.drawn,
+                "children": [getattr(c, "state_dict", dict)() for c in self.children]}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.drawn = state["drawn"]
+        for child, s in zip(self.children, state["children"]):
+            if hasattr(child, "load_state_dict"):
+                child.load_state_dict(s)
+
+
+def dataset(cfg: Config, sub_batch_size: int, slice_index: int = 0,
+            slice_count: int = 1):
+    """Mixture entry point mirroring the reference API (inputs.py:486-525)."""
+    from .video import VideoPipeline
+    children: typing.List[typing.Iterable] = []
+    weights: typing.List[float] = []
+    for dset in cfg.dataset_configs:
+        kind = dset["type"]
+        if kind == "video":
+            children.append(VideoPipeline(cfg, sub_batch_size, slice_index,
+                                          slice_count, paths=None,
+                                          path_glob=dset["path"]))
+        elif kind == "text" and cfg.use_language:
+            if cfg.model_mode == "gpt":
+                children.append(GptPipeline(cfg, sub_batch_size, slice_index,
+                                            slice_count))
+            else:
+                children.append(JannetTextPipeline(
+                    cfg, sub_batch_size, slice_index, slice_count,
+                    paths=None))
+        else:
+            raise ValueError(f"unsupported dataset type {kind}")
+        weights.append(dset.get("weight", 1.0))
+    if len(children) == 1:
+        return children[0]
+    return MixturePipeline(children, weights, cfg.data_seed)

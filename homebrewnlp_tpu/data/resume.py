@@ -1,0 +1,139 @@
+"""Deterministic data-stream resume.
+
+Two mechanisms:
+
+1. **Cursor checkpointing (primary)**: every pipeline exposes
+   ``state_dict``/``load_state_dict`` and the cursor rides along with orbax
+   checkpoints (train/checkpoint.py) — simpler and exact.
+
+2. **Run-log replay (reference parity)**: the reference reconstructs per-file
+   skip counts by replaying previous runs' consumption arithmetic against the
+   token counts encoded in filenames (``..._<n>.tfrecord``), never storing
+   iterator state (/root/reference/src/inputs.py:33-128,
+   src/run/dataloader_placement.py:101-136).  ``simulate_consumption`` ports
+   that: round-robin window consumption inside interleave groups, per slice,
+   until the run's step budget is exhausted.  Files are treated as one token
+   stream (the reference's single-document assumption).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import typing
+
+
+class RunLog:
+    """The DataLog artifact: one entry per completed run."""
+
+    def __init__(self, model_path: str):
+        self.path = os.path.join(model_path, "data_log.json")
+        self.runs: typing.List[dict] = []
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                self.runs = json.load(f)
+
+    def append(self, *, steps: int, batch_size: int, slice_count: int,
+               ctx: int, grad_accumulation: int = 1, interleave_size: int = 1,
+               token_patch_size: int = 1) -> None:
+        self.runs.append(dict(steps=steps, batch_size=batch_size,
+                              slice_count=slice_count, ctx=ctx,
+                              grad_accumulation=grad_accumulation,
+                              interleave_size=interleave_size,
+                              token_patch_size=token_patch_size,
+                              timestamp=time.time()))
+
+    def save(self) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        with open(self.path, "w") as f:
+            json.dump(self.runs, f)
+
+
+def tokens_from_filename(path: str) -> int:
+    """``shard..._<n>.tfrecord`` -> n (reference inputs.py:34)."""
+    stem = os.path.basename(str(path))
+    return int(stem.split("_")[-1].replace(".tfrecord", ""))
+
+
+def simulate_consumption(file_tokens: typing.Sequence[int],
+                         runs: typing.Sequence[dict]
+                         ) -> typing.Tuple[typing.List[bool], typing.List[int]]:
+    """Replay runs -> (file fully consumed?, tokens consumed per file).
+
+    Window arithmetic per file: usable tokens = ``c - ((c - patch) % ctx) -
+    patch`` (windows of ctx+patch shifted by ctx drop the remainder); each
+    window consumes ``ctx`` tokens.  Consumption is round-robin one window at
+    a time across each interleave group (tf.data interleave block_length=1),
+    groups processed in order, per slice (reference inputs.py:33-128)."""
+    n = len(file_tokens)
+    consumed = [0] * n
+    depleted = [False] * n
+
+    for run in runs:
+        ctx = run["ctx"]
+        patch = run.get("token_patch_size", 1)
+        slice_count = run["slice_count"]
+        interleave = max(1, run["interleave_size"])
+        budget_per_slice = (run["steps"] * run.get("grad_accumulation", 1)
+                            * (run["batch_size"] // slice_count))
+
+        # live files in original order (replicates the reference re-deriving
+        # the active file list at the start of each run)
+        live = [i for i in range(n) if not depleted[i]]
+
+        for slice_index in range(slice_count):
+            slice_files = live[slice_index::slice_count]
+            budget = budget_per_slice
+            for g in range(0, len(slice_files), interleave):
+                group = slice_files[g:g + interleave]
+                # remaining windows per file in this group
+                windows = []
+                for i in group:
+                    c = file_tokens[i] - consumed[i]
+                    usable = c - ((c - patch) % ctx) - patch
+                    windows.append(max(0, usable // ctx))
+                total = sum(windows)
+                if total <= budget:
+                    budget -= total
+                    for i, w in zip(group, windows):
+                        consumed[i] += w * ctx
+                        depleted[i] = True
+                    if budget == 0:
+                        break
+                    continue
+                # partial group: round-robin single windows
+                idx = 0
+                while budget > 0 and sum(windows) > 0:
+                    while windows[idx] <= 0:
+                        idx = (idx + 1) % len(group)
+                    windows[idx] -= 1
+                    consumed[group[idx]] += ctx
+                    budget -= 1
+                    idx = (idx + 1) % len(group)
+                for i, w in zip(group, windows):
+                    if w <= 0:
+                        depleted[i] = True
+                break  # budget exhausted inside this group
+
+        # the reference only skips whole files when an entire interleave
+        # group is depleted (inputs.py:117-127): partially-depleted groups
+        # must be revisited so the interleave pattern replays identically
+        for slice_index in range(slice_count):
+            slice_files = live[slice_index::slice_count]
+            for g in range(0, len(slice_files), interleave):
+                group = slice_files[g:g + interleave]
+                full = all(depleted[i] for i in group)
+                for i in group:
+                    depleted[i] = full
+
+    return depleted, consumed
+
+
+def skips_for_restart(filenames: typing.Sequence[str], runs: typing.Sequence[dict]
+                      ) -> typing.Tuple[typing.List[str], typing.List[int]]:
+    """Files to keep + per-file token skips for a restarted run."""
+    tokens = [tokens_from_filename(f) for f in filenames]
+    depleted, consumed = simulate_consumption(tokens, runs)
+    keep = [f for f, d in zip(filenames, depleted) if not d]
+    skips = [c for c, d in zip(consumed, depleted) if not d]
+    return keep, skips

@@ -1,0 +1,82 @@
+"""Synthetic data: deterministic token batches + TFRecord fixture writers.
+
+The reference has no test data story (SURVEY.md §4); these helpers back the
+test suite and bench.py, and double as the format reference for the real
+TFRecord writers in tools/.
+"""
+from __future__ import annotations
+
+import os
+import typing
+
+import numpy as np
+
+from ..config import Config
+from .tfrecord import RecordWriter, encode_example
+
+
+def synthetic_text_batch(cfg: Config, step: int = 0, seed: int = 0
+                         ) -> typing.Dict[str, np.ndarray]:
+    rng = np.random.default_rng((seed, step))
+    rows = cfg.sequence_length // cfg.token_patch_size
+    shape = (cfg.train_batch_size, rows + cfg.output_offset,
+             cfg.token_patch_size)
+    stream = rng.integers(0, cfg.vocab_size, shape, np.int32)
+    return {"token_x": stream[:, :rows],
+            "token_y": stream[:, cfg.output_offset:rows + cfg.output_offset]}
+
+
+def write_text_tfrecords(directory: str, n_files: int, records_per_file: int,
+                         tokens_per_record: int, vocab: int = 256,
+                         seed: int = 0, int64: bool = False
+                         ) -> typing.List[str]:
+    """Write synthetic text shards; filenames carry the token count the way
+    the reference's run-log replay expects (``..._<n_tokens>.tfrecord``,
+    inputs.py:34)."""
+    rng = np.random.default_rng(seed)
+    os.makedirs(directory, exist_ok=True)
+    paths = []
+    total = records_per_file * tokens_per_record
+    for i in range(n_files):
+        kind = "int64" if int64 else "bytes"
+        path = os.path.join(directory, f"shard{kind}{i:04d}_{total}.tfrecord")
+        with RecordWriter(path) as w:
+            for _ in range(records_per_file):
+                tokens = rng.integers(0, vocab, tokens_per_record)
+                if int64:
+                    w.write(encode_example({"text": [int(t) for t in tokens]}))
+                else:
+                    w.write(encode_example(
+                        {"text": bytes(tokens.astype(np.uint8).tolist())}))
+        paths.append(path)
+    return paths
+
+
+def write_video_tfrecords(directory: str, n_files: int, frames_per_file: int,
+                          cfg: Config, seed: int = 0) -> typing.List[str]:
+    """Synthetic video shards with JPEG frames + concat/skip flags (+ tokens
+    when language_token_per_frame > 0)."""
+    import cv2
+    rng = np.random.default_rng(seed)
+    os.makedirs(directory, exist_ok=True)
+    paths = []
+    for i in range(n_files):
+        path = os.path.join(directory, f"video{i:04d}.tfrecord")
+        with RecordWriter(path) as w:
+            for j in range(frames_per_file):
+                img = rng.integers(0, 256, (cfg.frame_height, cfg.frame_width,
+                                            cfg.color_channels), np.uint8)
+                ok, enc = cv2.imencode(".jpg", img)
+                assert ok
+                feats: typing.Dict[str, typing.Any] = {
+                    "frame": enc.tobytes(),
+                    "concat": [int(j == 0)],
+                    "skip_frame": [0],
+                }
+                if cfg.language_token_per_frame > 0:
+                    feats["tokens"] = [int(t) for t in rng.integers(
+                        0, cfg.vocab_size, cfg.language_token_per_frame)]
+                    feats["mask"] = [int(cfg.language_token_per_frame)]
+                w.write(encode_example(feats))
+        paths.append(path)
+    return paths

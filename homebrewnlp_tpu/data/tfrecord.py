@@ -1,0 +1,240 @@
+"""TFRecord container + tf.train.Example wire-format codec, dependency-free.
+
+The reference reads/writes TFRecords through tf.data / tf.io
+(/root/reference/src/inputs.py:231-268, scripts/text2tfrecord.py:57-107).  The
+on-disk formats are tiny specs, so this framework implements them directly —
+the training path needs numpy arrays for ``jax.make_array_from_callback``,
+not TF tensors, and dropping the TF dependency keeps the loader importable
+everywhere.  Layout per record: u64-LE length, masked-crc32c(length),
+payload, masked-crc32c(payload).  Payloads are tf.train.Example protobufs;
+only the three Feature kinds exist (bytes/float/int64 lists).
+
+A C++ fast path for the record framing + CRC lives in native/ (used by the
+data tooling); this module is the portable fallback and the source of truth
+for tests.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import typing
+
+# -- crc32c (Castagnoli, reflected poly 0x82F63B78) --------------------------
+
+_CRC_TABLE: typing.List[int] = []
+
+
+def _build_table() -> None:
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        _CRC_TABLE.append(c)
+
+
+_build_table()
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# -- varint ------------------------------------------------------------------
+
+def _write_varint(out: bytearray, value: int) -> None:
+    value &= 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(buf: bytes, pos: int) -> typing.Tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+# -- tf.train.Example --------------------------------------------------------
+
+def _field(out: bytearray, number: int, payload: bytes) -> None:
+    _write_varint(out, (number << 3) | 2)  # len-delimited wire type
+    _write_varint(out, len(payload))
+    out.extend(payload)
+
+
+def encode_example(features: typing.Dict[str, typing.Union[bytes, typing.Sequence[int], typing.Sequence[float]]]
+                   ) -> bytes:
+    """Build an Example proto.  Values: bytes -> BytesList, list of int ->
+    packed Int64List, list of float -> packed FloatList."""
+    feats = bytearray()
+    for key, value in features.items():
+        feature = bytearray()
+        if isinstance(value, bytes):
+            blist = bytearray()
+            _field(blist, 1, value)
+            _field(feature, 1, bytes(blist))  # Feature.bytes_list
+        elif len(value) and isinstance(value[0], float):
+            packed = struct.pack(f"<{len(value)}f", *value)
+            flist = bytearray()
+            _field(flist, 1, packed)
+            _field(feature, 2, bytes(flist))  # Feature.float_list
+        else:
+            packed = bytearray()
+            for v in value:
+                _write_varint(packed, int(v))
+            ilist = bytearray()
+            _field(ilist, 1, bytes(packed))
+            _field(feature, 3, bytes(ilist))  # Feature.int64_list
+        entry = bytearray()
+        _field(entry, 1, key.encode())
+        _field(entry, 2, bytes(feature))
+        _field(feats, 1, bytes(entry))  # Features.feature map entry
+    out = bytearray()
+    _field(out, 1, bytes(feats))  # Example.features
+    return bytes(out)
+
+
+def _parse_fields(buf: bytes) -> typing.Iterator[typing.Tuple[int, int, typing.Union[int, bytes]]]:
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        number, wire = tag >> 3, tag & 7
+        if wire == 0:
+            value, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            length, pos = _read_varint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire == 5:
+            value = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            value = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield number, wire, value
+
+
+def decode_example(buf: bytes) -> typing.Dict[str, typing.Union[typing.List[bytes], typing.List[int], typing.List[float]]]:
+    """Parse an Example into {key: list-of-values}."""
+    out: typing.Dict[str, typing.Any] = {}
+    for num, _, features_buf in _parse_fields(buf):
+        if num != 1:
+            continue
+        for fnum, _, entry in _parse_fields(features_buf):
+            if fnum != 1:
+                continue
+            key = None
+            feature = b""
+            for enum_, _, val in _parse_fields(entry):
+                if enum_ == 1:
+                    key = val.decode()
+                elif enum_ == 2:
+                    feature = val
+            values: typing.List[typing.Any] = []
+            for knum, wire, lst in _parse_fields(feature):
+                if knum == 1:  # bytes_list
+                    values.extend(v for n, _, v in _parse_fields(lst) if n == 1)
+                elif knum == 2:  # float_list
+                    for n, w, v in _parse_fields(lst):
+                        if n != 1:
+                            continue
+                        if w == 2:  # packed
+                            values.extend(struct.unpack(f"<{len(v) // 4}f", v))
+                        else:
+                            values.append(struct.unpack("<f", v)[0])
+                elif knum == 3:  # int64_list
+                    for n, w, v in _parse_fields(lst):
+                        if n != 1:
+                            continue
+                        if w == 2:  # packed varints
+                            p = 0
+                            while p < len(v):
+                                x, p = _read_varint(v, p)
+                                values.append(x - (1 << 64) if x >> 63 else x)
+                        else:
+                            values.append(v - (1 << 64) if v >> 63 else v)
+            out[key] = values
+    return out
+
+
+# -- record framing ----------------------------------------------------------
+
+class RecordWriter:
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "wb")
+
+    def write(self, record: bytes) -> None:
+        header = struct.pack("<Q", len(record))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", masked_crc(header)))
+        self._f.write(record)
+        self._f.write(struct.pack("<I", masked_crc(record)))
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_records(path: str, verify: bool = False,
+                 skip: int = 0) -> typing.Iterator[bytes]:
+    """Yield raw record payloads; ``skip`` fast-forwards without CRC work."""
+    with open(path, "rb") as f:
+        index = 0
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            if index < skip:
+                f.seek(4 + length + 4, os.SEEK_CUR)
+                index += 1
+                continue
+            f.seek(4, os.SEEK_CUR)  # length crc
+            record = f.read(length)
+            if len(record) < length:
+                return
+            crc_bytes = f.read(4)
+            if verify:
+                (expect,) = struct.unpack("<I", crc_bytes)
+                if masked_crc(record) != expect:
+                    raise IOError(f"crc mismatch in {path} record {index}")
+            index += 1
+            yield record
+
+
+def count_records(path: str) -> int:
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return n
+            (length,) = struct.unpack("<Q", header)
+            f.seek(4 + length + 4, os.SEEK_CUR)
+            n += 1

@@ -1,0 +1,167 @@
+"""Video TFRecord pipeline: JPEG frames -> patchified uint8/uint32 tensors.
+
+Port of the reference video decoder + dataset (/root/reference/src/inputs.py:
+131-228, 370-483): per-record Example features are {frame: JPEG bytes,
+concat: int64, skip_frame: int64} plus optional {tokens: int64[ltpf],
+mask: int64}.  Frames are color-quantized, patchified via the reference's
+reshape/transpose ((hp,P,wp,P,C) -> transpose(1,3,0,2,4) -> (hp,wp,P*P*C)),
+optionally bit-folded (several low-bit color values packed per uint32,
+inputs.py:174-198), windowed over ``sequence_length + time_patch`` frames
+with shift ``sequence_length``, and emitted with src/tgt frame masks and
+concat masks (dataset_video._pre_func, inputs.py:412-465).
+"""
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from ..config import Config
+from .pipeline import _ShuffleBuffer, split_files
+from .tfrecord import decode_example, read_records
+
+
+def _decode_jpeg(data: bytes) -> np.ndarray:
+    import cv2
+    arr = cv2.imdecode(np.frombuffer(data, np.uint8), cv2.IMREAD_COLOR)
+    if arr is None:
+        raise ValueError("undecodable frame")
+    return cv2.cvtColor(arr, cv2.COLOR_BGR2RGB)
+
+
+class FrameDecoder:
+    """Single-record decoder (reference get_video_decoder)."""
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        cc = cfg.channel_color_size
+        if cfg.use_bit_fold_input_pipeline:
+            cc = cc  # channel_color_size already divided by fold_count
+        self.frame_shape = ((cfg.frame_height_patch, cfg.frame_width_patch, cc)
+                            if cfg.three_axes else
+                            (cfg.frame_height_patch * cfg.frame_width_patch, cc))
+        self.dtype = np.uint32 if cfg.use_bit_fold_input_pipeline else np.uint8
+        self.multi = np.array(
+            [(2 ** cfg.bit_fold_value) ** i for i in range(cfg.fold_count)],
+            np.int64)[None, :, None]
+
+    def _op_decode(self, frame: np.ndarray) -> np.ndarray:
+        cfg = self.cfg
+        q = cfg.color_quantization_value
+        if q != 256:
+            frame = np.round(frame.astype(np.float32) * ((q - 1) / 255))
+            frame = frame.astype(np.int64 if cfg.use_bit_fold_input_pipeline
+                                 else np.uint8)
+        p = cfg.patch_size
+        frame = frame.reshape(cfg.frame_height_patch, p, cfg.frame_width_patch,
+                              p, cfg.color_channels)
+        frame = frame.transpose(1, 3, 0, 2, 4)
+        if cfg.use_bit_fold_input_pipeline:
+            out_shape = (list(self.frame_shape[:-1])
+                         + [cfg.fold_count, self.frame_shape[-1]])
+            frame = frame.reshape(out_shape)
+            frame = (frame.astype(np.int64) * self.multi).sum(axis=-2)
+            return frame.astype(np.uint32)
+        return frame.reshape(self.frame_shape)
+
+    def __call__(self, payload: bytes) -> typing.Tuple[np.ndarray, int, int,
+                                                       typing.Optional[np.ndarray],
+                                                       typing.Optional[np.ndarray]]:
+        cfg = self.cfg
+        ex = decode_example(payload)
+        concat = int(ex["concat"][0])
+        skip = int(ex["skip_frame"][0])
+        if skip > 0 or concat > 0:
+            frame = np.zeros(self.frame_shape, self.dtype)
+        else:
+            frame = self._op_decode(_decode_jpeg(ex["frame"][0]))
+        tokens = mask = None
+        if cfg.language_token_per_frame > 0:
+            tokens = np.asarray(ex["tokens"], np.int32)
+            token_range = np.arange(cfg.language_token_per_frame)
+            mask = token_range <= int(ex["mask"][0])
+        return frame, concat, skip, tokens, mask
+
+
+class VideoPipeline:
+    """Windowed, batched video (+token) samples (reference dataset_video)."""
+
+    def __init__(self, cfg: Config, sub_batch_size: int, slice_index: int = 0,
+                 slice_count: int = 1,
+                 paths: typing.Optional[typing.Sequence[str]] = None,
+                 path_glob: typing.Optional[str] = None):
+        import glob as globlib
+        if paths is None:
+            paths = globlib.glob(path_glob) if path_glob else []
+        self.cfg = cfg
+        self.batch = sub_batch_size
+        self.files, _ = split_files(paths, slice_index, slice_count,
+                                    cfg.data_seed * int(cfg.shuffle_input_filenames))
+        self.decoder = FrameDecoder(cfg)
+        self.next_file = 0
+
+    def _file_windows(self, path: str):
+        cfg = self.cfg
+        size = cfg.sequence_length + cfg.time_patch
+        buf: typing.List[tuple] = []
+        for payload in read_records(path):
+            buf.append(self.decoder(payload))
+            if len(buf) == size:
+                yield buf
+                buf = buf[cfg.sequence_length:]
+
+    def __iter__(self) -> typing.Iterator[typing.Dict[str, np.ndarray]]:
+        cfg = self.cfg
+        t = cfg.time_patch_size
+        batch_buf: typing.List[list] = []
+        while True:
+            if self.next_file >= len(self.files):
+                self.next_file = 0  # dataset_video repeats (inputs.py:475)
+                if not self.files:
+                    return
+            path = self.files[self.next_file]
+            self.next_file += 1
+            for window in self._file_windows(path):
+                batch_buf.append(window)
+                if len(batch_buf) < self.batch:
+                    continue
+                yield self._assemble(batch_buf)
+                batch_buf.clear()
+
+    def _assemble(self, windows: typing.List[list]) -> typing.Dict[str, np.ndarray]:
+        cfg = self.cfg
+        t = cfg.time_patch_size
+        frames = np.stack([np.stack([w[0] for w in win]) for win in windows])
+        concat = np.stack([[w[1] for w in win] for win in windows])
+        skip = np.stack([[w[2] for w in win] for win in windows])
+        out_shape = ((self.batch, t + 1, cfg.frame_height_patch,
+                      cfg.frame_width_patch, cfg.channel_color_size)
+                     if cfg.three_axes else
+                     (self.batch, t + 1,
+                      cfg.frame_height_patch * cfg.frame_width_patch,
+                      cfg.channel_color_size))
+        frames = frames.reshape(out_shape)
+        cat = (1 - concat).astype(bool)
+        fmask = (1 - skip).astype(bool)
+        out = {
+            "frame": frames if cfg.use_bit_fold_input_pipeline
+            else frames.astype(np.int32),
+            "vid_msk_src": fmask[:, :t], "vid_msk_tgt": fmask[:, 1:t + 1],
+            "cat_mask_x": cat[:, :t], "cat_mask_y": cat[:, 1:t + 1],
+        }
+        if cfg.use_language and cfg.language_token_per_frame > 0:
+            tokens = np.stack([[w[3] for w in win] for win in windows])
+            tmask = np.stack([[w[4] for w in win] for win in windows])
+            tokens = tokens.reshape(self.batch, t + 1, cfg.language_token_patch,
+                                    cfg.token_patch_size).astype(np.int32)
+            out["token_x"] = tokens[:, :t]
+            out["token_y"] = tokens[:, 1:t + 1]
+            out["txt_msk"] = tmask[:, 1:t + 1].reshape(
+                self.batch, t, cfg.language_token_patch, cfg.token_patch_size)
+        return out
+
+    def state_dict(self) -> dict:
+        return {"next_file": self.next_file}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.next_file = state["next_file"]

@@ -34,7 +34,9 @@ def axis_sizes(cfg: Config, n_devices: int) -> typing.Dict[str, int]:
         # shrink the model axis to the largest divisor that fits
         model = 1
         for cand in range(min(cfg.mesh_model, n_devices), 0, -1):
-            if n_devices % (cand * seq * pipe) == 0:
+            # the model axis must also divide the head count or head-sharded
+            # parameters cannot be placed on the mesh
+            if n_devices % (cand * seq * pipe) == 0 and cfg.heads % cand == 0:
                 model = cand
                 break
         denom = model * seq * pipe

@@ -1,0 +1,36 @@
+"""Shared utilities: flagship config loading + random batch construction
+(used by bench.py, __graft_entry__.py, and the CLI debug modes)."""
+from __future__ import annotations
+
+import json
+import os
+import typing
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_config(path: str, **overrides):
+    """Config from JSON with keyword overrides applied before derivation."""
+    from ..config import Config
+    if not os.path.isabs(path) and not os.path.exists(path):
+        path = os.path.join(REPO_ROOT, path)
+    with open(path) as f:
+        raw = json.load(f)
+    raw.update(overrides)
+    return Config(raw)
+
+
+def random_text_batch(cfg, seed: int = 0) -> typing.Dict[str, typing.Any]:
+    """Uniform-random token batch as NTs (model input shape, reference
+    dataclass.py:310-337 text entries)."""
+    import jax
+    from ..nd import NT
+    shape = (cfg.train_batch_size, cfg.sequence_length // cfg.token_patch_size,
+             cfg.token_patch_size)
+    names = ("batch", "sequence", "language_token_patch")
+    kx, ky = jax.random.split(jax.random.key(seed))
+    return {
+        "token_x": NT(jax.random.randint(kx, shape, 0, cfg.vocab_size), names),
+        "token_y": NT(jax.random.randint(ky, shape, 0, cfg.vocab_size), names),
+    }

@@ -1,0 +1,178 @@
+"""Data layer tests: TFRecord codec (with tf.train.Example as oracle when
+available), windowed pipelines, interleave determinism + resume, mixture
+weighting, run-log replay parity against actual consumption, video decode,
+host->device feeding."""
+import numpy as np
+import pytest
+
+from homebrewnlp_tpu.data import (GptPipeline, MixturePipeline, RecordWriter,
+                                  count_records, decode_example,
+                                  encode_example, read_records,
+                                  skips_for_restart, synthetic_text_batch,
+                                  to_global, write_text_tfrecords)
+from homebrewnlp_tpu.data.pipeline import _FileWindows, _Interleave
+from homebrewnlp_tpu.data.resume import RunLog, simulate_consumption
+
+from .backend import mixer_config
+
+
+def test_example_roundtrip():
+    ex = {"text": b"hello world", "ids": [1, 5, 70000, 0], "w": [0.5, -1.25]}
+    decoded = decode_example(encode_example(ex))
+    assert decoded["text"] == [b"hello world"]
+    assert decoded["ids"] == [1, 5, 70000, 0]
+    assert decoded["w"] == [0.5, -1.25]
+
+
+def test_example_matches_tensorflow_oracle():
+    tf = pytest.importorskip("tensorflow")
+    ours = encode_example({"text": b"abc", "ids": [3, 9, 127, 128, 300]})
+    theirs = decode_example(
+        tf.train.Example(features=tf.train.Features(feature={
+            "text": tf.train.Feature(bytes_list=tf.train.BytesList(value=[b"abc"])),
+            "ids": tf.train.Feature(int64_list=tf.train.Int64List(value=[3, 9, 127, 128, 300])),
+        })).SerializeToString())
+    assert theirs["text"] == [b"abc"] and theirs["ids"] == [3, 9, 127, 128, 300]
+    # and tf can parse ours
+    parsed = tf.io.parse_single_example(ours, {
+        "text": tf.io.FixedLenFeature([], tf.string),
+        "ids": tf.io.VarLenFeature(tf.int64)})
+    assert parsed["text"].numpy() == b"abc"
+    assert list(tf.sparse.to_dense(parsed["ids"]).numpy()) == [3, 9, 127, 128, 300]
+
+
+def test_record_framing_roundtrip(tmp_path):
+    p = str(tmp_path / "x.tfrecord")
+    payloads = [b"a" * 3, b"b" * 1000, b""]
+    with RecordWriter(p) as w:
+        for x in payloads:
+            w.write(x)
+    assert list(read_records(p, verify=True)) == payloads
+    assert count_records(p) == 3
+    assert list(read_records(p, skip=2)) == [b""]
+
+
+def test_tfrecord_readable_by_tensorflow(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    p = str(tmp_path / "x.tfrecord")
+    with RecordWriter(p) as w:
+        w.write(b"payload-1")
+        w.write(b"payload-2")
+    got = [r.numpy() for r in tf.data.TFRecordDataset(p)]
+    assert got == [b"payload-1", b"payload-2"]
+
+
+def test_file_windows_per_record(tmp_path):
+    (path,) = write_text_tfrecords(str(tmp_path), 1, records_per_file=2,
+                                   tokens_per_record=25, seed=1)
+    # window 10+1, shift 10 -> per 25-token record: starts 0,10 => 2 windows
+    wins = list(_FileWindows(path, window=11, shift=10))
+    assert len(wins) == 4
+    assert all(len(w) == 11 for w in wins)
+    # consecutive windows overlap by 1 token (x/y offset)
+    assert wins[0][10] == wins[1][0]
+
+
+def test_gpt_pipeline_shapes_and_xy_offset(tmp_path):
+    cfg = mixer_config(sequence_length=16)
+    paths = write_text_tfrecords(str(tmp_path), 4, 4, 70, seed=3)
+    pipe = GptPipeline(cfg, sub_batch_size=2, paths=paths)
+    batch = next(iter(pipe))
+    assert batch["token_x"].shape == (2, 16, 1)
+    assert batch["token_y"].shape == (2, 16, 1)
+    np.testing.assert_array_equal(batch["token_x"][:, 1:], batch["token_y"][:, :-1])
+
+
+def test_interleave_deterministic_and_resumable(tmp_path):
+    paths = write_text_tfrecords(str(tmp_path), 6, 3, 40, seed=5)
+    def make():
+        return _Interleave(sorted(paths), [0] * 6, window=17, shift=16,
+                           cycle=3, repeat=False)
+    full = [w.tobytes() for w in make()]
+    assert len(full) > 10
+    # same stream twice
+    assert [w.tobytes() for w in make()] == full
+    # stop after k, save state, resume
+    k = 7
+    inter = make()
+    it = iter(inter)
+    got = [next(it).tobytes() for _ in range(k)]
+    state = inter.state_dict()
+    resumed = make()
+    resumed.load_state_dict(state)
+    got += [w.tobytes() for w in resumed]
+    assert got == full
+
+
+def test_mixture_weights_and_determinism():
+    a = [{"x": np.full(1, 0)}] * 300
+    b = [{"x": np.full(1, 1)}] * 300
+    mix1 = list(MixturePipeline([a, b], [3, 1], seed=7))
+    mix2 = list(MixturePipeline([a, b], [3, 1], seed=7))
+    assert [m["x"][0] for m in mix1] == [m["x"][0] for m in mix2]
+    frac = np.mean([m["x"][0] for m in mix1][:200])
+    assert 0.1 < frac < 0.4  # ~0.25
+
+
+def test_runlog_replay_matches_actual_consumption(tmp_path):
+    """Property test (SURVEY.md §7 hard part): replay arithmetic must equal
+    real pipeline consumption for a single-record-per-file dataset."""
+    cfg = mixer_config(sequence_length=16, interleaved_datasets=2)
+    paths = write_text_tfrecords(str(tmp_path), 5, 1, 130, seed=9)
+    pipe = GptPipeline(cfg, sub_batch_size=2, paths=paths)
+    # consume 3 batches = 6 windows
+    it = iter(pipe)
+    consumed_windows = [next(it) for _ in range(3)]
+    log = RunLog(str(tmp_path))
+    log.append(steps=3, batch_size=2, slice_count=1, ctx=16,
+               interleave_size=2, token_patch_size=1)
+
+    # actual continuation from the live iterator
+    rest_actual = [b["token_x"].tobytes() for b in it]
+    # continuation reconstructed purely from the run log
+    pipe_replay = GptPipeline(cfg, sub_batch_size=2, paths=paths,
+                              runs_log=log.runs)
+    rest_replay = [b["token_x"].tobytes() for b in pipe_replay]
+    assert rest_replay == rest_actual
+    assert consumed_windows  # silence unused warning; 3 batches were drawn
+
+
+def test_simulate_consumption_full_depletion():
+    # 2 files, 100 tokens each, ctx 10 + patch 1 -> 9 windows per file
+    depleted, consumed = simulate_consumption(
+        [100, 100], [dict(steps=18, batch_size=1, slice_count=1, ctx=10,
+                          grad_accumulation=1, interleave_size=2,
+                          token_patch_size=1)])
+    assert depleted == [True, True]
+    assert consumed == [90, 90]
+
+
+def test_to_global_feeds_mesh(eight_devices):
+    import jax
+    from homebrewnlp_tpu.parallel import make_mesh
+    cfg = mixer_config(train_batch_size=8)
+    mesh = make_mesh(cfg)
+    batch = synthetic_text_batch(cfg)
+    global_batch = to_global(batch, cfg, mesh)
+    x = global_batch["token_x"]
+    assert x.x.shape == (8, 16, 1)
+    assert len(x.x.addressable_shards) == 8
+    np.testing.assert_array_equal(np.asarray(x.x), batch["token_x"])
+
+
+def test_video_pipeline(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    from homebrewnlp_tpu.data import write_video_tfrecords
+    from homebrewnlp_tpu.data.video import VideoPipeline
+    cfg = mixer_config(model_mode="jannet", use_video=True, use_language=False,
+                       frame_height=32, frame_width=32, patch_size=16,
+                       sequence_length=4, experts=1)
+    paths = write_video_tfrecords(str(tmp_path), 2, 12, cfg, seed=11)
+    pipe = VideoPipeline(cfg, sub_batch_size=2, paths=paths)
+    batch = next(iter(pipe))
+    # 3 axes: [B, t+1, hp, wp, color*patch^2]
+    assert batch["frame"].shape == (2, 5, 2, 2, 16 * 16 * 3)
+    assert batch["vid_msk_src"].shape == (2, 4)
+    assert batch["cat_mask_x"].dtype == bool
+    # first frame of each file is concat -> mask False somewhere
+    assert not batch["cat_mask_x"].all() or not batch["cat_mask_y"].all()

@@ -17,10 +17,12 @@ def test_axis_sizes_factoring():
     cfg = mixer_config()  # heads=4
     sizes = axis_sizes(cfg, 8)
     assert sizes[MODEL_AXIS] == 4 and sizes[DATA_AXIS] == 2
-    # non-divisible head count shrinks the model axis
+    # non-divisible head count shrinks the model axis — and the shrunk axis
+    # must still divide the head count (else params can't be placed)
     cfg3 = mixer_config(heads=3, features_per_head=32)
     sizes3 = axis_sizes(cfg3, 8)
     assert sizes3[MODEL_AXIS] * sizes3[DATA_AXIS] == 8
+    assert cfg3.heads % sizes3[MODEL_AXIS] == 0
 
 
 def test_spec_rules(eight_devices):
