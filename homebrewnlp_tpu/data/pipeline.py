@@ -164,27 +164,56 @@ class _Interleave:
 
 
 class _ShuffleBuffer:
-    """Seeded reservoir shuffle (tf.data Dataset.shuffle semantics)."""
+    """Seeded reservoir shuffle (tf.data Dataset.shuffle semantics).
+
+    Resumable by replay: the whole state is the count of items pulled from
+    the inner stream — ``load_state_dict`` replays that many pulls (same rng
+    draw sequence, discarding the yields) against a fresh inner iterator to
+    rebuild the buffer exactly.  Costs one sequential re-read of consumed
+    data on resume, but avoids serializing up to ``shuffle_buffer`` windows."""
 
     def __init__(self, inner: typing.Iterable, size: int, seed: int):
         self.inner = inner
         self.size = size
-        self.rng = np.random.default_rng(seed)
+        self.seed = seed
+        self.pulled = 0
+
+    def _replay(self) -> typing.Tuple[typing.List[np.ndarray],
+                                      np.random.Generator,
+                                      typing.Iterator]:
+        rng = np.random.default_rng(self.seed)
+        buf: typing.List[np.ndarray] = []
+        it = iter(self.inner)
+        for _ in range(self.pulled):
+            item = next(it)
+            if len(buf) < self.size:
+                buf.append(item)
+                continue
+            idx = int(rng.integers(len(buf)))
+            buf[idx] = item  # the swapped-out item was already yielded
+        return buf, rng, it
 
     def __iter__(self):
         if self.size <= 1:
             yield from self.inner
             return
-        buf: typing.List[np.ndarray] = []
-        for item in self.inner:
+        buf, rng, it = self._replay()
+        for item in it:
+            self.pulled += 1
             if len(buf) < self.size:
                 buf.append(item)
                 continue
-            idx = int(self.rng.integers(len(buf)))
+            idx = int(rng.integers(len(buf)))
             buf[idx], item = item, buf[idx]
             yield item
-        self.rng.shuffle(buf)  # drain
+        rng.shuffle(buf)  # drain
         yield from buf
+
+    def state_dict(self) -> dict:
+        return {"pulled": self.pulled}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.pulled = state["pulled"]
 
 
 class GptPipeline:
@@ -235,10 +264,17 @@ class GptPipeline:
                    "token_y": np.ascontiguousarray(token_y)}
 
     def state_dict(self) -> dict:
-        return self.interleave.state_dict()
+        if isinstance(self.stream, _ShuffleBuffer):
+            return {"shuffle": self.stream.state_dict()}
+        return {"interleave": self.interleave.state_dict()}
 
     def load_state_dict(self, state: dict) -> None:
-        self.interleave.load_state_dict(state)
+        """Must be called on a freshly-constructed pipeline (checkpoint
+        resume): shuffle replay re-pulls from the file start."""
+        if "shuffle" in state:
+            self.stream.load_state_dict(state["shuffle"])
+        else:
+            self.interleave.load_state_dict(state.get("interleave", state))
 
 
 class JannetTextPipeline:
@@ -298,10 +334,10 @@ class JannetTextPipeline:
             }
 
     def state_dict(self) -> dict:
-        return self.interleave.state_dict()
+        return {"shuffle": self.stream.state_dict()}
 
     def load_state_dict(self, state: dict) -> None:
-        self.interleave.load_state_dict(state)
+        self.stream.load_state_dict(state.get("shuffle", state))
 
 
 class MixturePipeline:
@@ -318,17 +354,21 @@ class MixturePipeline:
 
     def __iter__(self):
         rng = np.random.default_rng(self.seed)
+        live = list(range(len(self.children)))
         iters = [iter(c) for c in self.children]
         # replay the choice stream for deterministic resume
         for _ in range(self.drawn):
-            rng.choice(len(iters), p=self.weights)
-        while iters:
-            idx = int(rng.choice(len(iters), p=self.weights))
+            rng.choice(len(self.children), p=self.weights)
+        while live:
+            weights = self.weights[live] / self.weights[live].sum()
+            idx = live[int(rng.choice(len(live), p=weights))]
             self.drawn += 1
             try:
                 yield next(iters[idx])
             except StopIteration:
-                return
+                # keep sampling the remaining datasets (tf.data
+                # sample_from_datasets with stop_on_empty_dataset=False)
+                live.remove(idx)
 
     def state_dict(self) -> dict:
         return {"drawn": self.drawn,

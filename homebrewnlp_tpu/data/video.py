@@ -34,9 +34,9 @@ class FrameDecoder:
 
     def __init__(self, cfg: Config):
         self.cfg = cfg
+        # in bit-fold mode channel_color_size is already divided by
+        # fold_count (config.py derivation)
         cc = cfg.channel_color_size
-        if cfg.use_bit_fold_input_pipeline:
-            cc = cc  # channel_color_size already divided by fold_count
         self.frame_shape = ((cfg.frame_height_patch, cfg.frame_width_patch, cc)
                             if cfg.three_axes else
                             (cfg.frame_height_patch * cfg.frame_width_patch, cc))

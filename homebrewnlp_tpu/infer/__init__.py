@@ -1,0 +1,4 @@
+"""Inference layer: autoregressive while-loop samplers (JAX re-design of
+/root/reference/src/run/inference.py)."""
+from .sampler import (autoregressive_text, autoregressive_video,  # noqa: F401
+                      make_text_sampler)

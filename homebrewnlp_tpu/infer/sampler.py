@@ -1,0 +1,154 @@
+"""Autoregressive sampling.
+
+Port of the reference's while-loop sampler (/root/reference/src/run/
+inference.py:15-133) to ``jax.lax.while_loop``: each step rebuilds the full
+forward pass (no KV cache — faithful to the reference, and required for
+arbitrary DSL layers like bias-map mixer attention and cummean, whose state
+is not a KV pair), samples via the Gumbel trick
+``argmax(logits - T * log(-log(U)))`` (inference.py:88-92), shifts by one
+position and blends the sampled token into ``token_x`` with a one-hot mask
+(inference.py:94-96).  Temperature 0 reduces to greedy exactly as upstream.
+
+The video variant blends generated frames back into the frame stream and
+handles per-frame token sub-sequences (inference.py:25-73).
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config, SEQUENCE
+from ..models import build
+from ..models.ctx import Ctx
+from ..nd import NT
+
+
+def _logits(cfg: Config, params: dict, batch: typing.Dict[str, NT]) -> typing.Tuple[typing.Optional[jnp.ndarray], typing.Optional[jnp.ndarray]]:
+    ctx = Ctx(cfg, params=params, train=False, rng=None)
+    out = build(ctx, batch)
+    tok = out.token_out.x if out.token_out is not None else None
+    frame = out.frame_out.x if out.frame_out is not None else None
+    return tok, frame
+
+
+def _gumbel_argmax(logits: jnp.ndarray, temperature, key: jax.Array) -> jnp.ndarray:
+    u = jax.random.uniform(key, logits.shape, jnp.float32, 1e-9, 1.0)
+    noisy = logits.astype(jnp.float32) - temperature * jnp.log(-jnp.log(u))
+    return jnp.argmax(noisy, axis=-1).astype(jnp.int32)
+
+
+def autoregressive_text(cfg: Config, params: dict, token_x: NT,
+                        initial_pos: typing.Union[int, jnp.ndarray],
+                        temperature: typing.Optional[float] = None,
+                        end_iterations: typing.Optional[int] = None,
+                        rng: typing.Optional[jax.Array] = None) -> jnp.ndarray:
+    """Fill ``token_x`` from ``initial_pos`` to ``end_iterations``.
+
+    ``token_x``: int NT [batch, sequence, token_patch].  Returns the filled
+    int32 array of the same shape."""
+    temperature = (cfg.sampling_temperature if temperature is None
+                   else temperature)
+    end = cfg.sequence_length if end_iterations is None else end_iterations
+    rng = jax.random.key(0) if rng is None else rng
+    names = token_x.names
+    seq_axis = names.index(SEQUENCE)
+
+    batch_template = {"token_x": None,
+                      "token_y": NT(jnp.zeros_like(token_x.x), names)}
+
+    def body(carry):
+        pos, toks, key = carry
+        key, sub = jax.random.split(key)
+        batch = dict(batch_template)
+        batch["token_x"] = NT(toks, names)
+        logits, _ = _logits(cfg, params, batch)  # [b, seq, patch, vocab]
+        sampled = _gumbel_argmax(logits, jnp.float32(temperature), sub)
+        # shift +1 along sequence (zero-fill, not wrap-around — reference
+        # inference.py:94 shift(wrap=False)): position p receives the argmax
+        # of the logits at p-1
+        zeros = jnp.zeros_like(jax.lax.slice_in_dim(sampled, 0, 1, axis=seq_axis))
+        sampled = jnp.concatenate(
+            [zeros, jax.lax.slice_in_dim(sampled, 0, sampled.shape[seq_axis] - 1,
+                                         axis=seq_axis)], axis=seq_axis)
+        onehot = jax.nn.one_hot(pos, toks.shape[seq_axis], dtype=toks.dtype)
+        onehot = onehot.reshape((1, toks.shape[seq_axis])
+                                + (1,) * (toks.ndim - 2))
+        new_toks = (sampled * onehot + toks * (1 - onehot)).astype(toks.dtype)
+        return pos + 1, new_toks, key
+
+    def cond(carry):
+        pos, _, _ = carry
+        return pos < end
+
+    _, out, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(initial_pos, jnp.int32),
+                     token_x.x.astype(jnp.int32), rng))
+    return out
+
+
+def autoregressive_video(cfg: Config, params: dict,
+                         batch: typing.Dict[str, NT],
+                         initial_pos: typing.Optional[int] = None,
+                         rng: typing.Optional[jax.Array] = None
+                         ) -> typing.Tuple[typing.Optional[jnp.ndarray], jnp.ndarray]:
+    """Video (jannet) sampling: generated frames are blended back into the
+    input sequence; per-frame tokens are greedily decoded with padding-token
+    masking (reference inference.py:25-73)."""
+    pos0 = cfg.initial_autoregressive_position if initial_pos is None else initial_pos
+    rng = jax.random.key(0) if rng is None else rng
+    frame = batch["frame"]
+    fnames = frame.names
+    use_lang = cfg.use_language and "token_x" in batch
+
+    def body(carry):
+        pos, frame_x, tok_x, key = carry
+        b = dict(batch)
+        b["frame"] = NT(frame_x, fnames)
+        if use_lang:
+            b["token_x"] = NT(tok_x, batch["token_x"].names)
+        tok_logits, frame_out = _logits(cfg, params, b)
+        # frame_out covers positions [0, seq); write prediction for `pos`
+        # (frame stream has seq+1 entries, prediction at pos-1 predicts pos)
+        pad_width = [(0, 0)] * frame_x.ndim
+        pad_width[1] = (1, 0)
+        frame_pred = jnp.pad(frame_out.astype(frame_x.dtype), pad_width)
+        onehot = jax.nn.one_hot(pos, frame_x.shape[1], dtype=frame_x.dtype)
+        onehot = onehot.reshape((1, frame_x.shape[1]) + (1,) * (frame_x.ndim - 2))
+        new_frame = frame_pred * onehot + frame_x * (1 - onehot)
+        new_tok = tok_x
+        if use_lang:
+            sampled = jnp.argmax(tok_logits.astype(jnp.float32), -1).astype(
+                tok_x.dtype)
+            oh = onehot.reshape((1, frame_x.shape[1])
+                                + (1,) * (tok_x.ndim - 2)).astype(tok_x.dtype)
+            new_tok = sampled * oh + tok_x * (1 - oh)
+        return pos + 1, new_frame, new_tok, key
+
+    def cond(carry):
+        pos = carry[0]
+        return pos < cfg.time_patch_size
+
+    tok0 = (batch["token_x"].x.astype(jnp.int32) if use_lang
+            else jnp.zeros((), jnp.int32))
+    _, frame_filled, tok_filled, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(pos0, jnp.int32),
+                     frame.x.astype(cfg.calculation_dtype), tok0, rng))
+    return (tok_filled if use_lang else None), frame_filled
+
+
+def make_text_sampler(cfg: Config, params: dict):
+    """Jitted sampler: (token_x NT, initial_pos, temperature, rng,
+    end_iterations) -> int32 tokens.  initial_pos / temperature /
+    end_iterations are traced so one compilation serves every prompt and
+    response length (the reference feeds them via infeed placeholders,
+    src/run/dataloader_placement.py:234-271)."""
+
+    def fn(token_x: NT, initial_pos, temperature, rng, end_iterations=None):
+        end = (jnp.int32(cfg.sequence_length) if end_iterations is None
+               else end_iterations)
+        return autoregressive_text(cfg, params, token_x, initial_pos,
+                                   temperature, end_iterations=end, rng=rng)
+
+    return jax.jit(fn)

@@ -1,0 +1,197 @@
+"""CLI entry: run modes over a JSON config.
+
+Mirrors the reference CLI (/root/reference/main.py:12-30, src/main.py:36-166):
+``--model cfg.json --run_mode {train,sample,query,web_api,debug}``.  TPU
+bootstrap collapses from cluster-resolver/session plumbing to
+``jax.distributed.initialize`` (multi-host) + mesh construction; run-config
+and model-size artifacts are dumped next to checkpoints exactly like the
+reference (src/main.py:66-69, src/run/utils_run.py:108-113).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+import typing
+
+import numpy as np
+
+
+def parse_args(argv: typing.Optional[typing.Sequence[str]] = None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", type=str, required=True, help="JSON config path")
+    p.add_argument("--tpu", type=str, default="", help="unused on single host;"
+                   " 'host:port,rank,size' triggers jax.distributed.initialize")
+    p.add_argument("--run_mode", type=str, default="train",
+                   choices=["train", "sample", "query", "web_api", "debug"])
+    p.add_argument("--steps", type=int, default=0,
+                   help="override train_steps (0 = config value)")
+    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--debug_grad", action="store_true")
+    p.add_argument("--port", type=int, default=8000)
+    return p.parse_args(argv)
+
+
+def _init_distributed(tpu_arg: str) -> None:
+    if "," in tpu_arg:
+        import jax
+        addr, rank, size = tpu_arg.split(",")
+        jax.distributed.initialize(addr, int(size), int(rank))
+
+
+def _build_state(cfg, batch, mesh=None):
+    from .train import Checkpointer, Trainer, color_print
+    trainer = Trainer(cfg, mesh)
+    state = trainer.init(batch)
+    ckpt = None
+    data_state = None
+    if cfg.use_checkpointing:
+        ckpt = Checkpointer(os.path.join(cfg.model_path, "ckpt"),
+                            cfg.max_checkpoints_keep)
+        state, data_state = ckpt.restore(state)
+        color_print(f"restored step {int(state.step)} from checkpoints"
+                    if int(state.step) else "fresh initialization")
+    return trainer, state, ckpt, data_state
+
+
+def _dump_run_artifacts(cfg, trainer, params) -> None:
+    os.makedirs(cfg.model_path, exist_ok=True)
+    with open(os.path.join(cfg.model_path, "run_config.json"), "w") as f:
+        json.dump({k: str(v) for k, v in cfg.dict().items()}, f, indent=2)
+    census = trainer.param_census(params)
+    with open(os.path.join(cfg.model_path, "model_size.info"), "w") as f:
+        json.dump(census, f, indent=2)
+
+
+def train(cfg, args) -> None:
+    import jax
+    from .data import RunLog, dataset, to_global
+    from .data.synthetic import synthetic_text_batch
+    from .train import MetricWriter, color_print
+
+    have_data = bool(cfg.dataset_configs) and any(
+        __import__("glob").glob(d["path"]) for d in cfg.dataset_configs)
+    slice_index = jax.process_index()
+    slice_count = max(1, jax.process_count())
+    local_batch = cfg.train_batch_size // slice_count
+
+    if have_data:
+        pipe = dataset(cfg, local_batch, slice_index, slice_count)
+        batches = iter(pipe)
+        first_np = next(batches)
+    else:
+        color_print("no dataset files found; using synthetic data")
+        pipe = None
+        first_np = synthetic_text_batch(cfg, 0)
+
+    from .parallel import make_mesh
+    mesh = make_mesh(cfg)
+    trainer, state, ckpt, data_state = _build_state(
+        cfg, to_global(first_np, cfg, mesh), mesh)
+    step0 = int(state.step)
+    if pipe is not None and data_state and "pipeline" in data_state:
+        # resume the cursor on a *fresh* pipeline, then draw the first batch
+        # from the restored position (first_np above came from the start of
+        # the stream and was only used as the init template)
+        pipe = dataset(cfg, local_batch, slice_index, slice_count)
+        pipe.load_state_dict(data_state["pipeline"])
+        batches = iter(pipe)
+        first_np = next(batches)
+    elif pipe is None and step0:
+        first_np = synthetic_text_batch(cfg, step0)
+
+    _dump_run_artifacts(cfg, trainer, state.params)
+    writer = MetricWriter(cfg.model_path)
+    run_log = RunLog(cfg.model_path)
+    steps = args.steps or cfg.train_steps
+    rng = jax.random.key(cfg.data_seed)
+    t0 = time.time()
+    np_batch = first_np
+    for i in range(step0, steps):
+        gb = to_global(np_batch, cfg, trainer.mesh)
+        state, metrics = trainer.step(state, gb, jax.random.fold_in(rng, i))
+        writer.write(i, metrics)
+        if (i + 1) % 10 == 0:
+            rate = (i + 1 - step0) / (time.time() - t0)
+            color_print(f"step {i + 1} loss {float(metrics['loss']):.4f} "
+                        f"({rate:.2f} steps/s)")
+        if ckpt is not None and (i + 1) % cfg.steps_per_checkpoint == 0:
+            data_state = ({"pipeline": pipe.state_dict()} if pipe is not None
+                          else None)
+            ckpt.save(state, data_state)
+        if pipe is not None:
+            np_batch = next(batches)
+        else:
+            np_batch = synthetic_text_batch(cfg, i + 1)
+    if ckpt is not None:
+        ckpt.save(state, {"pipeline": pipe.state_dict()} if pipe else None)
+        ckpt.wait()
+    run_log.append(steps=steps - step0, batch_size=cfg.train_batch_size,
+                   slice_count=slice_count, ctx=cfg.sequence_length,
+                   grad_accumulation=cfg.grad_accumulation,
+                   interleave_size=cfg.interleaved_datasets,
+                   token_patch_size=cfg.token_patch_size)
+    run_log.save()
+    writer.close()
+
+
+def _params_for_serving(cfg):
+    from .utils import random_text_batch
+    batch = random_text_batch(cfg)
+    if cfg.use_checkpointing:
+        from .train import Checkpointer, Trainer
+        state = Trainer(cfg).init(batch)
+        state, _ = Checkpointer(os.path.join(cfg.model_path, "ckpt")).restore(state)
+        return state.params
+    from .models import init_params
+    params, _ = init_params(cfg, batch)
+    return params
+
+
+def sample(cfg, args) -> None:
+    from .serve import CompletionEngine, render_text_samples
+    params = _params_for_serving(cfg)
+    engine = CompletionEngine(cfg, params)
+    for i in range(cfg.num_of_sample):
+        out = engine.complete_tokens([int(cfg.concat_token)])
+        render_text_samples(out[None], engine.tokenizer)
+
+
+def query(cfg, args) -> None:
+    from .serve import repl
+    repl(cfg, _params_for_serving(cfg))
+
+
+def web_api(cfg, args) -> None:
+    from .serve import serve as rest_serve
+    print(f"serving on :{args.port}")
+    rest_serve(cfg, _params_for_serving(cfg), port=args.port)
+
+
+def debug(cfg, args) -> None:
+    """Self-similarity nondeterminism check (reference interface.py:283-302)."""
+    from .serve import CompletionEngine, similarity_score
+    params = _params_for_serving(cfg)
+    engine = CompletionEngine(cfg, params)
+    prompt = list(range(min(16, cfg.vocab_size)))
+    samples = [engine.complete_tokens(prompt, temperature=0.0)
+               for _ in range(max(2, min(4, cfg.equal_debugging_items_per_check)))]
+    score = similarity_score([np.asarray(s) for s in samples])
+    print(f"similarity: {score * 100:.2f}%")
+    if score < 1.0:
+        raise SystemExit("nondeterministic sampling detected")
+
+
+RUN_MODE_FNS = {"train": train, "sample": sample, "query": query,
+                "web_api": web_api, "debug": debug}
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> None:
+    args = parse_args(argv)
+    _init_distributed(args.tpu)
+    from .config import Config
+    cfg = Config.from_json(args.model)
+    if args.debug_grad:
+        cfg.debug_gradients = True
+    RUN_MODE_FNS[args.run_mode](cfg, args)

@@ -54,6 +54,20 @@ def make_mesh(cfg: Config,
               ) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     sizes = axis_sizes(cfg, len(devices))
+    batch = cfg.train_batch_size
+    if batch % sizes[DATA_AXIS]:
+        # the data axis cannot exceed what the batch can shard over; drop to
+        # the largest batch divisor and leave surplus devices out of the mesh
+        data = max(d for d in range(1, sizes[DATA_AXIS] + 1)
+                   if batch % d == 0)
+        print(f"WARNING: data axis shrunk from {sizes[DATA_AXIS]} to {data} "
+              f"(train_batch_size={batch}); "
+              f"{(sizes[DATA_AXIS] - data) * sizes[SEQ_AXIS] * sizes[PIPE_AXIS] * sizes[MODEL_AXIS]}"
+              " device(s) left unused")
+        sizes[DATA_AXIS] = data
     names = (DATA_AXIS, SEQ_AXIS, PIPE_AXIS, MODEL_AXIS)
-    grid = np.asarray(devices).reshape([sizes[n] for n in names])
+    n_used = 1
+    for n in names:
+        n_used *= sizes[n]
+    grid = np.asarray(devices[:n_used]).reshape([sizes[n] for n in names])
     return Mesh(grid, names)

@@ -1,0 +1,9 @@
+"""Serving layer: completion engine, async wrapper, REPL, REST API, sample
+renderers, similarity debug (JAX re-design of /root/reference/src/
+interface.py + src/rest_api.py)."""
+from .interface import (ByteTokenizer, CompletionEngine,  # noqa: F401
+                        InterfaceWrapper, tokenizer_for)
+from .repl import repl  # noqa: F401
+from .rest import RestAPI, serve  # noqa: F401
+from .sample import (depatchify, render_text_samples, render_video,  # noqa: F401
+                     similarity_score)

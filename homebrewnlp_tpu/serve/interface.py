@@ -1,0 +1,142 @@
+"""Serving bridge: tokenizers, completion engine, async wrapper.
+
+The reference couples serving to the TF session loop through a
+multiprocessing-Manager queue (``InterfaceWrapper``, /root/reference/src/
+interface.py:231-280); in JAX the sampler is an ordinary jitted function, so
+the engine is a plain object and the async wrapper is a worker thread + queue
+(same API: blocking or async ``complete``).
+
+Tokenizers mirror the reference's two modes (interface.py:184-198): raw
+byte-level for vocab<=256, HuggingFace GPT2 BPE otherwise.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import typing
+
+import jax
+import numpy as np
+
+from ..config import Config
+from ..data.feed import TEXT_AXES
+from ..infer.sampler import make_text_sampler
+from ..nd import NT
+
+
+class ByteTokenizer:
+    def encode(self, text: str) -> typing.List[int]:
+        return list(text.encode("utf-8", errors="replace"))
+
+    def decode(self, ids: typing.Sequence[int]) -> str:
+        return bytes(int(i) & 0xFF for i in ids).decode("utf-8", errors="replace")
+
+
+class Gpt2Tokenizer:
+    def __init__(self):
+        from transformers import GPT2TokenizerFast
+        self._tok = GPT2TokenizerFast.from_pretrained("gpt2")
+
+    def encode(self, text: str) -> typing.List[int]:
+        return self._tok.encode(text)
+
+    def decode(self, ids: typing.Sequence[int]) -> str:
+        return self._tok.decode(list(ids))
+
+
+def tokenizer_for(cfg: Config):
+    if cfg.vocab_size <= 256:
+        return ByteTokenizer()
+    try:
+        return Gpt2Tokenizer()
+    except Exception:  # offline image: fall back to bytes
+        return ByteTokenizer()
+
+
+class CompletionEngine:
+    """Jit-compiled prompt completion (the reference's query loop,
+    interface.py:177-220, with the padding behavior of ``complete``:
+    the prompt is padded to full context with random tokens which the sampler
+    overwrites)."""
+
+    def __init__(self, cfg: Config, params: dict):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer_for(cfg)
+        self._sampler = make_text_sampler(cfg, params)
+        self._rng = jax.random.key(cfg.data_seed)
+
+    def complete_tokens(self, prompt: typing.Sequence[int],
+                        temperature: typing.Optional[float] = None,
+                        max_tokens: typing.Optional[int] = None) -> np.ndarray:
+        """Returns the flat token stream (prompt + completion), truncated to
+        ``len(prompt) + max_tokens`` tokens.  The sampler works in rows of
+        ``token_patch_size`` tokens; the prompt is laid out row-major and the
+        loop stops at the last row needed."""
+        cfg = self.cfg
+        patch = cfg.token_patch_size
+        rows = cfg.sequence_length // patch
+        prompt = list(prompt)[:rows * patch]
+        self._rng, pad_key, sample_key = jax.random.split(self._rng, 3)
+        flat = jax.random.randint(pad_key, (rows * patch,), 0, cfg.vocab_size)
+        flat = flat.at[:len(prompt)].set(np.asarray(prompt, np.int32))
+        toks = flat.reshape(1, rows, patch)
+        prompt_rows = len(prompt) // patch
+        if max_tokens is None:
+            end_row = rows
+        else:
+            end_row = min(rows, -(-(len(prompt) + max_tokens) // patch))
+        out = self._sampler(
+            NT(toks, TEXT_AXES), np.int32(prompt_rows),
+            np.float32(cfg.sampling_temperature if temperature is None
+                       else temperature),
+            sample_key, np.int32(end_row))
+        out = np.asarray(out).reshape(-1)
+        end = (rows * patch if max_tokens is None
+               else min(rows * patch, len(prompt) + max_tokens))
+        return out[:end]
+
+    def complete_text(self, prompt: str, temperature=None, max_tokens=None) -> str:
+        ids = self.tokenizer.encode(prompt)
+        out = self.complete_tokens(ids, temperature, max_tokens)
+        return self.tokenizer.decode(out[len(ids):])
+
+
+class InterfaceWrapper:
+    """Async facade over the engine (reference interface.py:231-280):
+    ``complete(..., asynchronous=True)`` returns a handle whose ``fetch()``
+    blocks for the result."""
+
+    def __init__(self, engine: CompletionEngine):
+        self.engine = engine
+        self._q: "queue.Queue[tuple]" = queue.Queue()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, args, out = item
+            try:
+                out.put(("ok", fn(*args)))
+            except Exception as e:  # propagate to caller
+                out.put(("err", e))
+
+    def complete(self, prompt: typing.Sequence[int], temperature: float = 0.0,
+                 response_len: int = 64, asynchronous: bool = False):
+        out: "queue.Queue[tuple]" = queue.Queue(1)
+        self._q.put((self.engine.complete_tokens,
+                     (prompt, temperature, response_len), out))
+
+        def fetch():
+            status, value = out.get()
+            if status == "err":
+                raise value
+            return value
+
+        return fetch if asynchronous else fetch()
+
+    def close(self):
+        self._q.put(None)

@@ -1,0 +1,20 @@
+"""Interactive query REPL (the reference's ``query`` run mode,
+/root/reference/src/interface.py:177-220): read a prompt, print the
+completion, loop."""
+from __future__ import annotations
+
+from ..config import Config
+from .interface import CompletionEngine
+
+
+def repl(cfg: Config, params: dict) -> None:
+    engine = CompletionEngine(cfg, params)
+    print("homebrewnlp_tpu query REPL — empty line to exit")
+    while True:
+        try:
+            prompt = input("> ")
+        except (EOFError, KeyboardInterrupt):
+            return
+        if not prompt:
+            return
+        print(engine.complete_text(prompt))

@@ -1,0 +1,98 @@
+"""REST API on the stdlib HTTP server.
+
+Endpoint-compatible with the reference's FastAPI app (/root/reference/src/
+rest_api.py:13-89): POST /encode {prompt}, /decode {prompt: [ids]},
+/token_completion {prompt|tokens, temperature, response_len, asynchronous},
+/completion (same, returns text), /check_tokens.  fastapi/uvicorn are not in
+the image, so this uses ``http.server.ThreadingHTTPServer`` — zero deps, and
+the threaded wrapper serializes sampler calls exactly like the reference's
+Manager-queue bridge.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import typing
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..config import Config
+from .interface import CompletionEngine, InterfaceWrapper
+
+
+def _sanitize_tokens(tokens: typing.Sequence[int], vocab: int) -> typing.List[int]:
+    # the reference clamps out-of-vocab ids (rest_api.py:42-53)
+    return [min(max(int(t), 0), vocab - 1) for t in tokens]
+
+
+class RestAPI:
+    def __init__(self, cfg: Config, params: dict):
+        self.cfg = cfg
+        self.engine = CompletionEngine(cfg, params)
+        self.wrapper = InterfaceWrapper(self.engine)
+
+    # -- endpoints -----------------------------------------------------------
+    def encode(self, body: dict) -> dict:
+        return {"tokens": self.engine.tokenizer.encode(body["prompt"])}
+
+    def decode(self, body: dict) -> dict:
+        toks = _sanitize_tokens(body["prompt"], self.cfg.vocab_size)
+        return {"completion": self.engine.tokenizer.decode(toks)}
+
+    def check_tokens(self, body: dict) -> dict:
+        toks = body["prompt"]
+        return {"tokens": _sanitize_tokens(toks, self.cfg.vocab_size)}
+
+    def token_completion(self, body: dict) -> dict:
+        toks = _sanitize_tokens(body.get("prompt", body.get("tokens", [])),
+                                self.cfg.vocab_size)
+        out = self.wrapper.complete(
+            toks, float(body.get("temperature", self.cfg.sampling_temperature)),
+            int(body.get("response_len", 64)))
+        return {"completion": np.asarray(out).tolist()}
+
+    def completion(self, body: dict) -> dict:
+        ids = self.engine.tokenizer.encode(body["prompt"])
+        out = self.wrapper.complete(
+            ids, float(body.get("temperature", self.cfg.sampling_temperature)),
+            int(body.get("response_len", 64)))
+        return {"completion": self.engine.tokenizer.decode(
+            np.asarray(out)[len(ids):])}
+
+    ENDPOINTS = ("encode", "decode", "check_tokens", "token_completion",
+                 "completion")
+
+
+def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
+          port: int = 8000, background: bool = False):
+    api = RestAPI(cfg, params)
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            name = self.path.strip("/")
+            if name not in RestAPI.ENDPOINTS:
+                self.send_error(404)
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                result = getattr(api, name)(body)
+                payload = json.dumps(result).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+            except Exception as e:
+                self.send_error(500, str(e))
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    if background:
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return server
+    server.serve_forever()

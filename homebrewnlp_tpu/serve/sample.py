@@ -1,0 +1,65 @@
+"""Sample rendering + debug similarity mode.
+
+- ``render_text_samples``: the text branch of the reference's
+  ``gen_sample_fn`` (/root/reference/src/interface.py:101-174) — prints or
+  returns decoded continuations.
+- ``render_video``: depatchify + write ``.avi`` via OpenCV
+  (interface.py:13-98), gated on cv2.
+- ``similarity_score``: the reference's ``debug`` run mode
+  (interface.py:283-302): N greedy samples from identical prompts must agree;
+  the %-agreement is an end-to-end nondeterminism detector.
+"""
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+from ..config import Config
+
+
+def render_text_samples(tokens: np.ndarray, tokenizer,
+                        printer: typing.Callable[[str], None] = print
+                        ) -> typing.List[str]:
+    outs = []
+    for row in np.asarray(tokens):
+        text = tokenizer.decode(row.reshape(-1))
+        outs.append(text)
+        printer(text)
+    return outs
+
+
+def depatchify(cfg: Config, frames: np.ndarray) -> np.ndarray:
+    """[t, hp, wp, P*P*C] -> [t, H, W, C] (inverse of the decoder transpose,
+    reference interface.py:61-98 / inputs.py:188-191)."""
+    t = frames.shape[0]
+    p = cfg.patch_size
+    frames = frames.reshape(t, cfg.frame_height_patch, cfg.frame_width_patch,
+                            p, p, cfg.color_channels)
+    # inverse of transpose(1,3,0,2,4): patch dims lead in memory
+    frames = frames.reshape(t, p, p, cfg.frame_height_patch,
+                            cfg.frame_width_patch, cfg.color_channels)
+    frames = frames.transpose(0, 3, 1, 4, 2, 5)
+    return frames.reshape(t, cfg.frame_height_patch * p,
+                          cfg.frame_width_patch * p, cfg.color_channels)
+
+
+def render_video(cfg: Config, frames: np.ndarray, path: str,
+                 fps: int = 8) -> str:
+    import cv2
+    imgs = depatchify(cfg, np.asarray(frames, np.float32))
+    imgs = np.clip(imgs * 255, 0, 255).astype(np.uint8)
+    h, w = imgs.shape[1:3]
+    writer = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"MJPG"), fps, (w, h))
+    for img in imgs:
+        writer.write(cv2.cvtColor(img, cv2.COLOR_RGB2BGR))
+    writer.release()
+    return path
+
+
+def similarity_score(samples: typing.Sequence[np.ndarray]) -> float:
+    """% agreement of supposedly-identical greedy samples (reference
+    interface.py:283-302)."""
+    base = np.asarray(samples[0])
+    agree = [float(np.mean(np.asarray(s) == base)) for s in samples[1:]]
+    return float(np.mean(agree)) if agree else 1.0

@@ -25,10 +25,10 @@ def random_text_batch(cfg, seed: int = 0) -> typing.Dict[str, typing.Any]:
     """Uniform-random token batch as NTs (model input shape, reference
     dataclass.py:310-337 text entries)."""
     import jax
+    from ..data.feed import TEXT_AXES as names
     from ..nd import NT
     shape = (cfg.train_batch_size, cfg.sequence_length // cfg.token_patch_size,
              cfg.token_patch_size)
-    names = ("batch", "sequence", "language_token_patch")
     kx, ky = jax.random.split(jax.random.key(seed))
     return {
         "token_x": NT(jax.random.randint(kx, shape, 0, cfg.vocab_size), names),

@@ -1,0 +1,8 @@
+"""homebrewnlp_tpu launcher (reference: /root/reference/main.py).
+
+Usage: python3 main.py --model configs/32big_mixer.json --run_mode train
+"""
+from homebrewnlp_tpu.main import main
+
+if __name__ == "__main__":
+    main()

@@ -104,6 +104,38 @@ def test_interleave_deterministic_and_resumable(tmp_path):
     assert got == full
 
 
+def test_shuffled_pipeline_resume(tmp_path):
+    """Resume with shuffling must reproduce the exact continuation (buffer
+    contents rebuilt by replay)."""
+    cfg = mixer_config(sequence_length=16, use_random_dataloader=True,
+                       shuffle_buffer=8, interleaved_datasets=2)
+    paths = write_text_tfrecords(str(tmp_path), 3, 2, 100, seed=13)
+
+    def make():
+        return GptPipeline(cfg, sub_batch_size=2, paths=paths)
+
+    it = iter(make_pipe := make())
+    consumed = [next(it) for _ in range(4)]
+    state = make_pipe.state_dict()
+    expected = [next(it)["token_x"].tobytes() for _ in range(3)]
+    fresh = make()
+    fresh.load_state_dict(state)
+    got = []
+    it2 = iter(fresh)
+    got = [next(it2)["token_x"].tobytes() for _ in range(3)]
+    assert got == expected
+    assert consumed
+
+
+def test_mixture_continues_after_child_exhausts():
+    a = [{"x": np.full(1, 0)}] * 5
+    b = [{"x": np.full(1, 1)}] * 50
+    out = [int(m["x"][0]) for m in MixturePipeline([a, b], [1, 1], seed=3)]
+    # all 55 elements are yielded; the mixture doesn't stop when `a` drains
+    assert len(out) == 55
+    assert out.count(0) == 5 and out.count(1) == 50
+
+
 def test_mixture_weights_and_determinism():
     a = [{"x": np.full(1, 0)}] * 300
     b = [{"x": np.full(1, 1)}] * 300

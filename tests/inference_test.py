@@ -1,0 +1,149 @@
+"""Inference + serving tests: sampler determinism/prompt preservation,
+greedy self-consistency (the reference's debug mode as a test), completion
+engine, REST API over a live socket, CLI train mode end-to-end."""
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from homebrewnlp_tpu.infer import autoregressive_text, make_text_sampler
+from homebrewnlp_tpu.models import init_params
+from homebrewnlp_tpu.nd import NT
+from homebrewnlp_tpu.serve import (CompletionEngine, InterfaceWrapper,
+                                   similarity_score)
+from homebrewnlp_tpu.serve.interface import TEXT_AXES
+from homebrewnlp_tpu.utils import random_text_batch
+
+from .backend import mixer_config
+
+
+def _small_cfg(**over):
+    base = dict(depth=1, sequence_length=12, heads=2, features_per_head=16,
+                vocab_size=32, train_batch_size=1,
+                initial_autoregressive_position=4, sampling_temperature=0.0)
+    base.update(over)
+    return mixer_config(**base)
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = _small_cfg()
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    return cfg, params
+
+
+def test_sampler_preserves_prompt_and_fills(cfg_params):
+    cfg, params = cfg_params
+    toks = jnp_toks = np.zeros((1, cfg.sequence_length, 1), np.int32)
+    toks[0, :4, 0] = [5, 9, 3, 7]
+    out = autoregressive_text(cfg, params, NT(jax.numpy.asarray(toks), TEXT_AXES),
+                              initial_pos=4, temperature=0.0,
+                              rng=jax.random.key(0))
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[0, :4, 0], [5, 9, 3, 7])
+    assert (out[0, 4:, 0] < cfg.vocab_size).all()
+
+
+def test_greedy_sampling_deterministic(cfg_params):
+    """Greedy samples from identical prompts must agree 100% (the debug run
+    mode's property, reference interface.py:283-302)."""
+    cfg, params = cfg_params
+    sampler = make_text_sampler(cfg, params)
+    toks = np.zeros((1, cfg.sequence_length, 1), np.int32)
+    outs = [np.asarray(sampler(NT(jax.numpy.asarray(toks), TEXT_AXES),
+                               np.int32(2), np.float32(0.0),
+                               jax.random.key(i)))
+            for i in range(3)]
+    assert similarity_score(outs) == 1.0
+
+
+def test_temperature_changes_samples(cfg_params):
+    cfg, params = cfg_params
+    sampler = make_text_sampler(cfg, params)
+    toks = np.zeros((1, cfg.sequence_length, 1), np.int32)
+    a = np.asarray(sampler(NT(jax.numpy.asarray(toks), TEXT_AXES), np.int32(1),
+                           np.float32(5.0), jax.random.key(1)))
+    b = np.asarray(sampler(NT(jax.numpy.asarray(toks), TEXT_AXES), np.int32(1),
+                           np.float32(5.0), jax.random.key(2)))
+    assert not np.array_equal(a, b)
+
+
+def test_completion_engine_text_roundtrip(cfg_params):
+    cfg, params = cfg_params
+    engine = CompletionEngine(cfg, params)
+    out = engine.complete_tokens([1, 2, 3], temperature=0.0, max_tokens=4)
+    assert list(out[:3]) == [1, 2, 3]
+    assert len(out) == 7
+    wrapper = InterfaceWrapper(engine)
+    sync = wrapper.complete([1, 2, 3], response_len=4)
+    fetch = wrapper.complete([1, 2, 3], response_len=4, asynchronous=True)
+    np.testing.assert_array_equal(np.asarray(sync), np.asarray(fetch()))
+    wrapper.close()
+
+
+def test_rest_api_endpoints(cfg_params):
+    cfg, params = cfg_params
+    from homebrewnlp_tpu.serve import serve
+    server = serve(cfg, params, port=0, background=True)
+    port = server.server_address[1]
+
+    def post(path, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    enc = post("encode", {"prompt": "ab"})
+    assert enc["tokens"] == [97, 98] or isinstance(enc["tokens"], list)
+    dec = post("decode", {"prompt": [1, 2, 999999]})
+    assert isinstance(dec["completion"], str)
+    chk = post("check_tokens", {"prompt": [0, 31, 32, -5]})
+    assert chk["tokens"] == [0, 31, 31, 0]
+    comp = post("token_completion", {"prompt": [1, 2], "temperature": 0.0,
+                                     "response_len": 3})
+    assert comp["completion"][:2] == [1, 2]
+    server.shutdown()
+
+
+def test_video_sampler_runs():
+    from homebrewnlp_tpu.infer import autoregressive_video
+    cfg = mixer_config(model_mode="jannet", use_video=True, use_language=False,
+                       frame_height=32, frame_width=32, patch_size=16,
+                       sequence_length=4, experts=1, depth=1, heads=2,
+                       features_per_head=16, train_batch_size=1,
+                       initial_autoregressive_position=1)
+    frames = np.random.default_rng(0).random(
+        (1, 5, 2, 2, 16 * 16 * 3), np.float32)
+    batch = {"frame": NT(jax.numpy.asarray(frames),
+                         ("batch", "_sequence", "height", "width",
+                          "color_channels"))}
+    params, _ = init_params(cfg, batch)
+    _, filled = jax.jit(lambda p, b: autoregressive_video(cfg, p, b))(params, batch)
+    assert np.isfinite(np.asarray(filled, np.float32)).all()
+
+
+def test_cli_train_synthetic(tmp_path, capsys):
+    from homebrewnlp_tpu.main import main
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(dict(
+        model_mode="gpt", use_video=False, sequence_length=12, heads=2,
+        features_per_head=16, depth=1, vocab_size=32, train_batch_size=2,
+        memory_reduction_strategy="none", optimizer="adam-learning_rate",
+        intermediate_feed_forward_multiplier_multiplier=0.5,
+        block_config=[{"layer": ["norm-shift-scale", "feed_forward-in:relu"]}],
+        model_path=str(tmp_path / "run"), use_checkpointing=True,
+        steps_per_checkpoint=5)))
+    main(["--model", str(cfg_path), "--run_mode", "train", "--steps", "6"])
+    assert (tmp_path / "run" / "run_config.json").exists()
+    assert (tmp_path / "run" / "model_size.info").exists()
+    assert (tmp_path / "run" / "metrics.jsonl").exists()
+    assert (tmp_path / "run" / "data_log.json").exists()
+    # resume: second invocation restores step 6 and continues to 8
+    main(["--model", str(cfg_path), "--run_mode", "train", "--steps", "8"])
+    lines = [json.loads(l) for l in
+             (tmp_path / "run" / "metrics.jsonl").read_text().splitlines()]
+    assert lines[-1]["step"] == 7
