@@ -169,7 +169,7 @@ def _body(ctx: Ctx, src: NT) -> NT:
 
             def f(subparams: dict, x: NT) -> NT:
                 bctx = Ctx(cfg, params=subparams, train=ctx.train, seed=ctx.seed,
-                           rng=rng)
+                           rng=rng, mesh=ctx.mesh)
                 bctx._scope = [mode_scope, "body"]
                 bctx.attention_idx = a_start
                 with bctx.scope(_block_scope(i, c)):

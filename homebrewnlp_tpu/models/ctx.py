@@ -39,9 +39,10 @@ class Ctx:
 
     def __init__(self, cfg: Config, params: typing.Optional[dict] = None,
                  seed: int = 0, train: bool = True,
-                 rng: typing.Optional[jax.Array] = None):
+                 rng: typing.Optional[jax.Array] = None, mesh=None):
         self.cfg = cfg
         self.params = params  # None => init (collect) mode
+        self.mesh = mesh  # device mesh for shard_map islands (ring attention)
         self.collected: typing.Dict[str, jnp.ndarray] = {}
         self.axis_names: typing.Dict[str, typing.Tuple[str, ...]] = {}
         self.train = train

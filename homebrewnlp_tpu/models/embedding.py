@@ -44,8 +44,11 @@ def _multi_dim_flat_index(sizes: typing.Sequence[int], dtype) -> jnp.ndarray:
 def relative_embedding(args: Args, position_dims: typing.Sequence[Dim],
                        feature_dims: typing.Sequence[Dim], out_dims: typing.Sequence[Dim]
                        ) -> NT:
-    """Sinusoidal position embedding (reference embedding.py:140-172):
-    sin(pos_index * exp(flat_feature_index + 4/n_feat - log(n_pos/2pi))) * std."""
+    """Sinusoidal position embedding:
+    ``sin(pos_index * exp(4*flat_feature_index/n_feat - log(n_pos/2pi))) * std``
+    — geometric frequencies over the flattened feature grid.  Diverges from
+    the reference (embedding.py:140-172), whose additive ``+ 4/n_feat`` form
+    overflows float32 for n_feat > ~89; see the inline note below."""
     cfg = args.cfg
     dtype = cfg.calculation_dtype
     pos_sizes = [s for _, s in position_dims]
@@ -66,7 +69,15 @@ def relative_embedding(args: Args, position_dims: typing.Sequence[Dim],
         additive = additive * math.pi
         feature_count /= 2
 
-    features = features + 4.0 / feature_count
+    # Documented divergence: the reference computes
+    # ``exp(flat_feature_index + 4/n_feat - log(n_pos/2pi))``
+    # (embedding.py:166-168), which overflows float32 (-> inf -> sin=NaN) for
+    # any feature count above ~89 — a latent upstream bug its shipped mixer
+    # configs never hit (they use absolute bias-map embeddings).  The
+    # geometric-frequency reading ``exp(4*i/n_feat - log(n_pos/2pi))`` gives
+    # wavelengths from n_pos/2pi down to n_pos/(2pi*e^4), matches the
+    # reference's magnitude for small feature counts, and stays finite.
+    features = features * (4.0 / feature_count)
     features = features - math.log(position_count / 2.0 / math.pi)
     features = jnp.exp(features) + additive
 

@@ -236,6 +236,67 @@ def _masked_map(args: Args) -> typing.Tuple[NT, typing.Union[NT, int]]:
     return bias, mask
 
 
+def _ring_eligible(args: Args, dim: str) -> bool:
+    """Sequence-parallel ring attention replaces the plain dot-product
+    softmax path when the mesh has a sequence axis; the learned-bias-map
+    variants keep the GSPMD path (their seq x seq parameters are row-sharded
+    instead)."""
+    from ..parallel.mesh import SEQ_AXIS
+    mesh = args.ctx.mesh
+    return (mesh is not None
+            and args.ctx.params is not None
+            and mesh.shape.get(SEQ_AXIS, 1) > 1
+            and dim == SEQUENCE
+            and "dot_product" in args
+            # the ring kernel is rank-4 (batch, seq, heads, key); video
+            # tensors with height/width axes keep the GSPMD path
+            and set(args.tensor.names) == {args.tensor.names[0], dim,
+                                           HEADS, KEY}
+            and not any(f in args for f in ("biased_softmax",
+                                            "biased_attention_map",
+                                            "scale_attention_map")))
+
+
+def _qkv(args: Args, base: typing.Optional[Args], dim: str
+         ) -> typing.Tuple[typing.Optional[NT], typing.Optional[NT], NT]:
+    """Q/K/V construction shared by the dense and ring attention paths: key
+    source selection (embedded/context/positional), query scaling, value
+    source (shared_key_value/input_as_value/linear)."""
+    cfg = args.cfg
+    t = args.tensor
+    qry = key = None
+    if "dot_product" in args:
+        if "embedded" in args or "context" in args:
+            key = activated_linear_out(base)
+        if "embedded" in args or "positional" in args:
+            fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+            pos = embed(args, [(dim, t.dim_size(dim))] + fdims)
+            key = pos if key is None else key + pos
+        qry = activated_linear_out(base) * (t.dim_size(dim) ** -0.5)
+    if "dot_product" in args and "shared_key_value" in args:
+        val = key
+    elif "input_as_value" in args:
+        val = t
+    else:
+        val = activated_linear_out(base)
+    return qry, key, val
+
+
+def _ring_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
+    """Dot-product attention over the sequence-parallel ring (ops/ring.py)."""
+    from ..ops.ring import ring_attention
+    from ..parallel.mesh import SEQ_AXIS
+    from ..parallel.sharding import spec_for
+    t = args.tensor
+    order = (t.names[0], dim, HEADS, KEY)
+    mesh = args.ctx.mesh
+    spec = spec_for(order, mesh)
+    out = ring_attention(qry.transpose_to(order).x, key.transpose_to(order).x,
+                         val.transpose_to(order).x, mesh, SEQ_AXIS, spec,
+                         causal=True)
+    return NT(out, order).transpose_to(t.names)
+
+
 def attention(args: Args) -> NT:
     """Composable attention (reference spatial.py:42-81): optional QK^T
     softmax path, learned bias/scale attention maps, causal masking, and
@@ -249,34 +310,25 @@ def attention(args: Args) -> NT:
         base = args(activated_linear_in(args))
 
     dim = get_attention_dim(args).dim
+    qry, key, val_src = _qkv(args, base, dim)
+    if _ring_eligible(args, dim):
+        return _ring_attention(args, qry, key, val_src, dim)
     tmp = anonymize_name(dim)
     t = args.tensor
     shape_names = t.names
+    val = val_src.rename(dim, tmp)
 
     logit: typing.Optional[NT] = None
-    val: typing.Optional[NT] = None
-    key: typing.Optional[NT] = None
 
     def _biased(a: Args) -> NT:
         bias, mask = _masked_map(a)
         return bias * mask if isinstance(mask, NT) else bias
 
     if "dot_product" in args:
-        if "embedded" in args or "context" in args:
-            key = activated_linear_out(base)
-        if "embedded" in args or "positional" in args:
-            fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
-            pos = embed(args, [(dim, t.dim_size(dim))] + fdims)
-            key = pos if key is None else key + pos
-        qry = activated_linear_out(base)
-        qry = qry * (t.dim_size(dim) ** -0.5)
         old, _ = linear_shapes(args)
         contracted = [n for n, _ in old if n != HEADS]
         logit_names = tuple(n for n in shape_names if n not in contracted) + (tmp,)
-        key_anon = key.rename(dim, tmp)
-        logit = nd.einsum([qry, key_anon], logit_names)
-        if "shared_key_value" in args:
-            val = key.rename(dim, tmp)
+        logit = nd.einsum([qry, key.rename(dim, tmp)], logit_names)
     if "biased_softmax" in args:
         b = _biased(args)
         logit = b if logit is None else logit + b
@@ -293,9 +345,6 @@ def attention(args: Args) -> NT:
     if "scale_attention_map" in args:
         b = _biased(args)
         logit = b if logit is None else logit * b
-    if val is None:
-        src = t if "input_as_value" in args else activated_linear_out(base)
-        val = src.rename(dim, tmp)
     if logit is None:
         raise UserWarning(f"no spatial mixing in attention: {args.name_extras}")
     return nd.einsum([logit, val], shape_names)

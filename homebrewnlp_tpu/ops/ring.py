@@ -1,0 +1,96 @@
+"""Ring attention: sequence-parallel exact attention over the mesh.
+
+The reference has no sequence parallelism at all — long context is attacked
+with memory-reduction tricks only (SURVEY.md §5.7); this module is the
+TPU-native extension that makes the ``sequence_parallel`` mesh axis
+first-class.  Design (Liu et al. 2023 ring attention / flash-style online
+softmax): queries stay put, K/V blocks rotate around the ring via
+``jax.lax.ppermute`` over ICI; each hop contracts the local Q block against
+the visiting K/V block and folds the result into running (max, denominator,
+accumulator) statistics, so the full softmax is exact while no device ever
+holds more than one (s_local x s_local) logit block.
+
+Used from models/layers.attention through ``shard_map`` when the mesh's
+sequence axis is >1 and the layer is plain dot-product attention; the
+bias-map mixer variants keep the GSPMD path (their learned seq x seq maps are
+row-sharded parameters instead).
+"""
+from __future__ import annotations
+
+import functools
+import typing
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2e38  # the reference's mask value (spatial.py:68)
+
+
+def _block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+           row0: jnp.ndarray, col0: jnp.ndarray, causal: bool,
+           m: jnp.ndarray, l: jnp.ndarray, acc: jnp.ndarray):
+    """Fold one K/V block into the running softmax statistics.
+
+    q [b, sq, h, d]; k/v [b, sk, h, d]; m/l [b, h, sq]; acc [b, sq, h, d];
+    row0/col0 are the global offsets of the local q rows / visiting k cols.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+    if causal:
+        rows = row0 + jnp.arange(q.shape[1])
+        cols = col0 + jnp.arange(k.shape[1])
+        mask = rows[:, None] >= cols[None, :]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    block_max = jnp.max(logits, axis=-1)  # [b, h, q]
+    new_m = jnp.maximum(m, block_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(logits - new_m[..., None])  # [b, h, q, k]
+    new_l = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    new_acc = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return new_m, new_l, new_acc
+
+
+def ring_attention_kernel(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          axis_name: str, causal: bool = True) -> jnp.ndarray:
+    """Per-shard body (run under shard_map): exact attention over the ring.
+
+    All inputs are local blocks [b, s_local, h, d] of the sequence-sharded
+    global arrays; returns the local output block."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    row0 = idx * s_local
+
+    m = jnp.full(q.shape[:1] + (q.shape[2], s_local), NEG_INF,
+                 jnp.float32)  # [b, h, sq]
+    l = jnp.zeros_like(m)
+    acc = jnp.zeros(q.shape, jnp.float32)
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+
+    # hop 0: own block, no rotation; hops 1..n-1 rotate first then fold, so
+    # exactly n-1 ppermute pairs ride the ring
+    m, l, acc = _block(qf, kf, vf, row0, idx * s_local, causal, m, l, acc)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def hop(i, carry):
+        kf, vf, m, l, acc = carry
+        kf = jax.lax.ppermute(kf, axis_name, perm)
+        vf = jax.lax.ppermute(vf, axis_name, perm)
+        col_shard = (idx - i) % n  # whose K/V block is visiting
+        m, l, acc = _block(qf, kf, vf, row0, col_shard * s_local, causal,
+                           m, l, acc)
+        return kf, vf, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(1, n, hop, (kf, vf, m, l, acc))
+    out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, seq_axis: str, spec, causal: bool = True):
+    """shard_map wrapper: q/k/v are global [b, s, h, d] arrays inside jit;
+    ``spec`` is their full PartitionSpec (batch/seq/heads dims per the
+    caller's sharding rules — heads stay model-sharded inside the kernel)."""
+    kernel = functools.partial(ring_attention_kernel, axis_name=seq_axis,
+                               causal=causal)
+    return jax.shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

@@ -77,7 +77,7 @@ class Trainer:
 
     # -- loss / gradients ----------------------------------------------------
     def _losses(self, params, batch, rng):
-        ctx = Ctx(self.cfg, params=params, train=True, rng=rng)
+        ctx = Ctx(self.cfg, params=params, train=True, rng=rng, mesh=self.mesh)
         out = build(ctx, batch)
         return out
 

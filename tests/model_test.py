@@ -84,6 +84,24 @@ def test_sgd_loss_decreases():
     assert float(loss) < first, (first, float(loss))
 
 
+def test_relative_embedding_finite_large_features():
+    """Regression: the reference's relative-embedding formula overflows f32
+    for feature counts > ~89 (exp of the raw flat feature index); our
+    geometric-frequency form must stay finite at any width."""
+    import numpy as np
+    from homebrewnlp_tpu.models.ctx import Args
+    from homebrewnlp_tpu.models.embedding import relative_embedding
+    cfg = mixer_config(heads=8, features_per_head=64)  # 512 features
+    ctx = Ctx(cfg, params={})
+    args = Args(ctx, None, ["relative"])
+    out = relative_embedding(
+        args, [("sequence", 128)], [("heads", 8), ("features_per_head", 64)],
+        [("sequence", 128), ("heads", 8), ("features_per_head", 64)])
+    x = np.asarray(out.x, np.float32)
+    assert np.isfinite(x).all()
+    assert 0 < np.abs(x).max() <= cfg.embedding_stddev + 1e-6
+
+
 def test_dtype_policy_bf16():
     cfg = mixer_config(calculation_dtype="bfloat16", storage_dtype="bfloat16",
                        slice_dtype="float32")
