@@ -180,9 +180,9 @@ def decode_example(buf: bytes) -> typing.Dict[str, typing.Union[typing.List[byte
 # -- record framing ----------------------------------------------------------
 
 class RecordWriter:
-    def __init__(self, path: str):
+    def __init__(self, path: str, append: bool = False):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._f = open(path, "wb")
+        self._f = open(path, "ab" if append else "wb")
 
     def write(self, record: bytes) -> None:
         header = struct.pack("<Q", len(record))

@@ -1,0 +1,211 @@
+"""ctypes bindings for the C++ tooling hot paths (native/hbnlp_native.cc).
+
+Lazily builds the shared library with ``make -C native`` on first use (the
+reference ships equivalent compile_*.sh scripts for its Cython components)
+and degrades to pure-Python fallbacks when no toolchain is available.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import typing
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_NATIVE_DIR = os.path.join(_REPO, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhbnlp_native.so")
+_lock = threading.Lock()
+_lib: typing.Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _load() -> typing.Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            # build to a process-unique name then atomically rename, so
+            # concurrent workers (tools/text2tfrecord.py pool) never load a
+            # partially-written .so
+            tmp = f"{_LIB_PATH}.{os.getpid()}"
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR,
+                     f"TARGET={os.path.basename(tmp)}"],
+                    check=True, capture_output=True)
+                os.replace(tmp, _LIB_PATH)
+            except Exception:
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.hb_crc32c.restype = ctypes.c_uint32
+        lib.hb_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.hb_masked_crc.restype = ctypes.c_uint32
+        lib.hb_masked_crc.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.hb_write_records.restype = ctypes.c_int
+        lib.hb_write_records.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64, ctypes.c_int]
+        lib.hb_clean_text.restype = ctypes.c_size_t
+        lib.hb_clean_text.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                      ctypes.c_char_p]
+        lib.hb_bpe_train.restype = ctypes.c_int
+        lib.hb_bpe_train.argtypes = [
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+        lib.hb_bpe_encode.restype = ctypes.c_int64
+        lib.hb_bpe_encode.argtypes = [
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int32, ctypes.c_int32]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# -- crc ---------------------------------------------------------------------
+
+def crc32c(data: bytes) -> int:
+    lib = _load()
+    if lib is None:
+        from ..data.tfrecord import crc32c as py
+        return py(data)
+    return int(lib.hb_crc32c(data, len(data)))
+
+
+def masked_crc(data: bytes) -> int:
+    lib = _load()
+    if lib is None:
+        from ..data.tfrecord import masked_crc as py
+        return py(data)
+    return int(lib.hb_masked_crc(data, len(data)))
+
+
+# -- tfrecord ----------------------------------------------------------------
+
+def write_records(path: str, payloads: typing.Sequence[bytes],
+                  append: bool = False) -> None:
+    """Write framed TFRecords via the native path (falls back to the Python
+    RecordWriter)."""
+    lib = _load()
+    if lib is None:
+        from ..data.tfrecord import RecordWriter
+        with RecordWriter(path, append=append) as w:
+            for p in payloads:
+                w.write(p)
+        return
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    blob = b"".join(payloads)
+    lengths = (ctypes.c_uint64 * len(payloads))(*[len(p) for p in payloads])
+    rc = lib.hb_write_records(path.encode(), blob, lengths, len(payloads),
+                              int(append))
+    if rc != 0:
+        raise IOError(f"hb_write_records({path}) failed: {rc}")
+
+
+# -- text cleaning -----------------------------------------------------------
+
+def clean_text(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        out = data.replace(b"\r\n", b"\n").replace(b"\r", b"\n")
+        out = bytes(c for c in out if c >= 0x20 or c in (0x09, 0x0A))
+        while b"\n\n\n" in out:
+            out = out.replace(b"\n\n\n", b"\n\n")
+        return out
+    out = ctypes.create_string_buffer(len(data))
+    n = lib.hb_clean_text(data, len(data), out)
+    return out.raw[:n]
+
+
+# -- BPE ---------------------------------------------------------------------
+
+def bpe_train(corpus: np.ndarray, n_merges: int, first_new_id: int = 256
+              ) -> np.ndarray:
+    """Greedy BPE merges over an int32 token stream (-1 = boundary).
+    Returns [n_done, 2] (left, right) pairs; merge i creates id
+    first_new_id + i."""
+    lib = _load()
+    corpus = np.ascontiguousarray(corpus, np.int32)
+    out = np.zeros((n_merges, 2), np.int32)
+    if lib is None:
+        return _bpe_train_py(corpus, n_merges, first_new_id)
+    done = lib.hb_bpe_train(corpus.copy(), len(corpus), n_merges,
+                            first_new_id, out.reshape(-1))
+    return out[:done]
+
+
+def bpe_encode(tokens: np.ndarray, pairs: np.ndarray,
+               first_new_id: int = 256) -> np.ndarray:
+    lib = _load()
+    tokens = np.ascontiguousarray(tokens, np.int32).copy()
+    pairs = np.ascontiguousarray(pairs, np.int32)
+    if lib is None:
+        return _bpe_encode_py(tokens, pairs, first_new_id)
+    n = lib.hb_bpe_encode(tokens, len(tokens), pairs.reshape(-1),
+                          len(pairs), first_new_id)
+    return tokens[:n]
+
+
+def _bpe_train_py(corpus: np.ndarray, n_merges: int, first_new_id: int
+                  ) -> np.ndarray:
+    buf = list(corpus)
+    merges = []
+    for m in range(n_merges):
+        counts: typing.Dict[tuple, int] = {}
+        for a, b in zip(buf, buf[1:]):
+            if a >= 0 and b >= 0:
+                counts[(a, b)] = counts.get((a, b), 0) + 1
+        if not counts:
+            break
+        (left, right), count = min(counts.items(),
+                                   key=lambda kv: (-kv[1], kv[0]))
+        if count < 2:
+            break
+        new_id = first_new_id + m
+        merges.append((left, right))
+        out, i = [], 0
+        while i < len(buf):
+            if i + 1 < len(buf) and buf[i] == left and buf[i + 1] == right:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(buf[i])
+                i += 1
+        buf = out
+    return np.asarray(merges, np.int32).reshape(-1, 2)
+
+
+def _bpe_encode_py(tokens: np.ndarray, pairs: np.ndarray, first_new_id: int
+                   ) -> np.ndarray:
+    rank = {(int(l), int(r)): i for i, (l, r) in enumerate(pairs)}
+    buf = list(tokens)
+    while True:
+        best = min((rank.get((a, b), len(pairs))
+                    for a, b in zip(buf, buf[1:])), default=len(pairs))
+        if best == len(pairs):
+            return np.asarray(buf, np.int32)
+        left, right = map(int, pairs[best])
+        new_id = first_new_id + best
+        out, i = [], 0
+        while i < len(buf):
+            if i + 1 < len(buf) and buf[i] == left and buf[i + 1] == right:
+                out.append(new_id)
+                i += 2
+            else:
+                out.append(buf[i])
+                i += 1
+        buf = out

@@ -65,23 +65,28 @@ def ring_attention_kernel(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                  jnp.float32)  # [b, h, sq]
     l = jnp.zeros_like(m)
     acc = jnp.zeros(q.shape, jnp.float32)
-    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    qf = q.astype(jnp.float32)
+
+    def fold(kv, vv, col_shard, m, l, acc):
+        # k/v ride the ring in their input dtype (half the ICI bytes under
+        # bf16); the f32 upcast happens per-block, and the f32 m/l/acc
+        # accumulators keep the softmax exact
+        return _block(qf, kv.astype(jnp.float32), vv.astype(jnp.float32),
+                      row0, col_shard * s_local, causal, m, l, acc)
 
     # hop 0: own block, no rotation; hops 1..n-1 rotate first then fold, so
     # exactly n-1 ppermute pairs ride the ring
-    m, l, acc = _block(qf, kf, vf, row0, idx * s_local, causal, m, l, acc)
+    m, l, acc = fold(k, v, idx, m, l, acc)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def hop(i, carry):
-        kf, vf, m, l, acc = carry
-        kf = jax.lax.ppermute(kf, axis_name, perm)
-        vf = jax.lax.ppermute(vf, axis_name, perm)
-        col_shard = (idx - i) % n  # whose K/V block is visiting
-        m, l, acc = _block(qf, kf, vf, row0, col_shard * s_local, causal,
-                           m, l, acc)
-        return kf, vf, m, l, acc
+        kc, vc, m, l, acc = carry
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        m, l, acc = fold(kc, vc, (idx - i) % n, m, l, acc)
+        return kc, vc, m, l, acc
 
-    _, _, m, l, acc = jax.lax.fori_loop(1, n, hop, (kf, vf, m, l, acc))
+    _, _, m, l, acc = jax.lax.fori_loop(1, n, hop, (k, v, m, l, acc))
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 

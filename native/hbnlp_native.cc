@@ -1,0 +1,214 @@
+// Native hot paths for the data tooling.
+//
+// TPU-native replacement for the reference's two Cython components
+// (/root/reference/scripts/train_tokenizer.pyx, local_text2tfrecord.pyx,
+// compiled with gcc -Ofast by compile_*.sh): the compute-heavy inner loops —
+// TFRecord framing + CRC32C, streaming text cleaning, and BPE pair
+// counting/merging — live here; Python (homebrewnlp_tpu/native) binds via
+// ctypes with a pure-Python fallback.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC, no deps)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- crc32c --
+// Castagnoli CRC, slicing-by-8.
+static uint32_t kCrcTable[8][256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+    kCrcTable[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = kCrcTable[0][i];
+    for (int t = 1; t < 8; ++t) {
+      c = kCrcTable[0][c & 0xFF] ^ (c >> 8);
+      kCrcTable[t][i] = c;
+    }
+  }
+  crc_init_done = true;
+}
+
+uint32_t hb_crc32c(const uint8_t* data, size_t n) {
+  crc_init();
+  uint32_t crc = 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint32_t lo, hi;
+    memcpy(&lo, data, 4);
+    memcpy(&hi, data + 4, 4);
+    lo ^= crc;
+    crc = kCrcTable[7][lo & 0xFF] ^ kCrcTable[6][(lo >> 8) & 0xFF] ^
+          kCrcTable[5][(lo >> 16) & 0xFF] ^ kCrcTable[4][lo >> 24] ^
+          kCrcTable[3][hi & 0xFF] ^ kCrcTable[2][(hi >> 8) & 0xFF] ^
+          kCrcTable[1][(hi >> 16) & 0xFF] ^ kCrcTable[0][hi >> 24];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = kCrcTable[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+uint32_t hb_masked_crc(const uint8_t* data, size_t n) {
+  uint32_t crc = hb_crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+// --------------------------------------------------------- tfrecord write --
+// Append framed records to a file: [u64 len][crc(len)][payload][crc(payload)]
+int hb_write_records(const char* path, const uint8_t* payloads,
+                     const uint64_t* lengths, uint64_t count, int append) {
+  FILE* f = fopen(path, append ? "ab" : "wb");
+  if (!f) return -1;
+  const uint8_t* p = payloads;
+  for (uint64_t i = 0; i < count; ++i) {
+    uint64_t len = lengths[i];
+    uint8_t header[8];
+    memcpy(header, &len, 8);  // little-endian hosts only (x86/ARM)
+    uint32_t hcrc = hb_masked_crc(header, 8);
+    uint32_t pcrc = hb_masked_crc(p, len);
+    if (fwrite(header, 1, 8, f) != 8 || fwrite(&hcrc, 4, 1, f) != 1 ||
+        fwrite(p, 1, len, f) != len || fwrite(&pcrc, 4, 1, f) != 1) {
+      fclose(f);
+      return -2;
+    }
+    p += len;
+  }
+  fclose(f);
+  return 0;
+}
+
+// ------------------------------------------------------------ text clean --
+// Streaming cleaner (the ftfy-ish hot loop of train_tokenizer.pyx:98-106):
+// drop control bytes except \n and \t, collapse \r\n -> \n, collapse runs of
+// >2 blank lines, NFC is left to Python (rare path). Returns output length.
+size_t hb_clean_text(const uint8_t* in, size_t n, uint8_t* out) {
+  size_t o = 0;
+  int newlines = 0;
+  for (size_t i = 0; i < n; ++i) {
+    uint8_t c = in[i];
+    if (c == '\r') {
+      if (i + 1 < n && in[i + 1] == '\n') continue;  // \r\n -> \n
+      c = '\n';
+    }
+    if (c == '\n') {
+      if (++newlines > 2) continue;  // at most one blank line
+    } else {
+      newlines = 0;
+      if (c < 0x20 && c != '\t') continue;  // strip control bytes
+    }
+    out[o++] = c;
+  }
+  return o;
+}
+
+// ------------------------------------------------------------------- BPE --
+// Greedy byte-pair training over a token stream (the compute core of
+// train_tokenizer.pyx's BpeTrainer call): repeatedly count adjacent pairs,
+// merge the most frequent into a fresh id.  O(n_merges * n) rescan — simple,
+// cache-friendly, and orders of magnitude faster than a Python loop.
+//
+// corpus: int32 tokens, -1 marks an unmergeable boundary (word split).
+// out_pairs: n_merges * 2 ints (left id, right id), merge i creates id
+// first_new_id + i.  Returns number of merges actually performed.
+int hb_bpe_train(int32_t* corpus, int64_t n, int32_t n_merges,
+                 int32_t first_new_id, int32_t* out_pairs) {
+  std::vector<int32_t> buf(corpus, corpus + n);
+  int merges_done = 0;
+  for (int m = 0; m < n_merges; ++m) {
+    std::unordered_map<uint64_t, int64_t> counts;
+    counts.reserve(1 << 16);
+    for (int64_t i = 0; i + 1 < (int64_t)buf.size(); ++i) {
+      if (buf[i] < 0 || buf[i + 1] < 0) continue;
+      uint64_t key = ((uint64_t)(uint32_t)buf[i] << 32) |
+                     (uint32_t)buf[i + 1];
+      ++counts[key];
+    }
+    uint64_t best_key = 0;
+    int64_t best_count = 0;
+    for (const auto& kv : counts) {
+      if (kv.second > best_count ||
+          (kv.second == best_count && kv.first < best_key)) {
+        best_count = kv.second;
+        best_key = kv.first;
+      }
+    }
+    if (best_count < 2) break;  // nothing worth merging
+    int32_t left = (int32_t)(best_key >> 32);
+    int32_t right = (int32_t)(best_key & 0xFFFFFFFFu);
+    int32_t new_id = first_new_id + m;
+    out_pairs[2 * m] = left;
+    out_pairs[2 * m + 1] = right;
+    // in-place merge pass
+    int64_t w = 0;
+    for (int64_t r = 0; r < (int64_t)buf.size();) {
+      if (r + 1 < (int64_t)buf.size() && buf[r] == left &&
+          buf[r + 1] == right) {
+        buf[w++] = new_id;
+        r += 2;
+      } else {
+        buf[w++] = buf[r++];
+      }
+    }
+    buf.resize(w);
+    ++merges_done;
+  }
+  return merges_done;
+}
+
+// Apply learned merges to encode a byte/token stream (local_text2tfrecord's
+// encode loop). pairs: n_merges*2; merge i -> id first_new_id+i.
+// Returns encoded length (<= n). In-place on `tokens`.
+int64_t hb_bpe_encode(int32_t* tokens, int64_t n, const int32_t* pairs,
+                      int32_t n_merges, int32_t first_new_id) {
+  std::unordered_map<uint64_t, int32_t> merge_rank;
+  merge_rank.reserve(n_merges * 2);
+  for (int32_t i = 0; i < n_merges; ++i) {
+    uint64_t key = ((uint64_t)(uint32_t)pairs[2 * i] << 32) |
+                   (uint32_t)pairs[2 * i + 1];
+    merge_rank.emplace(key, i);
+  }
+  int64_t len = n;
+  bool changed = true;
+  while (changed) {
+    changed = false;
+    // find lowest-rank applicable merge, apply globally (BPE order matters)
+    int32_t best_rank = n_merges;
+    for (int64_t i = 0; i + 1 < len; ++i) {
+      if (tokens[i] < 0 || tokens[i + 1] < 0) continue;
+      uint64_t key = ((uint64_t)(uint32_t)tokens[i] << 32) |
+                     (uint32_t)tokens[i + 1];
+      auto it = merge_rank.find(key);
+      if (it != merge_rank.end() && it->second < best_rank)
+        best_rank = it->second;
+    }
+    if (best_rank == n_merges) break;
+    int32_t left = pairs[2 * best_rank];
+    int32_t right = pairs[2 * best_rank + 1];
+    int32_t new_id = first_new_id + best_rank;
+    int64_t w = 0;
+    for (int64_t r = 0; r < len;) {
+      if (r + 1 < len && tokens[r] == left && tokens[r + 1] == right) {
+        tokens[w++] = new_id;
+        r += 2;
+        changed = true;
+      } else {
+        tokens[w++] = tokens[r++];
+      }
+    }
+    len = w;
+  }
+  return len;
+}
+
+}  // extern "C"

@@ -1,0 +1,89 @@
+"""Text -> TFRecord shard builder with C++ hot paths.
+
+Port of /root/reference/scripts/text2tfrecord.py + local_text2tfrecord.pyx:
+multiprocess encoding of text files into TFRecord shards, byte-level or BPE
+(a tools/train_tokenizer.py artifact), with the token count embedded in the
+filename (``..._<n>.tfrecord``) the way the run-log replay resume expects
+(src/inputs.py:34).  GCS upload becomes a ``--post-cmd`` hook (zero-egress
+image); framing + CRC go through native/hbnlp_native.cc.
+
+Usage:
+  python tools/text2tfrecord.py --input *.txt --output-dir datasets/pile \
+      [--tokenizer tokenizer.json] [--procs 8]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import subprocess
+import sys
+import typing
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from homebrewnlp_tpu.data.tfrecord import encode_example  # noqa: E402
+from homebrewnlp_tpu.native import bpe_encode, clean_text, write_records  # noqa: E402
+
+
+def encode_file(path: str, merges: typing.Optional[np.ndarray]
+                ) -> typing.Tuple[bytes, int]:
+    with open(path, "rb") as f:
+        raw = clean_text(f.read())
+    if merges is None:
+        return encode_example({"text": raw}), len(raw)
+    toks = np.frombuffer(raw, np.uint8).astype(np.int32)
+    toks = bpe_encode(toks, merges)
+    return encode_example({"text": [int(t) for t in toks]}), len(toks)
+
+
+def _work(job) -> str:
+    shard_idx, paths, out_dir, tokenizer_path, records_per_shard = job
+    merges = None
+    suffix = "bytes"
+    if tokenizer_path:
+        with open(tokenizer_path) as f:
+            merges = np.asarray(json.load(f)["merges"], np.int32)
+        suffix = "int64"
+    payloads, total = [], 0
+    for p in paths:
+        payload, n = encode_file(p, merges)
+        payloads.append(payload)
+        total += n
+    out = os.path.join(out_dir, f"shard{suffix}{shard_idx:05d}_{total}.tfrecord")
+    write_records(out, payloads)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", nargs="+", required=True)
+    ap.add_argument("--output-dir", required=True)
+    ap.add_argument("--tokenizer", default="",
+                    help="tokenizer.json from tools/train_tokenizer.py "
+                         "(omit for byte-level)")
+    ap.add_argument("--files-per-shard", type=int, default=16)
+    ap.add_argument("--procs", type=int, default=os.cpu_count())
+    ap.add_argument("--post-cmd", default="",
+                    help="shell command run per finished shard, {} = path "
+                         "(e.g. 'gsutil cp {} gs://bucket/')")
+    args = ap.parse_args()
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    jobs = []
+    for i in range(0, len(args.input), args.files_per_shard):
+        jobs.append((len(jobs), args.input[i:i + args.files_per_shard],
+                     args.output_dir, args.tokenizer, args.files_per_shard))
+    with multiprocessing.Pool(min(args.procs, len(jobs))) as pool:
+        for out in pool.imap_unordered(_work, jobs):
+            print(out, flush=True)
+            if args.post_cmd:
+                subprocess.run(args.post_cmd.replace("{}", out), shell=True,
+                               check=False)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""BPE tokenizer training with the C++ hot loop.
+
+Port of /root/reference/scripts/train_tokenizer.pyx (+ run/compile scripts):
+that pipeline streams The Pile's ``.jsonl.zst`` shards through parallel
+wget/zstd, ftfy-fixes the text, and feeds HuggingFace's BpeTrainer with a
+regex pre-split and a 256-byte special-token alphabet.  Here: local/stdin
+corpus (the image has no egress — downloading is the operator's problem, and
+`--download-cmd` documents the reference's wget|zstd recipe), C++ streaming
+cleaner + greedy BPE core (native/hbnlp_native.cc), whitespace pre-split
+boundaries, JSON vocab artifact.
+
+Usage:
+  python tools/train_tokenizer.py --input corpus1.txt corpus2.jsonl \
+      --vocab-size 65536 --output tokenizer.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import typing
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from homebrewnlp_tpu.native import available, bpe_train, clean_text  # noqa: E402
+
+# whitespace pre-split: merges never cross word boundaries (the reference
+# uses an equivalent regex pre-split, train_tokenizer.pyx:180-187)
+WORD_RE = re.compile(rb"\s")
+
+
+def _chunks(path: str, limit: int) -> typing.Iterator[bytes]:
+    """Yield text chunks; JSONL files are iterated line-by-line so records
+    never straddle a read boundary (arbitrary-size documents parse whole)."""
+    opener = open
+    if path.endswith(".zst"):
+        import zstandard  # optional; Pile shards
+
+        def opener(p, mode="rb"):
+            return zstandard.open(p, mode)
+    is_jsonl = path.endswith((".jsonl", ".jsonl.zst"))
+    with opener(path, "rb") as f:
+        if is_jsonl:
+            for line in f:
+                if limit <= 0:
+                    return
+                try:
+                    text = json.loads(line).get("text", "").encode()
+                except Exception:
+                    print(f"WARNING: unparseable JSONL line in {path}",
+                          file=sys.stderr)
+                    continue
+                limit -= len(text)
+                yield text
+        else:
+            while limit > 0:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    return
+                limit -= len(chunk)
+                yield chunk
+
+
+def corpus_tokens(paths: typing.Sequence[str], limit_bytes: int
+                  ) -> np.ndarray:
+    """Byte tokens with -1 boundaries at whitespace splits."""
+    stream: typing.List[np.ndarray] = []
+    total = 0
+    boundary = np.asarray([-1], np.int32)
+    for path in paths:
+        for chunk in _chunks(path, limit_bytes - total):
+            chunk = clean_text(chunk)
+            total += len(chunk)
+            for piece in WORD_RE.split(chunk):
+                if piece:
+                    stream.append(np.frombuffer(piece, np.uint8).astype(np.int32))
+                    stream.append(boundary)
+            if total >= limit_bytes:
+                break
+    if not stream:
+        raise SystemExit("empty corpus")
+    return np.concatenate(stream)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", nargs="+", required=True)
+    p.add_argument("--vocab-size", type=int, default=65536)
+    p.add_argument("--output", default="tokenizer.json")
+    p.add_argument("--limit-mb", type=int, default=256,
+                   help="max corpus bytes to train on")
+    p.add_argument("--download-cmd", action="store_true",
+                   help="print the reference's Pile download recipe and exit")
+    args = p.parse_args()
+    if args.download_cmd:
+        print("for i in $(seq -w 0 29); do wget -q "
+              "https://the-eye.eu/public/AI/pile/train/$i.jsonl.zst & done; "
+              "wait  # (reference train_tokenizer.pyx:31-43)")
+        return
+
+    print(f"native library: {'yes' if available() else 'no (python fallback)'}")
+    tokens = corpus_tokens(args.input, args.limit_mb << 20)
+    n_merges = args.vocab_size - 256
+    print(f"training {n_merges} merges over {len(tokens)} tokens")
+    pairs = bpe_train(tokens, n_merges, first_new_id=256)
+    vocab = {"type": "bpe", "byte_fallback": True, "first_new_id": 256,
+             "merges": pairs.tolist()}
+    with open(args.output, "w") as f:
+        json.dump(vocab, f)
+    print(f"wrote {args.output}: {len(pairs)} merges "
+          f"(vocab {256 + len(pairs)})")
+
+
+if __name__ == "__main__":
+    main()
