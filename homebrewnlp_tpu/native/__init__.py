@@ -57,9 +57,11 @@ def _load() -> typing.Optional[ctypes.CDLL]:
         lib.hb_clean_text.restype = ctypes.c_size_t
         lib.hb_clean_text.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
                                       ctypes.c_char_p]
-        lib.hb_bpe_train.restype = ctypes.c_int
-        lib.hb_bpe_train.argtypes = [
+        lib.hb_bpe_train_words.restype = ctypes.c_int
+        lib.hb_bpe_train_words.argtypes = [
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
             ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
         lib.hb_bpe_encode.restype = ctypes.c_int64
@@ -121,31 +123,80 @@ def write_records(path: str, payloads: typing.Sequence[bytes],
 def clean_text(data: bytes) -> bytes:
     lib = _load()
     if lib is None:
-        out = data.replace(b"\r\n", b"\n").replace(b"\r", b"\n")
-        out = bytes(c for c in out if c >= 0x20 or c in (0x09, 0x0A))
-        while b"\n\n\n" in out:
-            out = out.replace(b"\n\n\n", b"\n\n")
-        return out
+        return _clean_text_py(data)
     out = ctypes.create_string_buffer(len(data))
     n = lib.hb_clean_text(data, len(data), out)
     return out.raw[:n]
 
 
+def _clean_text_py(data: bytes) -> bytes:
+    """Byte-exact port of hb_clean_text (same state machine, so shards built
+    without the toolchain are identical to native-built ones)."""
+    out = bytearray()
+    newlines = 0
+    n = len(data)
+    i = 0
+    while i < n:
+        c = data[i]
+        if c == 0x0D:  # \r
+            if i + 1 < n and data[i + 1] == 0x0A:
+                i += 1
+                continue
+            c = 0x0A
+        if c == 0x0A:
+            newlines += 1
+            if newlines > 2:
+                i += 1
+                continue
+        else:
+            newlines = 0
+            if c < 0x20 and c != 0x09:
+                i += 1
+                continue
+        out.append(c)
+        i += 1
+    return bytes(out)
+
+
 # -- BPE ---------------------------------------------------------------------
+
+def _stream_to_words(corpus: np.ndarray) -> typing.Dict[bytes, int]:
+    """int32 stream with -1 boundaries -> {word token-bytes: count}."""
+    corpus = np.ascontiguousarray(corpus, np.int32)
+    counts: typing.Dict[bytes, int] = {}
+    for seg in np.split(corpus, np.nonzero(corpus < 0)[0]):
+        seg = seg[seg >= 0]
+        if len(seg):
+            key = seg.tobytes()
+            counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def bpe_train_words(word_counts: typing.Dict[bytes, int], n_merges: int,
+                    first_new_id: int = 256) -> np.ndarray:
+    """Greedy BPE merges over a word-frequency table ({int32-token-bytes:
+    count}, the HF-BpeTrainer-style structure).  Returns [n_done, 2]
+    (left, right) pairs; merge i creates id first_new_id + i."""
+    lib = _load()
+    if lib is None:
+        return _bpe_train_py(word_counts, n_merges, first_new_id)
+    words = [np.frombuffer(k, np.int32) for k in word_counts]
+    flat = (np.concatenate(words) if words else np.zeros(0, np.int32))
+    flat = np.ascontiguousarray(flat, np.int32)
+    offsets = np.zeros(len(words) + 1, np.int64)
+    np.cumsum([len(w) for w in words], out=offsets[1:])
+    counts = np.asarray(list(word_counts.values()), np.int64)
+    out = np.zeros((max(n_merges, 1), 2), np.int32)
+    done = lib.hb_bpe_train_words(flat, offsets, counts, len(words),
+                                  n_merges, first_new_id, out.reshape(-1))
+    return out[:done]
+
 
 def bpe_train(corpus: np.ndarray, n_merges: int, first_new_id: int = 256
               ) -> np.ndarray:
-    """Greedy BPE merges over an int32 token stream (-1 = boundary).
-    Returns [n_done, 2] (left, right) pairs; merge i creates id
-    first_new_id + i."""
-    lib = _load()
-    corpus = np.ascontiguousarray(corpus, np.int32)
-    out = np.zeros((n_merges, 2), np.int32)
-    if lib is None:
-        return _bpe_train_py(corpus, n_merges, first_new_id)
-    done = lib.hb_bpe_train(corpus.copy(), len(corpus), n_merges,
-                            first_new_id, out.reshape(-1))
-    return out[:done]
+    """Greedy BPE merges over an int32 token stream (-1 = boundary);
+    convenience wrapper deduplicating into the word-frequency form."""
+    return bpe_train_words(_stream_to_words(corpus), n_merges, first_new_id)
 
 
 def bpe_encode(tokens: np.ndarray, pairs: np.ndarray,
@@ -160,15 +211,18 @@ def bpe_encode(tokens: np.ndarray, pairs: np.ndarray,
     return tokens[:n]
 
 
-def _bpe_train_py(corpus: np.ndarray, n_merges: int, first_new_id: int
-                  ) -> np.ndarray:
-    buf = list(corpus)
+def _bpe_train_py(word_counts: typing.Dict[bytes, int], n_merges: int,
+                  first_new_id: int) -> np.ndarray:
+    """Word-frequency BPE, same tie-break as the native version (largest
+    count, then smallest (left<<32)|right key)."""
+    words = [list(np.frombuffer(k, np.int32)) for k in word_counts]
+    wcounts = list(word_counts.values())
     merges = []
     for m in range(n_merges):
         counts: typing.Dict[tuple, int] = {}
-        for a, b in zip(buf, buf[1:]):
-            if a >= 0 and b >= 0:
-                counts[(a, b)] = counts.get((a, b), 0) + 1
+        for word, c in zip(words, wcounts):
+            for a, b in zip(word, word[1:]):
+                counts[(int(a), int(b))] = counts.get((int(a), int(b)), 0) + c
         if not counts:
             break
         (left, right), count = min(counts.items(),
@@ -177,15 +231,16 @@ def _bpe_train_py(corpus: np.ndarray, n_merges: int, first_new_id: int
             break
         new_id = first_new_id + m
         merges.append((left, right))
-        out, i = [], 0
-        while i < len(buf):
-            if i + 1 < len(buf) and buf[i] == left and buf[i + 1] == right:
-                out.append(new_id)
-                i += 2
-            else:
-                out.append(buf[i])
-                i += 1
-        buf = out
+        for word in words:
+            o, i = [], 0
+            while i < len(word):
+                if i + 1 < len(word) and word[i] == left and word[i + 1] == right:
+                    o.append(new_id)
+                    i += 2
+                else:
+                    o.append(word[i])
+                    i += 1
+            word[:] = o
     return np.asarray(merges, np.int32).reshape(-1, 2)
 
 

@@ -12,8 +12,10 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <queue>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 extern "C" {
@@ -113,55 +115,108 @@ size_t hb_clean_text(const uint8_t* in, size_t n, uint8_t* out) {
 }
 
 // ------------------------------------------------------------------- BPE --
-// Greedy byte-pair training over a token stream (the compute core of
-// train_tokenizer.pyx's BpeTrainer call): repeatedly count adjacent pairs,
-// merge the most frequent into a fresh id.  O(n_merges * n) rescan — simple,
-// cache-friendly, and orders of magnitude faster than a Python loop.
+// Greedy byte-pair training over a deduplicated word-frequency table — the
+// same structure HuggingFace's BpeTrainer (which the reference calls,
+// train_tokenizer.pyx:180-187) uses, so full-corpus scale is feasible:
+//   * pair counts are maintained incrementally (only words containing the
+//     merged pair are touched, found via pair -> word-id postings)
+//   * the argmax pair comes from a lazy max-heap (stale entries validated
+//     against the live count on pop)
+// Tie-break: larger count first, then smaller packed (left<<32|right) key.
 //
-// corpus: int32 tokens, -1 marks an unmergeable boundary (word split).
-// out_pairs: n_merges * 2 ints (left id, right id), merge i creates id
-// first_new_id + i.  Returns number of merges actually performed.
-int hb_bpe_train(int32_t* corpus, int64_t n, int32_t n_merges,
-                 int32_t first_new_id, int32_t* out_pairs) {
-  std::vector<int32_t> buf(corpus, corpus + n);
+// words_flat / word_offsets: CSR of n_words token sequences (int32 ids);
+// word_counts: corpus frequency per word.  out_pairs: n_merges*2 (left,
+// right); merge i creates id first_new_id + i.  Returns merges performed.
+
+struct HeapEntry {
+  int64_t count;
+  uint64_t key;
+  bool operator<(const HeapEntry& o) const {
+    if (count != o.count) return count < o.count;  // max-heap by count
+    return key > o.key;                            // then smallest key
+  }
+};
+
+static inline uint64_t pack(int32_t a, int32_t b) {
+  return ((uint64_t)(uint32_t)a << 32) | (uint32_t)b;
+}
+
+int hb_bpe_train_words(const int32_t* words_flat, const int64_t* word_offsets,
+                       const int64_t* word_counts, int64_t n_words,
+                       int32_t n_merges, int32_t first_new_id,
+                       int32_t* out_pairs) {
+  std::vector<std::vector<int32_t>> words(n_words);
+  std::unordered_map<uint64_t, int64_t> counts;
+  std::unordered_map<uint64_t, std::unordered_set<int32_t>> postings;
+  counts.reserve(1 << 18);
+  postings.reserve(1 << 18);
+  for (int64_t w = 0; w < n_words; ++w) {
+    words[w].assign(words_flat + word_offsets[w],
+                    words_flat + word_offsets[w + 1]);
+    for (size_t i = 0; i + 1 < words[w].size(); ++i) {
+      uint64_t key = pack(words[w][i], words[w][i + 1]);
+      counts[key] += word_counts[w];
+      postings[key].insert((int32_t)w);
+    }
+  }
+  std::priority_queue<HeapEntry> heap;
+  for (const auto& kv : counts) heap.push({kv.second, kv.first});
+
   int merges_done = 0;
-  for (int m = 0; m < n_merges; ++m) {
-    std::unordered_map<uint64_t, int64_t> counts;
-    counts.reserve(1 << 16);
-    for (int64_t i = 0; i + 1 < (int64_t)buf.size(); ++i) {
-      if (buf[i] < 0 || buf[i + 1] < 0) continue;
-      uint64_t key = ((uint64_t)(uint32_t)buf[i] << 32) |
-                     (uint32_t)buf[i + 1];
-      ++counts[key];
-    }
-    uint64_t best_key = 0;
-    int64_t best_count = 0;
-    for (const auto& kv : counts) {
-      if (kv.second > best_count ||
-          (kv.second == best_count && kv.first < best_key)) {
-        best_count = kv.second;
-        best_key = kv.first;
-      }
-    }
-    if (best_count < 2) break;  // nothing worth merging
-    int32_t left = (int32_t)(best_key >> 32);
-    int32_t right = (int32_t)(best_key & 0xFFFFFFFFu);
-    int32_t new_id = first_new_id + m;
-    out_pairs[2 * m] = left;
-    out_pairs[2 * m + 1] = right;
-    // in-place merge pass
-    int64_t w = 0;
-    for (int64_t r = 0; r < (int64_t)buf.size();) {
-      if (r + 1 < (int64_t)buf.size() && buf[r] == left &&
-          buf[r + 1] == right) {
-        buf[w++] = new_id;
-        r += 2;
-      } else {
-        buf[w++] = buf[r++];
-      }
-    }
-    buf.resize(w);
+  while (merges_done < n_merges && !heap.empty()) {
+    HeapEntry top = heap.top();
+    heap.pop();
+    auto it = counts.find(top.key);
+    if (it == counts.end() || it->second != top.count) continue;  // stale
+    if (top.count < 2) break;
+    int32_t left = (int32_t)(top.key >> 32);
+    int32_t right = (int32_t)(top.key & 0xFFFFFFFFu);
+    int32_t new_id = first_new_id + merges_done;
+    out_pairs[2 * merges_done] = left;
+    out_pairs[2 * merges_done + 1] = right;
     ++merges_done;
+
+    auto post_it = postings.find(top.key);
+    std::vector<int32_t> touched;
+    if (post_it != postings.end())
+      touched.assign(post_it->second.begin(), post_it->second.end());
+    std::unordered_set<uint64_t> dirty;
+    for (int32_t w : touched) {
+      std::vector<int32_t>& word = words[w];
+      int64_t c = word_counts[w];
+      // remove this word's old pair contributions
+      for (size_t i = 0; i + 1 < word.size(); ++i) {
+        uint64_t key = pack(word[i], word[i + 1]);
+        counts[key] -= c;
+        dirty.insert(key);
+      }
+      // apply the merge in place
+      size_t o = 0;
+      for (size_t r = 0; r < word.size();) {
+        if (r + 1 < word.size() && word[r] == left && word[r + 1] == right) {
+          word[o++] = new_id;
+          r += 2;
+        } else {
+          word[o++] = word[r++];
+        }
+      }
+      word.resize(o);
+      // add back the new contributions
+      for (size_t i = 0; i + 1 < word.size(); ++i) {
+        uint64_t key = pack(word[i], word[i + 1]);
+        counts[key] += c;
+        postings[key].insert(w);
+        dirty.insert(key);
+      }
+    }
+    counts.erase(top.key);
+    postings.erase(top.key);
+    dirty.erase(top.key);
+    for (uint64_t key : dirty) {
+      auto cit = counts.find(key);
+      if (cit != counts.end() && cit->second > 0)
+        heap.push({cit->second, key});
+    }
   }
   return merges_done;
 }

@@ -11,9 +11,11 @@ import pytest
 
 from homebrewnlp_tpu.data.tfrecord import crc32c as py_crc
 from homebrewnlp_tpu.data.tfrecord import decode_example, read_records
-from homebrewnlp_tpu.native import (_bpe_encode_py, _bpe_train_py, available,
-                                    bpe_encode, bpe_train, clean_text, crc32c,
-                                    masked_crc, write_records)
+from homebrewnlp_tpu.native import (_bpe_encode_py, _bpe_train_py,
+                                    _clean_text_py, _stream_to_words,
+                                    available, bpe_encode, bpe_train,
+                                    clean_text, crc32c, masked_crc,
+                                    write_records)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -42,6 +44,15 @@ def test_clean_text():
     assert out == b"a\nb\nc d\n\ne\tf".replace(b"c d", b"cd")
 
 
+def test_clean_text_fallback_parity():
+    """The Python fallback must be byte-exact vs the native state machine
+    (shards built without a toolchain must match native-built ones)."""
+    cases = [b"a\r\nb\rc\x00\x01d\n\n\n\n\ne\tf", b"\n\n\x01\n", b"\r\r\n",
+             b"", b"\x1f\x20", bytes(range(64)) * 3]
+    for data in cases:
+        assert _clean_text_py(data) == clean_text(data), data
+
+
 def test_bpe_train_finds_frequent_pair():
     # "ababab..." -> first merge must be (97, 98)
     corpus = np.asarray(list(b"ab" * 50) + [-1] + list(b"xy" * 10), np.int32)
@@ -53,9 +64,10 @@ def test_bpe_train_finds_frequent_pair():
 def test_bpe_native_matches_python_fallback():
     rng = np.random.default_rng(0)
     corpus = rng.integers(0, 8, 500).astype(np.int32)
-    corpus[::50] = -1
+    corpus[::7] = -1  # lots of word boundaries
+    words = _stream_to_words(corpus)
     native_pairs = bpe_train(corpus, 6)
-    py_pairs = _bpe_train_py(corpus, 6, 256)
+    py_pairs = _bpe_train_py(words, 6, 256)
     np.testing.assert_array_equal(native_pairs, py_pairs)
     toks = rng.integers(0, 8, 100).astype(np.int32)
     np.testing.assert_array_equal(bpe_encode(toks, native_pairs),

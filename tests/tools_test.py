@@ -1,0 +1,106 @@
+"""Fleet/ops tooling tests: sweep config generation, babysitter restart
+logic, video pipeline (synthetic avi -> tfrecords -> VideoPipeline), subtitle
+parsing, duration balancing."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_run_experiments_grid(tmp_path):
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps({"learning_rate": 1.0, "depth": 1}))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/run_experiments.py"),
+         "--base", str(base), "--grid", "learning_rate=0.01,0.003",
+         "--grid", "depth=8,16", "--out-dir", str(tmp_path / "sweep")],
+        check=True, capture_output=True, text=True)
+    cfgs = sorted((tmp_path / "sweep").glob("*.json"))
+    assert len(cfgs) == 4
+    one = json.loads(cfgs[0].read_text())
+    assert one["learning_rate"] in (0.01, 0.003) and one["depth"] in (8, 16)
+    assert str(tmp_path / "sweep") in one["model_path"]
+    assert out.stdout.count("would launch") == 4
+
+
+def test_run_manager_restarts_and_completes(tmp_path):
+    """Child fails twice then succeeds; manager must restart and exit 0."""
+    model = tmp_path / "run"
+    model.mkdir()
+    script = tmp_path / "child.sh"
+    marker = tmp_path / "attempts"
+    script.write_text(
+        "#!/bin/bash\n"
+        f"echo x >> {marker}\n"
+        f"touch {model}/metrics.jsonl\n"
+        f"if [ $(wc -l < {marker}) -lt 3 ]; then exit 1; fi\n")
+    script.chmod(0o755)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/run_manager.py"),
+         "--cmd", str(script), "--model-path", str(model), "--poll", "1",
+         "--max-restarts", "5"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert marker.read_text().count("x") == 3
+    assert "restarting" in proc.stdout
+
+
+def test_split_equal_balances():
+    from video2tfrecord import split_equal
+    buckets = split_equal([10, 1, 1, 1, 1, 1, 1, 1, 1, 2], 3)
+    loads = [sum([10, 1, 1, 1, 1, 1, 1, 1, 1, 2][i] for i in b)
+             for b in buckets]
+    assert max(loads) <= 10  # the giant item sits alone-ish
+    assert sum(len(b) for b in buckets) == 10
+
+
+def test_parse_subs(tmp_path):
+    from video2tfrecord import parse_subs
+    vtt = tmp_path / "a.vtt"
+    vtt.write_text("WEBVTT\n\n00:00:01.000 --> 00:00:03.500\nhello <i>world</i>\n"
+                   "\n00:00:04.000 --> 00:00:05.000\nsecond line\nmore\n")
+    spans = parse_subs(str(vtt))
+    assert spans[0][:2] == (1.0, 3.5)
+    assert spans[0][2] == "hello world"
+    assert spans[1][2] == "second line more"
+
+
+def test_video2tfrecord_end_to_end(tmp_path):
+    cv2 = pytest.importorskip("cv2")
+    # synthetic avi
+    vid_path = str(tmp_path / "in.avi")
+    w = cv2.VideoWriter(vid_path, cv2.VideoWriter_fourcc(*"MJPG"), 10, (64, 32))
+    rng = np.random.default_rng(0)
+    for _ in range(30):
+        w.write(rng.integers(0, 255, (32, 64, 3), np.uint8))
+    w.release()
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(dict(
+        model_mode="jannet", use_language=False, frame_height=32,
+        frame_width=64, patch_size=16, sequence_length=4, experts=1,
+        features_per_head=16, heads=2, depth=1)))
+    out_dir = tmp_path / "shards"
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/video2tfrecord.py"),
+         "--input", vid_path, "--model", str(cfg_path),
+         "--output-dir", str(out_dir), "--fps", "10", "--procs", "1"],
+        check=True, capture_output=True)
+    shards = list(out_dir.glob("*.tfrecord"))
+    assert len(shards) == 1
+
+    # and the training pipeline can consume them
+    from homebrewnlp_tpu.config import Config
+    from homebrewnlp_tpu.data.video import VideoPipeline
+    cfg = Config(json.loads(cfg_path.read_text()))
+    pipe = VideoPipeline(cfg, sub_batch_size=2, paths=[str(shards[0])])
+    batch = next(iter(pipe))
+    assert batch["frame"].shape == (2, 5, 2, 4, 16 * 16 * 3)
+    assert not batch["cat_mask_x"].all()  # first frame concat flag present

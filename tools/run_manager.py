@@ -1,0 +1,110 @@
+"""Preemption babysitter: supervise a training run, restart on failure.
+
+Port of /root/reference/scripts/run_manager.py — the reference's elastic
+story (SURVEY.md §5.3): create the TPU, stream logs, poll health every few
+minutes, and on unhealthiness kill the process group, recreate the TPU and
+relaunch (:119-146), relying on checkpoint restore + deterministic data
+resume for continuity.  Health here is two-signal: child liveness and a
+training heartbeat (metrics.jsonl mtime — a hung-but-alive job is unhealthy
+too, which the reference's TPU-state poll missed); TPU recreate hooks are
+command templates so the gcloud recipe stays available without hardcoding
+gcloud.
+
+Usage:
+  python tools/run_manager.py --cmd 'python main.py --model cfg.json --run_mode train' \
+      --model-path runs/myrun [--recreate-cmd 'gcloud compute tpus ...'] \
+      [--poll 300] [--max-restarts 100]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def start(cmd: str, log_path: str) -> subprocess.Popen:
+    log = open(log_path, "ab")
+    return subprocess.Popen(cmd, shell=True, stdout=log, stderr=log,
+                            preexec_fn=os.setsid)
+
+
+def kill_group(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def heartbeat_age(model_path: str) -> float:
+    metrics = os.path.join(model_path, "metrics.jsonl")
+    if not os.path.exists(metrics):
+        return float("inf")
+    return time.time() - os.path.getmtime(metrics)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cmd", required=True, help="training command")
+    ap.add_argument("--model-path", required=True,
+                    help="run dir (heartbeat = metrics.jsonl mtime)")
+    ap.add_argument("--log", default="", help="log file (default: "
+                    "<model-path>/manager.log)")
+    ap.add_argument("--poll", type=int, default=300, help="seconds between "
+                    "health checks (reference polls every 5-10 min)")
+    ap.add_argument("--stall-timeout", type=int, default=1800,
+                    help="restart if no heartbeat for this many seconds")
+    ap.add_argument("--startup-grace", type=int, default=1800,
+                    help="allowance for compile/restore before first heartbeat")
+    ap.add_argument("--recreate-cmd", default="",
+                    help="run before each relaunch (e.g. gcloud tpus delete+"
+                         "create recipe, reference :119-146)")
+    ap.add_argument("--max-restarts", type=int, default=100)
+    args = ap.parse_args()
+
+    os.makedirs(args.model_path, exist_ok=True)
+    log_path = args.log or os.path.join(args.model_path, "manager.log")
+    restarts = 0
+    while restarts <= args.max_restarts:
+        started = time.time()
+        proc = start(args.cmd, log_path)
+        print(f"[manager] started pid {proc.pid} (restart {restarts})",
+              flush=True)
+        while True:
+            time.sleep(args.poll)
+            rc = proc.poll()
+            if rc is not None:
+                if rc == 0:
+                    print("[manager] run completed cleanly", flush=True)
+                    return
+                print(f"[manager] child exited rc={rc}; restarting", flush=True)
+                break
+            age = heartbeat_age(args.model_path)
+            elapsed = time.time() - started
+            if age == float("inf"):
+                # no heartbeat yet: healthy while within the compile/restore
+                # startup grace window
+                unhealthy = elapsed > args.startup_grace
+            else:
+                unhealthy = age > args.stall_timeout
+            if unhealthy:
+                print(f"[manager] heartbeat stale ({age:.0f}s, "
+                      f"elapsed {elapsed:.0f}s); killing", flush=True)
+                kill_group(proc)
+                break
+        restarts += 1
+        if args.recreate_cmd:
+            print(f"[manager] recreate: {args.recreate_cmd}", flush=True)
+            subprocess.run(args.recreate_cmd, shell=True, check=False)
+    print("[manager] max restarts exceeded", file=sys.stderr)
+    sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

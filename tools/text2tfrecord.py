@@ -41,7 +41,7 @@ def encode_file(path: str, merges: typing.Optional[np.ndarray]
 
 
 def _work(job) -> str:
-    shard_idx, paths, out_dir, tokenizer_path, records_per_shard = job
+    shard_idx, paths, out_dir, tokenizer_path = job
     merges = None
     suffix = "bytes"
     if tokenizer_path:
@@ -76,7 +76,7 @@ def main() -> None:
     jobs = []
     for i in range(0, len(args.input), args.files_per_shard):
         jobs.append((len(jobs), args.input[i:i + args.files_per_shard],
-                     args.output_dir, args.tokenizer, args.files_per_shard))
+                     args.output_dir, args.tokenizer))
     with multiprocessing.Pool(min(args.procs, len(jobs))) as pool:
         for out in pool.imap_unordered(_work, jobs):
             print(out, flush=True)

@@ -18,7 +18,6 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import re
 import sys
 import typing
 
@@ -26,22 +25,21 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from homebrewnlp_tpu.native import available, bpe_train, clean_text  # noqa: E402
-
-# whitespace pre-split: merges never cross word boundaries (the reference
-# uses an equivalent regex pre-split, train_tokenizer.pyx:180-187)
-WORD_RE = re.compile(rb"\s")
+from homebrewnlp_tpu.native import available, bpe_train_words, clean_text  # noqa: E402
 
 
 def _chunks(path: str, limit: int) -> typing.Iterator[bytes]:
     """Yield text chunks; JSONL files are iterated line-by-line so records
     never straddle a read boundary (arbitrary-size documents parse whole)."""
+    import io
     opener = open
     if path.endswith(".zst"):
         import zstandard  # optional; Pile shards
 
         def opener(p, mode="rb"):
-            return zstandard.open(p, mode)
+            # ZstdDecompressionReader has no readline; buffer it for line
+            # iteration
+            return io.BufferedReader(zstandard.open(p, mode))
     is_jsonl = path.endswith((".jsonl", ".jsonl.zst"))
     with opener(path, "rb") as f:
         if is_jsonl:
@@ -65,25 +63,25 @@ def _chunks(path: str, limit: int) -> typing.Iterator[bytes]:
                 yield chunk
 
 
-def corpus_tokens(paths: typing.Sequence[str], limit_bytes: int
-                  ) -> np.ndarray:
-    """Byte tokens with -1 boundaries at whitespace splits."""
-    stream: typing.List[np.ndarray] = []
+def corpus_word_counts(paths: typing.Sequence[str], limit_bytes: int
+                       ) -> typing.Dict[bytes, int]:
+    """Deduplicated {word-as-int32-token-bytes: count} — the HF-BpeTrainer
+    structure the native trainer consumes; whole corpus never materializes
+    as one token stream."""
+    from collections import Counter
+    counter: typing.Counter[bytes] = Counter()
     total = 0
-    boundary = np.asarray([-1], np.int32)
     for path in paths:
         for chunk in _chunks(path, limit_bytes - total):
             chunk = clean_text(chunk)
             total += len(chunk)
-            for piece in WORD_RE.split(chunk):
-                if piece:
-                    stream.append(np.frombuffer(piece, np.uint8).astype(np.int32))
-                    stream.append(boundary)
+            counter.update(chunk.split())  # whitespace-run word split
             if total >= limit_bytes:
                 break
-    if not stream:
+    if not counter:
         raise SystemExit("empty corpus")
-    return np.concatenate(stream)
+    return {np.frombuffer(word, np.uint8).astype(np.int32).tobytes(): c
+            for word, c in counter.items()}
 
 
 def main() -> None:
@@ -103,10 +101,12 @@ def main() -> None:
         return
 
     print(f"native library: {'yes' if available() else 'no (python fallback)'}")
-    tokens = corpus_tokens(args.input, args.limit_mb << 20)
+    words = corpus_word_counts(args.input, args.limit_mb << 20)
     n_merges = args.vocab_size - 256
-    print(f"training {n_merges} merges over {len(tokens)} tokens")
-    pairs = bpe_train(tokens, n_merges, first_new_id=256)
+    n_tokens = sum(len(w) // 4 * c for w, c in words.items())
+    print(f"training {n_merges} merges over {len(words)} unique words "
+          f"({n_tokens} tokens)")
+    pairs = bpe_train_words(words, n_merges, first_new_id=256)
     vocab = {"type": "bpe", "byte_fallback": True, "first_new_id": 256,
              "merges": pairs.tolist()}
     with open(args.output, "w") as f:

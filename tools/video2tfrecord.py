@@ -1,0 +1,158 @@
+"""Video -> TFRecord shard builder.
+
+Port of /root/reference/scripts/video2tfrecord.py (922 LoC): that pipeline
+scrapes YouTube through proxies, parses VTT subtitles with per-timestamp BPE
+alignment, extracts frames via ffmpeg/cv2 workers, and balances work by
+duration.  The zero-egress port keeps everything after the download: local
+video files -> cv2 frame extraction at a target fps, resize to the config's
+frame geometry, optional subtitle (SRT/VTT) token alignment per frame,
+``concat``/``skip_frame`` flags between videos, multiprocess workers balanced
+by duration (the reference's ``split_equal``, :168-183).
+
+Usage:
+  python tools/video2tfrecord.py --model configs/video.json \
+      --input a.mp4 b.mp4 [--subs a.vtt b.vtt] --output-dir datasets/video \
+      [--fps 1] [--procs 4]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing
+import os
+import re
+import sys
+import typing
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from homebrewnlp_tpu.config import Config  # noqa: E402
+from homebrewnlp_tpu.data.tfrecord import encode_example  # noqa: E402
+from homebrewnlp_tpu.native import write_records  # noqa: E402
+
+TS_RE = re.compile(
+    r"(\d+):(\d\d):(\d\d)[.,](\d+)\s*-->\s*(\d+):(\d\d):(\d\d)[.,](\d+)")
+
+
+def parse_subs(path: str) -> typing.List[typing.Tuple[float, float, str]]:
+    """SRT/VTT -> [(start_s, end_s, text)] (reference :186-360 minus the
+    HTML-tag/karaoke handling its YouTube VTTs need)."""
+    out = []
+    text_lines: typing.List[str] = []
+    span = None
+    for line in open(path, encoding="utf-8", errors="replace"):
+        line = line.strip()
+        m = TS_RE.match(line)
+        if m:
+            if span and text_lines:
+                out.append((*span, " ".join(text_lines)))
+            h1, m1, s1, f1, h2, m2, s2, f2 = m.groups()
+            span = (int(h1) * 3600 + int(m1) * 60 + int(s1) + float(f"0.{f1}"),
+                    int(h2) * 3600 + int(m2) * 60 + int(s2) + float(f"0.{f2}"))
+            text_lines = []
+        elif line and span and not line.isdigit() and "WEBVTT" not in line:
+            text_lines.append(re.sub(r"<[^>]+>", "", line))
+    if span and text_lines:
+        out.append((*span, " ".join(text_lines)))
+    return out
+
+
+def split_equal(durations: typing.Sequence[float], n: int
+                ) -> typing.List[typing.List[int]]:
+    """Balance items over n workers by duration (reference :168-183 —
+    greedy into the lightest bucket)."""
+    buckets: typing.List[typing.List[int]] = [[] for _ in range(n)]
+    loads = [0.0] * n
+    for idx in sorted(range(len(durations)), key=lambda i: -durations[i]):
+        tgt = loads.index(min(loads))
+        buckets[tgt].append(idx)
+        loads[tgt] += durations[idx]
+    return [b for b in buckets if b]
+
+
+def video_frames(path: str, fps: float, width: int, height: int):
+    import cv2
+    cap = cv2.VideoCapture(path)
+    native_fps = cap.get(cv2.CAP_PROP_FPS) or 30.0
+    step = max(1, round(native_fps / fps))
+    i = 0
+    while True:
+        ok, frame = cap.read()
+        if not ok:
+            break
+        if i % step == 0:
+            frame = cv2.resize(frame, (width, height))
+            yield i / native_fps, cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+        i += 1
+    cap.release()
+
+
+def _encode_video(job) -> str:
+    (worker_idx, video_paths, sub_paths, out_dir, cfg_path, fps) = job
+    import cv2
+    cfg = Config.from_json(cfg_path) if cfg_path else None
+    width = cfg.frame_width if cfg else 320
+    height = cfg.frame_height if cfg else 176
+    ltpf = cfg.language_token_per_frame if cfg else 0
+    payloads = []
+    for vid_idx, path in enumerate(video_paths):
+        subs = parse_subs(sub_paths[vid_idx]) if sub_paths else []
+        first = True
+        for ts, frame in video_frames(path, fps, width, height):
+            ok, jpg = cv2.imencode(".jpg", cv2.cvtColor(frame,
+                                                        cv2.COLOR_RGB2BGR))
+            assert ok
+            feats: typing.Dict[str, typing.Any] = {
+                "frame": jpg.tobytes(),
+                "concat": [int(first)],
+                "skip_frame": [0],
+            }
+            if ltpf:
+                text = " ".join(t for s, e, t in subs if s <= ts < e)
+                toks = list(text.encode())[:ltpf]
+                feats["tokens"] = toks + [0] * (ltpf - len(toks))
+                feats["mask"] = [len(toks)]
+            payloads.append(encode_example(feats))
+            first = False
+    out = os.path.join(out_dir, f"video{worker_idx:05d}_{len(payloads)}.tfrecord")
+    write_records(out, payloads)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", nargs="+", required=True, help="video files")
+    ap.add_argument("--subs", nargs="*", default=None,
+                    help="subtitle files (parallel to --input)")
+    ap.add_argument("--model", default="", help="config JSON for frame "
+                    "geometry / language_token_per_frame")
+    ap.add_argument("--output-dir", required=True)
+    ap.add_argument("--fps", type=float, default=1.0)
+    ap.add_argument("--procs", type=int, default=os.cpu_count())
+    args = ap.parse_args()
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    import cv2
+    durations = []
+    for p in args.input:
+        cap = cv2.VideoCapture(p)
+        n = cap.get(cv2.CAP_PROP_FRAME_COUNT) or 0
+        f = cap.get(cv2.CAP_PROP_FPS) or 30.0
+        durations.append(n / f)
+        cap.release()
+
+    buckets = split_equal(durations, max(1, args.procs))
+    jobs = []
+    for w, bucket in enumerate(buckets):
+        jobs.append((w, [args.input[i] for i in bucket],
+                     [args.subs[i] for i in bucket] if args.subs else None,
+                     args.output_dir, args.model, args.fps))
+    with multiprocessing.Pool(len(jobs)) as pool:
+        for out in pool.imap_unordered(_encode_video, jobs):
+            print(out, flush=True)
+
+
+if __name__ == "__main__":
+    main()
