@@ -251,6 +251,15 @@ class Config:
             self.features_per_head = self.features // self.heads
         if self.use_video and (self.frame_width * self.frame_height // self.patch_size) % self.experts:
             raise ValueError("Frame size must be divisible by expert count")
+        if self.use_video and self.use_language and self.three_axes:
+            # joint mode concatenates text along the video's "height" axis,
+            # which requires the flattened (height*width) video layout — the
+            # reference implicitly requires the same (dataclass.py:334 names
+            # the token patch-count dim "height"; mtf.concat would reject the
+            # extra width axis)
+            print("WARNING: three_axes disabled — joint video+language mode "
+                  "requires the flattened spatial layout")
+            self.three_axes = False
         if self.intermediate_feed_forward_multiplier_multiplier is not None:
             self.intermediate_feed_forward_multiplier = (
                 self.group_linear_factor

@@ -39,8 +39,10 @@ def axes_for(name: str, arr: np.ndarray, cfg: Config) -> typing.Tuple[str, ...]:
     if name == "frame" and not cfg.three_axes:
         names = ("batch", "_sequence", "height", "color_channels")
     if name in ("token_x", "token_y", "txt_msk") and arr.ndim == 4:
-        # jannet token layout [batch, sequence, token_patch, patch_size]
-        names = ("batch", "sequence", "language_token_patch", "_token_patch")
+        # joint video+language token layout: the patch-count dim is NAMED
+        # "height" so text concatenates with the flattened video along one
+        # shared spatial axis (reference dataclass.py:334)
+        names = ("batch", "sequence", "height", "language_token_patch")
     return names[:arr.ndim]
 
 

@@ -14,16 +14,19 @@ import typing
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..config import ANON_PREFIX, BATCH, HEADS, SEQUENCE
+from ..config import ANON_PREFIX, BATCH, EXPERTS, HEADS, SEQUENCE
 from ..nd import NT
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
-# logical axis -> mesh axis.  Everything else is replicated, matching the
-# reference layout which splits only batch and heads (SURVEY.md §2.12).
+# logical axis -> mesh axis.  Everything else is replicated — the reference
+# layout splits only batch and heads (SURVEY.md §2.12); the experts mapping
+# is our expert-parallel extension (the reference's MoE expert axis is never
+# laid out, §2.12 row EP).
 RULES: typing.Dict[str, str] = {
     BATCH: DATA_AXIS,
     HEADS: MODEL_AXIS,
     SEQUENCE: SEQ_AXIS,
+    EXPERTS: MODEL_AXIS,
 }
 
 
@@ -32,13 +35,18 @@ def spec_for(names: typing.Sequence[str], mesh: Mesh,
              ) -> PartitionSpec:
     """PartitionSpec for a tuple of logical axis names.  Mesh axes of size 1
     are omitted (XLA treats them as replicated anyway, and omitting keeps
-    specs valid on smaller meshes)."""
+    specs valid on smaller meshes).  A mesh axis is used at most once per
+    spec, first logical axis wins — e.g. an MoE weight carrying both heads
+    and experts shards heads over the model axis and replicates experts."""
     rules = RULES if rules is None else rules
-    parts = []
+    parts: typing.List[typing.Optional[str]] = []
+    used: typing.Set[str] = set()
     for n in names:
         mesh_axis = None if n.startswith(ANON_PREFIX) else rules.get(n)
-        if mesh_axis is not None and mesh.shape.get(mesh_axis, 1) > 1:
+        if (mesh_axis is not None and mesh_axis not in used
+                and mesh.shape.get(mesh_axis, 1) > 1):
             parts.append(mesh_axis)
+            used.add(mesh_axis)
         else:
             parts.append(None)
     while parts and parts[-1] is None:
