@@ -167,8 +167,28 @@ def einsum(inputs: typing.Sequence[NT], out_names: typing.Sequence[str],
             raise ValueError(f"output axis {n} not present in any input")
     spec = ",".join("".join(mapping[n] for n in t.names) for t in inputs)
     spec += "->" + "".join(mapping[n] for n in out_names)
-    x = jnp.einsum(spec, *[t.x for t in inputs], precision=precision,
-                   preferred_element_type=inputs[0].dtype)
+    # Accumulate half-precision matmuls in f32 (free on the MXU, strictly
+    # better numerically — same policy as ops/losses.py) and cast the result
+    # back to the input dtype so activation storage stays half-precision.
+    # TPU runs the native bf16 x bf16 -> f32 MXU dot; other backends (the
+    # CPU test mesh can't execute that thunk) upcast the operands instead —
+    # bit-identical, since half-precision products are exact in f32.
+    in_dtype = inputs[0].dtype
+    arrays = [t.x for t in inputs]
+    if in_dtype in (jnp.bfloat16, jnp.float16):
+        def _tpu(*xs):
+            return jnp.einsum(spec, *xs, precision=precision,
+                              preferred_element_type=jnp.float32)
+
+        def _generic(*xs):
+            return jnp.einsum(spec, *[x.astype(jnp.float32) for x in xs],
+                              precision=precision)
+
+        x = jax.lax.platform_dependent(*arrays, tpu=_tpu, default=_generic)
+        x = x.astype(in_dtype)
+    else:
+        x = jnp.einsum(spec, *arrays, precision=precision,
+                       preferred_element_type=in_dtype)
     return NT(x, out_names)
 
 

@@ -110,3 +110,29 @@ def test_dtype_policy_bf16():
     loss = jax.jit(loss_fn)(params, jax.random.key(0))
     assert jnp.isfinite(loss)
     assert loss.dtype == jnp.float32  # losses accumulate in f32
+
+
+def test_einsum_f32_accumulation():
+    """bf16 einsum must accumulate in f32 (preferred_element_type) and cast
+    back — output dtype bf16, but dot_general runs with an f32 accumulator."""
+    from homebrewnlp_tpu import nd
+    from homebrewnlp_tpu.nd import NT
+
+    a = NT(jnp.ones((4, 8), jnp.bfloat16), ("row", "inner"))
+    b = NT(jnp.ones((8, 3), jnp.bfloat16), ("inner", "col"))
+
+    out = nd.einsum([a, b], ("row", "col"))
+    assert out.dtype == jnp.bfloat16  # storage stays half-precision
+
+    jaxpr = jax.make_jaxpr(
+        lambda x, y: nd.einsum([NT(x, a.names), NT(y, b.names)],
+                               ("row", "col")).x)(a.x, b.x)
+    dots = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "dot_general"]
+    assert dots, "einsum should lower to dot_general"
+    for e in dots:
+        assert e.params["preferred_element_type"] == jnp.float32
+
+    # f32 inputs keep an f32 accumulator and f32 output
+    af = NT(jnp.ones((4, 8), jnp.float32), ("row", "inner"))
+    bf = NT(jnp.ones((8, 3), jnp.float32), ("inner", "col"))
+    assert nd.einsum([af, bf], ("row", "col")).dtype == jnp.float32
