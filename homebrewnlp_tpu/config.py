@@ -6,7 +6,7 @@ explicit derivation, without the dict-compat shims.  The whole parallelism
 configuration of the reference is two integers (``tpu_size``, ``heads``) that
 synthesize a (mesh_shape, layout) pair (dataclass.py:247-252); here the same two
 integers synthesize a `jax.sharding.Mesh` axis layout (see parallel/mesh.py),
-extended with optional sequence-parallel and pipeline axes the reference lacks.
+extended with an optional sequence-parallel axis the reference lacks.
 """
 from __future__ import annotations
 
@@ -111,13 +111,11 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     shuffle_buffer=256,
     interleaved_datasets=256,
     buffer_size=4,
-    parallel_batch=None,
     parallel_interleave=None,
     shuffle_input_filenames=True,
     use_bit_fold_input_pipeline=False,
     bit_fold_value=4,
     color_quantization_value=256,
-    prefix="datasets/full_hd_video",
     # training
     train=True,
     train_batch_size=1,
@@ -135,7 +133,6 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     weight_standardisation=True,
     rezero_lr_multiplier=0.1,
     train_steps=2 ** 30,
-    warmup_steps=3000,
     z_loss=1e-4,
     calc_accuracy=False,
     multi_loss_strategy="linear",
@@ -144,7 +141,6 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     debug_train_step=False,
     debug_gradients=False,
     current_step=0,
-    iterations=2500,
     steps_per_checkpoint=100_000,
     use_checkpointing=False,
     max_checkpoints_keep=1,
@@ -176,7 +172,6 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # parallelism (the reference's two knobs, plus TPU-native extensions)
     tpu_size=32,
     sequence_parallel=1,  # extension: size of the sequence-parallel mesh axis
-    pipeline_parallel=1,  # extension: pipeline stages (1 = off)
     # sampling / serving
     initial_autoregressive_position=128,
     use_autoregressive_sampling=False,
@@ -198,6 +193,12 @@ class Config:
     def __init__(self, config: typing.Optional[dict] = None):
         self.__dict__.update(_DEFAULTS)
         config = dict(config or {})
+        # rejected (not silently ignored): pipeline parallelism is not
+        # implemented — scale via the data/model/sequence_parallel axes
+        if config.pop("pipeline_parallel", 1) != 1:
+            raise NotImplementedError(
+                "pipeline_parallel is not supported; use tpu_size/heads "
+                "(data x model) and sequence_parallel instead")
         for k, v in config.items():
             if k not in _DEFAULTS and k not in ("mesh_shape", "layout"):
                 print(f"WARNING: Unknown Config parameter {k}={v!r}")
@@ -211,9 +212,12 @@ class Config:
 
     # -- derivation ---------------------------------------------------------
     def _validate_and_derive(self) -> None:
-        if self.grad_accumulation > 1 and self.macro_batching % self.grad_accumulation:
-            raise ValueError("macro_batching must be divisible by grad_accumulation")
+        # macro_batching inflates the host batch by M (reference
+        # dataloader_placement.py:40-44); grad_accumulation splits each
+        # configured batch into G micro-slices.  The train step scans M*G
+        # micro-batches per optimizer update (train/state.py).
         assert self.macro_batching > 0
+        assert self.grad_accumulation > 0
 
         for attr in ("position_embedding", "token_embedding", "output_embedding",
                      "empty_frame_embedding"):
@@ -313,9 +317,9 @@ class Config:
         self.feature_dims = (HEADS, KEY)
 
         # parallelism synthesis: reference maps batch->b, heads->h
-        # (dataclass.py:247-252); we extend with sequence/pipeline axes.
+        # (dataclass.py:247-252); we extend with a sequence-parallel axis.
         self.mesh_data = max(1, self.tpu_size // (
-            self.heads * self.sequence_parallel * self.pipeline_parallel))
+            self.heads * self.sequence_parallel))
         self.mesh_model = self.heads if self.heads > 1 else 1
 
     # -- convenience --------------------------------------------------------

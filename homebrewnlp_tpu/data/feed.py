@@ -46,12 +46,31 @@ def axes_for(name: str, arr: np.ndarray, cfg: Config) -> typing.Tuple[str, ...]:
     return names[:arr.ndim]
 
 
+def local_row_slice(index: typing.Tuple[slice, ...], local_rows: int,
+                    global_rows: int) -> slice:
+    """Translate a device's GLOBAL batch-row request into LOCAL row offsets.
+
+    Each process holds ``local_rows`` consecutive global rows (process p owns
+    [p*local_rows, (p+1)*local_rows)); a device request must stay inside its
+    process's span — the data-axis sharding guarantees it when the per-process
+    batch divides evenly over that process's devices."""
+    start = index[0].start or 0
+    stop = index[0].stop if index[0].stop is not None else global_rows
+    local_start = start % local_rows
+    if local_start + (stop - start) > local_rows:
+        raise ValueError(
+            f"device requests rows [{start},{stop}) crossing a process "
+            f"boundary (local batch {local_rows}) — the data-axis sharding "
+            "must align with per-process batches")
+    return slice(local_start, local_start + (stop - start))
+
+
 def to_global(batch: typing.Dict[str, np.ndarray], cfg: Config, mesh: Mesh
               ) -> typing.Dict[str, NT]:
     """Assemble the per-host numpy batch into global NT arrays on the mesh.
 
     The batch passed in is this host's shard (local batch rows); global shape
-    is inferred as local * data-axis-span of this process's devices."""
+    is inferred as local * process count."""
     out: typing.Dict[str, NT] = {}
     n_procs = jax.process_count()
     for name, arr in batch.items():
@@ -59,13 +78,9 @@ def to_global(batch: typing.Dict[str, np.ndarray], cfg: Config, mesh: Mesh
         sharding = NamedSharding(mesh, spec_for(names, mesh))
         global_shape = (arr.shape[0] * n_procs,) + arr.shape[1:]
 
-        def cb(index, arr=arr, sharding=sharding):
-            # index is a global slice; translate to local row offsets
-            start = index[0].start or 0
-            stop = index[0].stop if index[0].stop is not None else global_shape[0]
-            local_start = start % arr.shape[0]
-            return arr[(slice(local_start, local_start + (stop - start)),)
-                       + index[1:]]
+        def cb(index, arr=arr, global_rows=global_shape[0]):
+            rows = local_row_slice(index, arr.shape[0], global_rows)
+            return arr[(rows,) + tuple(index[1:])]
 
         x = jax.make_array_from_callback(global_shape, sharding, cb)
         out[name] = NT(x, names)

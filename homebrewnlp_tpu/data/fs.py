@@ -1,0 +1,99 @@
+"""Pluggable filesystem layer: local paths + remote URLs (gs://, s3://, ...).
+
+The reference trains straight from GCS: dataset globs are ``gs://`` paths
+(/root/reference/configs/32big_mixer.json:37), the TFRecord builders upload
+shards with bounded retry (scripts/text2tfrecord.py:61-89), and run logs
+stream to GCS (scripts/run_manager.py:26-56).  This module is the single
+switch point: anything with a ``://`` scheme goes through fsspec (gcsfs
+backs ``gs://``); bare paths use the stdlib, so local work never pays the
+fsspec import.
+
+Orbax checkpoints take ``gs://`` paths natively (tensorstore), so checkpoint
+IO needs no help from here.
+"""
+from __future__ import annotations
+
+import glob as globlib
+import os
+import time
+import typing
+
+
+def is_remote(path: str) -> bool:
+    return "://" in str(path)
+
+
+def open_stream(path: str, mode: str = "rb"):
+    """Open local files via the stdlib, ``scheme://`` URLs via fsspec.
+    Remote reads are block-cached by fsspec, so the TFRecord reader's
+    seek-heavy skip path stays efficient."""
+    if not is_remote(path):
+        return open(path, mode)
+    import fsspec
+    return fsspec.open(path, mode).open()
+
+
+def glob(pattern: str) -> typing.List[str]:
+    """Glob local patterns or remote URLs; remote results keep their scheme
+    prefix so downstream opens route back through fsspec."""
+    if not is_remote(pattern):
+        return globlib.glob(pattern)
+    import fsspec
+    fsys, _, paths = fsspec.get_fs_token_paths(pattern)
+    protocol = pattern.split("://", 1)[0]
+    return [p if is_remote(p) else f"{protocol}://{p}" for p in paths]
+
+
+def exists(path: str) -> bool:
+    if not is_remote(path):
+        return os.path.exists(path)
+    import fsspec
+    fsys, _, (p,) = fsspec.get_fs_token_paths(path)
+    return fsys.exists(p)
+
+
+def put_with_retry(local_path: str, remote_path: str, retries: int = 5,
+                   base_delay: float = 1.0) -> None:
+    """Upload a local file with exponential backoff (the reference's GCS
+    upload loop, scripts/text2tfrecord.py:61-89).  A plain copy for local
+    destinations."""
+    if not is_remote(remote_path):
+        import shutil
+        os.makedirs(os.path.dirname(os.path.abspath(remote_path)), exist_ok=True)
+        shutil.copyfile(local_path, remote_path)
+        return
+    import fsspec
+    fsys, _, (dest,) = fsspec.get_fs_token_paths(remote_path)
+    last: typing.Optional[BaseException] = None
+    for attempt in range(retries):
+        try:
+            fsys.put_file(local_path, dest)
+            return
+        except Exception as e:  # noqa: BLE001 - network errors vary by backend
+            last = e
+            time.sleep(base_delay * 2 ** attempt)
+    raise IOError(f"upload {local_path} -> {remote_path} failed "
+                  f"after {retries} attempts") from last
+
+
+def write_with_retry(path: str, data: bytes, retries: int = 5,
+                     base_delay: float = 1.0) -> None:
+    """Write bytes (small artifacts: logs, manifests) with retry on remote
+    targets."""
+    if not is_remote(path):
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+        return
+    last: typing.Optional[BaseException] = None
+    for attempt in range(retries):
+        try:
+            with open_stream(path, "wb") as f:
+                f.write(data)
+            return
+        except Exception as e:  # noqa: BLE001
+            last = e
+            time.sleep(base_delay * 2 ** attempt)
+    raise IOError(f"write {path} failed after {retries} attempts") from last

@@ -224,11 +224,11 @@ class GptPipeline:
                  slice_count: int = 1,
                  paths: typing.Optional[typing.Sequence[str]] = None,
                  runs_log: typing.Optional[typing.Sequence[dict]] = None):
-        import glob as globlib
+        from . import fs
         if paths is None:
             paths = []
             for dset in cfg.dataset_configs:
-                paths.extend(globlib.glob(dset["path"]))
+                paths.extend(fs.glob(dset["path"]))
         self.cfg = cfg
         self.batch = sub_batch_size
         files, file_skips = split_files(
@@ -285,12 +285,12 @@ class JannetTextPipeline:
     def __init__(self, cfg: Config, sub_batch_size: int, slice_index: int = 0,
                  slice_count: int = 1,
                  paths: typing.Optional[typing.Sequence[str]] = None):
-        import glob as globlib
+        from . import fs
         if paths is None:
             paths = []
             for dset in cfg.dataset_configs:
                 if dset["type"] == "text":
-                    paths.extend(globlib.glob(dset["path"]))
+                    paths.extend(fs.glob(dset["path"]))
         self.cfg = cfg
         self.batch = sub_batch_size
         files, skips = split_files(paths, slice_index, slice_count,
@@ -381,6 +381,62 @@ class MixturePipeline:
                 child.load_state_dict(s)
 
 
+class Prefetcher:
+    """Background-thread batch prefetch with a bounded queue of
+    ``cfg.buffer_size`` batches (the reference's ``dataset.prefetch(
+    params.buffer_size)``, dataloader_placement.py:157).
+
+    Resume stays exact: the producer snapshots the inner pipeline's cursor
+    *after* producing each batch and attaches it to the queue entry, so
+    ``state_dict`` reflects the last batch actually handed to the consumer —
+    batches still sitting in the queue are not lost."""
+
+    _DONE = object()
+
+    def __init__(self, inner, depth: int):
+        self.inner = inner
+        self.depth = max(1, int(depth))
+        self._state = getattr(inner, "state_dict", dict)()
+        self._thread = None
+        self._queue = None
+
+    def __iter__(self):
+        import queue as queuelib
+        import threading
+
+        self._queue = queuelib.Queue(maxsize=self.depth)
+        err: typing.List[BaseException] = []
+
+        def produce():
+            try:
+                for item in self.inner:
+                    self._queue.put(
+                        (item, getattr(self.inner, "state_dict", dict)()))
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                self._queue.put((self._DONE, None))
+
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+        while True:
+            item, state = self._queue.get()
+            if item is self._DONE:
+                if err:
+                    raise err[0]
+                return
+            self._state = state
+            yield item
+
+    def state_dict(self) -> dict:
+        return dict(self._state)
+
+    def load_state_dict(self, state: dict) -> None:
+        if hasattr(self.inner, "load_state_dict"):
+            self.inner.load_state_dict(state)
+        self._state = dict(state)
+
+
 def dataset(cfg: Config, sub_batch_size: int, slice_index: int = 0,
             slice_count: int = 1):
     """Mixture entry point mirroring the reference API (inputs.py:486-525)."""
@@ -404,6 +460,8 @@ def dataset(cfg: Config, sub_batch_size: int, slice_index: int = 0,
         else:
             raise ValueError(f"unsupported dataset type {kind}")
         weights.append(dset.get("weight", 1.0))
-    if len(children) == 1:
-        return children[0]
-    return MixturePipeline(children, weights, cfg.data_seed)
+    pipe = (children[0] if len(children) == 1
+            else MixturePipeline(children, weights, cfg.data_seed))
+    if cfg.buffer_size and cfg.buffer_size > 0:
+        pipe = Prefetcher(pipe, cfg.buffer_size)
+    return pipe

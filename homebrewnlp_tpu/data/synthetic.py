@@ -19,11 +19,40 @@ def synthetic_text_batch(cfg: Config, step: int = 0, seed: int = 0
                          ) -> typing.Dict[str, np.ndarray]:
     rng = np.random.default_rng((seed, step))
     rows = cfg.sequence_length // cfg.token_patch_size
-    shape = (cfg.train_batch_size, rows + cfg.output_offset,
-             cfg.token_patch_size)
+    # macro-batching inflates the host batch (reference
+    # dataloader_placement.py:40-44)
+    shape = (cfg.train_batch_size * cfg.macro_batching,
+             rows + cfg.output_offset, cfg.token_patch_size)
     stream = rng.integers(0, cfg.vocab_size, shape, np.int32)
     return {"token_x": stream[:, :rows],
             "token_y": stream[:, cfg.output_offset:rows + cfg.output_offset]}
+
+
+def synthetic_video_batch(cfg: Config, step: int = 0, seed: int = 0
+                          ) -> typing.Dict[str, np.ndarray]:
+    """Random jannet-mode batch matching VideoPipeline's output shapes."""
+    rng = np.random.default_rng((seed, step, 7))
+    b = cfg.train_batch_size * cfg.macro_batching
+    t = cfg.time_patch_size
+    frame_shape = ((b, t + 1, cfg.frame_height_patch, cfg.frame_width_patch,
+                    cfg.channel_color_size) if cfg.three_axes else
+                   (b, t + 1, cfg.frame_height_patch * cfg.frame_width_patch,
+                    cfg.channel_color_size))
+    out = {
+        "frame": rng.integers(0, 256, frame_shape, np.int32),
+        "vid_msk_src": np.ones((b, t), bool),
+        "vid_msk_tgt": np.ones((b, t), bool),
+        "cat_mask_x": np.ones((b, t), bool),
+        "cat_mask_y": np.ones((b, t), bool),
+    }
+    if cfg.use_language and cfg.language_token_per_frame > 0:
+        toks = rng.integers(0, cfg.vocab_size,
+                            (b, t + 1, cfg.language_token_patch,
+                             cfg.token_patch_size), np.int32)
+        out["token_x"] = toks[:, :t]
+        out["token_y"] = toks[:, 1:t + 1]
+        out["txt_msk"] = np.ones_like(out["token_y"], bool)
+    return out
 
 
 def write_text_tfrecords(directory: str, n_files: int, records_per_file: int,

@@ -181,8 +181,10 @@ def decode_example(buf: bytes) -> typing.Dict[str, typing.Union[typing.List[byte
 
 class RecordWriter:
     def __init__(self, path: str, append: bool = False):
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._f = open(path, "ab" if append else "wb")
+        from . import fs
+        if not fs.is_remote(path):
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = fs.open_stream(path, "ab" if append else "wb")
 
     def write(self, record: bytes) -> None:
         header = struct.pack("<Q", len(record))
@@ -203,8 +205,10 @@ class RecordWriter:
 
 def read_records(path: str, verify: bool = False,
                  skip: int = 0) -> typing.Iterator[bytes]:
-    """Yield raw record payloads; ``skip`` fast-forwards without CRC work."""
-    with open(path, "rb") as f:
+    """Yield raw record payloads; ``skip`` fast-forwards without CRC work.
+    ``path`` may be a remote URL (gs://...) — see data/fs.py."""
+    from . import fs
+    with fs.open_stream(path, "rb") as f:
         index = 0
         while True:
             header = f.read(8)
@@ -229,8 +233,9 @@ def read_records(path: str, verify: bool = False,
 
 
 def count_records(path: str) -> int:
+    from . import fs
     n = 0
-    with open(path, "rb") as f:
+    with fs.open_stream(path, "rb") as f:
         while True:
             header = f.read(8)
             if len(header) < 8:

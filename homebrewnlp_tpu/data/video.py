@@ -84,49 +84,91 @@ class FrameDecoder:
 
 
 class VideoPipeline:
-    """Windowed, batched video (+token) samples (reference dataset_video)."""
+    """Windowed, batched video (+token) samples (reference dataset_video).
+
+    Resume is exact at batch granularity: the cursor records (file index,
+    windows emitted within that file) as of the last yielded batch — the
+    batch buffer is empty at every yield, so replaying from the cursor
+    reproduces the uninterrupted stream (the round-1 ``next_file``-only
+    cursor lost intra-file position)."""
 
     def __init__(self, cfg: Config, sub_batch_size: int, slice_index: int = 0,
                  slice_count: int = 1,
                  paths: typing.Optional[typing.Sequence[str]] = None,
                  path_glob: typing.Optional[str] = None):
-        import glob as globlib
+        from . import fs
         if paths is None:
-            paths = globlib.glob(path_glob) if path_glob else []
+            paths = fs.glob(path_glob) if path_glob else []
         self.cfg = cfg
         self.batch = sub_batch_size
         self.files, _ = split_files(paths, slice_index, slice_count,
                                     cfg.data_seed * int(cfg.shuffle_input_filenames))
         self.decoder = FrameDecoder(cfg)
-        self.next_file = 0
+        # cursor: next window position in the stream (file_idx may equal
+        # len(files): the repeat loop wraps it)
+        self.file_idx = 0
+        self.windows_done = 0
+        # deterministic order-preserving JPEG decode parallelism (the tf.data
+        # ``num_parallel_calls=parallel_interleave`` analogue, reference
+        # inputs.py:556-559); cv2 releases the GIL
+        self._workers = int(cfg.parallel_interleave or 1)
 
-    def _file_windows(self, path: str):
+    def _decode_records(self, path: str, skip_records: int = 0):
+        records = read_records(path, skip=skip_records)
+        if self._workers <= 1:
+            for payload in records:
+                yield self.decoder(payload)
+            return
+        from multiprocessing.pool import ThreadPool
+        # pool per file so worker threads are torn down deterministically
+        # (a long-lived pool would keep non-daemon threads alive at exit)
+        with ThreadPool(self._workers) as pool:
+            yield from pool.imap(self.decoder, records, chunksize=4)
+
+    def _file_windows(self, path: str, skip_windows: int = 0):
         cfg = self.cfg
         size = cfg.sequence_length + cfg.time_patch
+        # window k consumes records [k*shift, k*shift + size): resume skips
+        # the first skip_windows*shift records RAW (no JPEG decode) and
+        # restarts the window buffer at that record boundary
+        start_record = skip_windows * cfg.sequence_length
         buf: typing.List[tuple] = []
-        for payload in read_records(path):
-            buf.append(self.decoder(payload))
+        for item in self._decode_records(path, skip_records=start_record):
+            buf.append(item)
             if len(buf) == size:
                 yield buf
                 buf = buf[cfg.sequence_length:]
 
     def __iter__(self) -> typing.Iterator[typing.Dict[str, np.ndarray]]:
-        cfg = self.cfg
-        t = cfg.time_patch_size
         batch_buf: typing.List[list] = []
+        file_idx = self.file_idx
+        skip = self.windows_done
         while True:
-            if self.next_file >= len(self.files):
-                self.next_file = 0  # dataset_video repeats (inputs.py:475)
+            if file_idx >= len(self.files):
+                file_idx = 0  # dataset_video repeats (inputs.py:475)
+                skip = 0
                 if not self.files:
                     return
-            path = self.files[self.next_file]
-            self.next_file += 1
-            for window in self._file_windows(path):
+            path = self.files[file_idx]
+            produced = 0
+            for window in self._file_windows(path, skip_windows=skip):
+                produced += 1
                 batch_buf.append(window)
                 if len(batch_buf) < self.batch:
                     continue
-                yield self._assemble(batch_buf)
+                batch = self._assemble(batch_buf)
                 batch_buf.clear()
+                # commit the cursor only at batch boundaries (the buffer is
+                # empty, so (file, window) identifies the next item of the
+                # uninterrupted stream even when the buffer spanned a file
+                # boundary) and BEFORE the yield — the generator suspends at
+                # the yield, so a state_dict taken after consuming this batch
+                # must already see the advanced cursor
+                self.file_idx = file_idx
+                self.windows_done = skip + produced
+                yield batch
+            skip = 0
+            file_idx += 1
 
     def _assemble(self, windows: typing.List[list]) -> typing.Dict[str, np.ndarray]:
         cfg = self.cfg
@@ -161,7 +203,12 @@ class VideoPipeline:
         return out
 
     def state_dict(self) -> dict:
-        return {"next_file": self.next_file}
+        return {"file_idx": self.file_idx, "windows_done": self.windows_done}
 
     def load_state_dict(self, state: dict) -> None:
-        self.next_file = state["next_file"]
+        if "next_file" in state:  # round-1 coarse cursor (file-level only)
+            self.file_idx = state["next_file"]
+            self.windows_done = 0
+            return
+        self.file_idx = state["file_idx"]
+        self.windows_done = state["windows_done"]

@@ -1,4 +1,6 @@
 """Inference layer: autoregressive while-loop samplers (JAX re-design of
 /root/reference/src/run/inference.py)."""
+from .kv_cache import (cache_eligible, init_caches,  # noqa: F401
+                       make_cached_text_sampler)
 from .sampler import (autoregressive_text, autoregressive_video,  # noqa: F401
-                      make_text_sampler)
+                      forward_logits, make_single_forward, make_text_sampler)

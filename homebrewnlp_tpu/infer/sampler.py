@@ -25,12 +25,18 @@ from ..models.ctx import Ctx
 from ..nd import NT
 
 
-def _logits(cfg: Config, params: dict, batch: typing.Dict[str, NT]) -> typing.Tuple[typing.Optional[jnp.ndarray], typing.Optional[jnp.ndarray]]:
+def forward_logits(cfg: Config, params: dict, batch: typing.Dict[str, NT]
+                   ) -> typing.Tuple[typing.Optional[jnp.ndarray],
+                                     typing.Optional[jnp.ndarray]]:
+    """One forward pass -> (token logits, frame output) raw arrays."""
     ctx = Ctx(cfg, params=params, train=False, rng=None)
     out = build(ctx, batch)
     tok = out.token_out.x if out.token_out is not None else None
     frame = out.frame_out.x if out.frame_out is not None else None
     return tok, frame
+
+
+_logits = forward_logits
 
 
 def _gumbel_argmax(logits: jnp.ndarray, temperature, key: jax.Array) -> jnp.ndarray:
@@ -136,6 +142,36 @@ def autoregressive_video(cfg: Config, params: dict,
         cond, body, (jnp.asarray(pos0, jnp.int32),
                      frame.x.astype(cfg.calculation_dtype), tok0, rng))
     return (tok_filled if use_lang else None), frame_filled
+
+
+def make_single_forward(cfg: Config, params: dict):
+    """Non-autoregressive prediction (``use_autoregressive_sampling=False``,
+    reference inference.py:136-170): ONE forward pass; positions from
+    ``initial_pos`` up to ``end_iterations`` receive the one-step-ahead
+    (teacher-forced) prediction, the prompt keeps its tokens.  Same signature
+    as the autoregressive sampler so the engine can swap them."""
+
+    def fn(token_x: NT, initial_pos, temperature, rng, end_iterations=None):
+        names = token_x.names
+        seq_axis = names.index(SEQUENCE)
+        toks = token_x.x.astype(jnp.int32)
+        end = (jnp.int32(cfg.sequence_length) if end_iterations is None
+               else end_iterations)
+        batch = {"token_x": NT(toks, names),
+                 "token_y": NT(jnp.zeros_like(toks), names)}
+        logits, _ = _logits(cfg, params, batch)
+        sampled = _gumbel_argmax(logits, jnp.float32(temperature), rng)
+        zeros = jnp.zeros_like(jax.lax.slice_in_dim(sampled, 0, 1, axis=seq_axis))
+        sampled = jnp.concatenate(
+            [zeros, jax.lax.slice_in_dim(sampled, 0,
+                                         sampled.shape[seq_axis] - 1,
+                                         axis=seq_axis)], axis=seq_axis)
+        pos = jnp.arange(toks.shape[seq_axis]).reshape(
+            (1, toks.shape[seq_axis]) + (1,) * (toks.ndim - 2))
+        keep = (pos < initial_pos) | (pos >= end)
+        return jnp.where(keep, toks, sampled)
+
+    return jax.jit(fn)
 
 
 def make_text_sampler(cfg: Config, params: dict):

@@ -24,10 +24,12 @@ def parse_args(argv: typing.Optional[typing.Sequence[str]] = None):
     p.add_argument("--tpu", type=str, default="", help="unused on single host;"
                    " 'host:port,rank,size' triggers jax.distributed.initialize")
     p.add_argument("--run_mode", type=str, default="train",
-                   choices=["train", "sample", "query", "web_api", "debug"])
+                   choices=["train", "sample", "query", "web_api", "debug",
+                            "debug_old"])
     p.add_argument("--steps", type=int, default=0,
                    help="override train_steps (0 = config value)")
-    p.add_argument("--workers", type=int, default=1)
+    p.add_argument("--workers", type=int, default=None,
+                   help="override cfg.web_workers (reference src/main.py:60)")
     p.add_argument("--debug_grad", action="store_true")
     p.add_argument("--port", type=int, default=8000)
     return p.parse_args(argv)
@@ -70,11 +72,14 @@ def train(cfg, args) -> None:
     from .data.synthetic import synthetic_text_batch
     from .train import MetricWriter, color_print
 
+    from .data import fs
     have_data = bool(cfg.dataset_configs) and any(
-        __import__("glob").glob(d["path"]) for d in cfg.dataset_configs)
+        fs.glob(d["path"]) for d in cfg.dataset_configs)
     slice_index = jax.process_index()
     slice_count = max(1, jax.process_count())
-    local_batch = cfg.train_batch_size // slice_count
+    # macro-batching inflates the per-step host batch by M (reference
+    # dataloader_placement.py:40-44)
+    local_batch = cfg.train_batch_size * cfg.macro_batching // slice_count
 
     if have_data:
         pipe = dataset(cfg, local_batch, slice_index, slice_count)
@@ -89,6 +94,12 @@ def train(cfg, args) -> None:
     mesh = make_mesh(cfg)
     trainer, state, ckpt, data_state = _build_state(
         cfg, to_global(first_np, cfg, mesh), mesh)
+    if int(state.step) == 0 and cfg.current_step > 0:
+        # config-forced starting step with no checkpoint (the reference reads
+        # it from estimator internals and skips data accordingly,
+        # src/main.py:71, dataloader_placement.py:156)
+        import jax.numpy as jnp
+        state = state._replace(step=jnp.asarray(cfg.current_step, jnp.int32))
     step0 = int(state.step)
     if pipe is not None and data_state and "pipeline" in data_state:
         # resume the cursor on a *fresh* pipeline, then draw the first batch
@@ -99,37 +110,50 @@ def train(cfg, args) -> None:
         batches = iter(pipe)
         first_np = next(batches)
     elif pipe is None and step0:
-        first_np = synthetic_text_batch(cfg, step0)
+        # synthetic batches are indexed by UPDATE count (the loop below)
+        first_np = synthetic_text_batch(cfg, step0 // max(1, cfg.macro_batching))
 
     _dump_run_artifacts(cfg, trainer, state.params)
     writer = MetricWriter(cfg.model_path)
     run_log = RunLog(cfg.model_path)
+    # train_steps (and the step counter) count macro slices, reference
+    # run.py:155,249: one optimizer update advances the counter by
+    # macro_batching, so the update loop runs in units of M slices.
     steps = args.steps or cfg.train_steps
+    m = max(1, cfg.macro_batching)
+    updates_total = -(-steps // m)
+    u0 = step0 // m
+    ckpt_every = max(1, cfg.steps_per_checkpoint // m)
     rng = jax.random.key(cfg.data_seed)
     t0 = time.time()
     np_batch = first_np
-    for i in range(step0, steps):
+    for u in range(u0, updates_total):
         gb = to_global(np_batch, cfg, trainer.mesh)
-        state, metrics = trainer.step(state, gb, jax.random.fold_in(rng, i))
-        writer.write(i, metrics)
-        if (i + 1) % 10 == 0:
-            rate = (i + 1 - step0) / (time.time() - t0)
-            color_print(f"step {i + 1} loss {float(metrics['loss']):.4f} "
-                        f"({rate:.2f} steps/s)")
-        if ckpt is not None and (i + 1) % cfg.steps_per_checkpoint == 0:
+        state, metrics = trainer.step(state, gb, jax.random.fold_in(rng, u))
+        writer.write(int(state.step) - m, metrics)
+        if cfg.debug_train_step or (u + 1) % 10 == 0:
+            # debug_train_step: per-step prints (reference run.py:252-261)
+            rate = (u + 1 - u0) / (time.time() - t0)
+            color_print(f"step {int(state.step)} "
+                        f"loss {float(metrics['loss']):.4f} "
+                        f"({rate:.2f} updates/s)")
+        if ckpt is not None and (u + 1) % ckpt_every == 0:
             data_state = ({"pipeline": pipe.state_dict()} if pipe is not None
                           else None)
-            ckpt.save(state, data_state)
+            ckpt.save(state, data_state, master_dtype=cfg.storage_dtype)
         if pipe is not None:
             np_batch = next(batches)
         else:
-            np_batch = synthetic_text_batch(cfg, i + 1)
+            np_batch = synthetic_text_batch(cfg, u + 1)
     if ckpt is not None:
-        ckpt.save(state, {"pipeline": pipe.state_dict()} if pipe else None)
+        ckpt.save(state, {"pipeline": pipe.state_dict()} if pipe else None,
+                  master_dtype=cfg.storage_dtype)
         ckpt.wait()
-    run_log.append(steps=steps - step0, batch_size=cfg.train_batch_size,
+    # rows consumed per update = batch * macro_batching (grad_accumulation
+    # only splits the delivered batch, it does not consume more data)
+    run_log.append(steps=updates_total - u0, batch_size=cfg.train_batch_size,
                    slice_count=slice_count, ctx=cfg.sequence_length,
-                   grad_accumulation=cfg.grad_accumulation,
+                   grad_accumulation=cfg.macro_batching,
                    interleave_size=cfg.interleaved_datasets,
                    token_patch_size=cfg.token_patch_size)
     run_log.save()
@@ -137,8 +161,12 @@ def train(cfg, args) -> None:
 
 
 def _params_for_serving(cfg):
-    from .utils import random_text_batch
-    batch = random_text_batch(cfg)
+    if cfg.use_video:
+        from .data.synthetic import synthetic_video_batch
+        batch = _np_to_nt(synthetic_video_batch(cfg, 0), cfg)
+    else:
+        from .utils import random_text_batch
+        batch = random_text_batch(cfg)
     if cfg.use_checkpointing:
         from .train import Checkpointer, Trainer
         state = Trainer(cfg).init(batch)
@@ -149,9 +177,87 @@ def _params_for_serving(cfg):
     return params
 
 
+def _video_batches(cfg):
+    """Real video batches when dataset files exist, else synthetic frames."""
+    from .data import fs
+    from .data.synthetic import synthetic_video_batch
+    from .data.video import VideoPipeline
+    globs = [d["path"] for d in cfg.dataset_configs if d.get("type") == "video"]
+    paths = [p for g in globs for p in fs.glob(g)]
+    if paths:
+        return iter(VideoPipeline(cfg, cfg.train_batch_size, paths=paths))
+    return (synthetic_video_batch(cfg, i) for i in __import__("itertools").count())
+
+
+def _np_to_nt(np_batch, cfg):
+    import jax.numpy as jnp
+    from .data.feed import axes_for
+    from .nd import NT
+    return {k: NT(jnp.asarray(v), axes_for(k, v, cfg))
+            for k, v in np_batch.items()}
+
+
+def _sample_video(cfg, args) -> None:
+    """Video sample mode: render input/output ``.avi`` files from real (or
+    synthetic) frame streams (reference interface.py:101-139)."""
+    import numpy as np
+    from .infer.sampler import autoregressive_video, forward_logits
+    from .serve.sample import render_video
+    from .train import color_print
+    params = _params_for_serving(cfg)
+    batches = _video_batches(cfg)
+    outdir = os.path.join(cfg.model_path, "samples")
+    os.makedirs(outdir, exist_ok=True)
+    t = cfg.time_patch_size
+    for i in range(cfg.num_of_sample):
+        np_batch = next(batches)
+        nt = _np_to_nt(np_batch, cfg)
+        if cfg.use_autoregressive_sampling:
+            _, frames = autoregressive_video(cfg, params, nt)
+            out = np.array(frames[0], np.float32, copy=True)[:t]
+            # context positions are raw 0..255; generated ones are sigmoid
+            # outputs in [0,1] (the reference blends the same way,
+            # inference.py:39-40)
+            pos0 = min(cfg.initial_autoregressive_position, t)
+            out[:pos0] /= 255.0
+        else:
+            _, frame_out = forward_logits(cfg, params, nt)
+            out = np.asarray(frame_out[0], np.float32)[:t]
+            inp = np.asarray(np_batch["frame"][0], np.float32)[1:t + 1] / 255.0
+            render_video(cfg, inp, os.path.join(outdir, f"sample_{i}_input.avi"))
+        path = render_video(cfg, out,
+                            os.path.join(outdir, f"sample_{i}_output.avi"))
+        color_print(f"sample_idx: {i} -> {path}")
+
+
 def sample(cfg, args) -> None:
+    if cfg.debug_sample:
+        # sample mode with debug_sample prints dataset-driven similarity
+        # (reference interface.py:144-152)
+        return debug_old(cfg, args)
+    if cfg.model_mode == "jannet" and cfg.use_video:
+        return _sample_video(cfg, args)
     from .serve import CompletionEngine, render_text_samples
     params = _params_for_serving(cfg)
+    if not cfg.use_autoregressive_sampling:
+        # dataset-driven single forward: print target vs one-step prediction
+        # (reference interface.py:165-170)
+        import jax
+        import numpy as np
+        from .data.synthetic import synthetic_text_batch
+        from .infer.sampler import make_single_forward
+        from .serve.interface import tokenizer_for
+        tok = tokenizer_for(cfg)
+        fwd = make_single_forward(cfg, params)
+        for i in range(cfg.num_of_sample):
+            nt = _np_to_nt(synthetic_text_batch(cfg, i), cfg)["token_x"]
+            out = np.asarray(fwd(nt, np.int32(0), np.float32(0.0),
+                                 jax.random.key(i)))
+            print("target:")
+            print(tok.decode(np.asarray(nt.x)[0].reshape(-1)))
+            print("\nsample:")
+            print(tok.decode(out[0].reshape(-1)))
+        return
     engine = CompletionEngine(cfg, params)
     for i in range(cfg.num_of_sample):
         out = engine.complete_tokens([int(cfg.concat_token)])
@@ -172,8 +278,12 @@ def web_api(cfg, args) -> None:
 def debug(cfg, args) -> None:
     """Self-similarity nondeterminism check (reference interface.py:283-302)."""
     from .serve import CompletionEngine, similarity_score
+    # debug sampling forces greedy autoregressive mode (reference
+    # src/main.py:75-78)
+    cfg.use_autoregressive_sampling = True
+    cfg.sampling_temperature = 0
     params = _params_for_serving(cfg)
-    engine = CompletionEngine(cfg, params)
+    engine = CompletionEngine(cfg, params, force_rebuild=True)
     prompt = list(range(min(16, cfg.vocab_size)))
     samples = [engine.complete_tokens(prompt, temperature=0.0)
                for _ in range(max(2, min(4, cfg.equal_debugging_items_per_check)))]
@@ -183,15 +293,73 @@ def debug(cfg, args) -> None:
         raise SystemExit("nondeterministic sampling detected")
 
 
+def debug_old(cfg, args) -> None:
+    """Dataset-driven similarity sampling (reference src/main.py:37-38,
+    interface.py:144-152): one real dataset window duplicated to batch 2,
+    greedy autoregressive samples, % agreement printed with both decodings."""
+    import jax
+    import numpy as np
+
+    from .data import dataset, fs
+    from .infer.sampler import make_text_sampler
+    from .nd import NT
+    from .serve import similarity_score
+    from .serve.interface import TEXT_AXES, tokenizer_for
+    from .train import color_print
+
+    params = _params_for_serving(cfg)
+    have_data = bool(cfg.dataset_configs) and any(
+        fs.glob(d["path"]) for d in cfg.dataset_configs)
+    if have_data:
+        np_batch = next(iter(dataset(cfg, 1)))
+        token_x = np.asarray(np_batch["token_x"])[:1]
+    else:
+        color_print("no dataset files found; using synthetic prompt")
+        from .data.synthetic import synthetic_text_batch
+        token_x = synthetic_text_batch(cfg, 0)["token_x"][:1, :cfg.sequence_length
+                                                          // cfg.token_patch_size]
+    pos0 = max(1, min(cfg.initial_autoregressive_position,
+                      cfg.sequence_length - 1)) // cfg.token_patch_size
+    both = np.concatenate([token_x, token_x], axis=0)  # batch 2, same prompt
+    sampler = make_text_sampler(cfg, params)
+    out = np.asarray(sampler(NT(jax.numpy.asarray(both), TEXT_AXES),
+                             np.int32(pos0), np.float32(0.0),
+                             jax.random.key(0)))
+    score = similarity_score([out[0], out[1]])
+    tok = tokenizer_for(cfg)
+    print(f"similarity score: {score * 100:.0f}%\n")
+    color_print("Prompt:")
+    print(tok.decode(out[0, :pos0].reshape(-1)))
+    color_print("Output:")
+    print(tok.decode(out[0, pos0:].reshape(-1)).rstrip())
+    if score < 1.0:
+        raise SystemExit("nondeterministic sampling detected")
+
+
 RUN_MODE_FNS = {"train": train, "sample": sample, "query": query,
-                "web_api": web_api, "debug": debug}
+                "web_api": web_api, "debug": debug, "debug_old": debug_old}
 
 
 def main(argv: typing.Optional[typing.Sequence[str]] = None) -> None:
     args = parse_args(argv)
     _init_distributed(args.tpu)
     from .config import Config
-    cfg = Config.from_json(args.model)
+    with open(args.model) as f:
+        raw = json.load(f)
+    if args.run_mode != "train":
+        # serving modes force batch size 1 (2 + greedy AR for debug_old) —
+        # reference src/main.py:74-80
+        raw["train"] = False
+        if args.run_mode == "debug_old":
+            raw["train_batch_size"] = 2
+            raw["use_autoregressive_sampling"] = True
+            raw["sampling_temperature"] = 0
+            raw["debug_sample"] = True
+        else:
+            raw["train_batch_size"] = 1
+    cfg = Config(raw)
     if args.debug_grad:
         cfg.debug_gradients = True
+    if args.workers is not None:  # reference src/main.py:60
+        cfg.web_workers = args.workers
     RUN_MODE_FNS[args.run_mode](cfg, args)

@@ -126,8 +126,13 @@ def _body(ctx: Ctx, src: NT) -> NT:
             attn_starts.append(acc)
             acc += _attn_layers(cfg.block_config[c])
 
-        if ctx.params is None:
-            # init / collect mode: run the plain chain so parameters materialize
+        if ctx.params is None or ctx.decode is not None:
+            # init / collect mode: run the plain chain so parameters
+            # materialize.  KV-cache decode takes the same path: there is no
+            # backward pass so the memory-reduction machinery (which rebuilds
+            # per-block sub-Ctxs that would drop the decode state) is skipped,
+            # while the scope walk — and therefore every parameter path —
+            # stays identical.
             if strategy in ("revnet", "momentum"):
                 x1, x2 = (src, src) if strategy == "revnet" else (src, nd.zeros_like(src))
                 for k, (i, c) in enumerate(seq):

@@ -34,15 +34,30 @@ def _name_seed(name: str) -> int:
     return int.from_bytes(hashlib.blake2b(name.encode(), digest_size=4).digest(), "little")
 
 
+class DecodeState:
+    """KV-cache decode mode (infer/kv_cache.py): the model runs on ONE
+    sequence position; ``pos`` is the absolute position of that row and
+    ``caches`` maps attention-layer ids to (k, v) arrays of shape
+    [batch, seq, heads, key].  Attention layers read/update their entry;
+    position-dependent embeddings slice their row at ``pos``."""
+
+    def __init__(self, pos, caches: typing.Dict[str, tuple], seq: int):
+        self.pos = pos
+        self.caches = caches
+        self.seq = seq
+
+
 class Ctx:
     """Carries config + parameters + scope state through model construction."""
 
     def __init__(self, cfg: Config, params: typing.Optional[dict] = None,
                  seed: int = 0, train: bool = True,
-                 rng: typing.Optional[jax.Array] = None, mesh=None):
+                 rng: typing.Optional[jax.Array] = None, mesh=None,
+                 decode: typing.Optional[DecodeState] = None):
         self.cfg = cfg
         self.params = params  # None => init (collect) mode
         self.mesh = mesh  # device mesh for shard_map islands (ring attention)
+        self.decode = decode  # KV-cache incremental decode state
         self.collected: typing.Dict[str, jnp.ndarray] = {}
         self.axis_names: typing.Dict[str, typing.Tuple[str, ...]] = {}
         self.train = train
@@ -87,7 +102,10 @@ class Ctx:
                 else:
                     parts.append(p)
             full = "/".join(parts)
-        store_dtype = dtype or self.cfg.storage_dtype
+        # device-resident params live in slice_dtype (MTF's per-device slice
+        # copy, reference dataclass.py:253-255); storage_dtype is the
+        # checkpoint master copy (train/checkpoint.py casts on save)
+        store_dtype = dtype or self.cfg.slice_dtype
         if self.params is not None:
             if full not in self.params:
                 raise KeyError(f"missing parameter {full}")

@@ -259,20 +259,30 @@ def _ring_eligible(args: Args, dim: str) -> bool:
 
 def _qkv(args: Args, base: typing.Optional[Args], dim: str
          ) -> typing.Tuple[typing.Optional[NT], typing.Optional[NT], NT]:
-    """Q/K/V construction shared by the dense and ring attention paths: key
-    source selection (embedded/context/positional), query scaling, value
-    source (shared_key_value/input_as_value/linear)."""
+    """Q/K/V construction shared by the dense, ring, and KV-cached attention
+    paths: key source selection (embedded/context/positional), query scaling,
+    value source (shared_key_value/input_as_value/linear)."""
     cfg = args.cfg
     t = args.tensor
+    dc = args.ctx.decode
     qry = key = None
     if "dot_product" in args:
         if "embedded" in args or "context" in args:
             key = activated_linear_out(base)
         if "embedded" in args or "positional" in args:
             fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
-            pos = embed(args, [(dim, t.dim_size(dim))] + fdims)
+            # the embedding table is always built full-size (same scope path
+            # as training, so checkpointed weights resolve); decode mode
+            # slices the current row
+            full = dc.seq if dc is not None else t.dim_size(dim)
+            pos = embed(args, [(dim, full)] + fdims)
+            if dc is not None:
+                ax = pos.names.index(dim)
+                pos = NT(jax.lax.dynamic_slice_in_dim(pos.x, dc.pos, 1, ax),
+                         pos.names)
             key = pos if key is None else key + pos
-        qry = activated_linear_out(base) * (t.dim_size(dim) ** -0.5)
+        scale = (dc.seq if dc is not None else t.dim_size(dim)) ** -0.5
+        qry = activated_linear_out(base) * scale
     if "dot_product" in args and "shared_key_value" in args:
         val = key
     elif "input_as_value" in args:
@@ -280,6 +290,49 @@ def _qkv(args: Args, base: typing.Optional[Args], dim: str
     else:
         val = activated_linear_out(base)
     return qry, key, val
+
+
+def _cached_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
+    """KV-cache incremental decode (the fast path the reference lacks,
+    SURVEY.md §7 item 7): the layer sees ONE row at absolute position
+    ``ctx.decode.pos``; its K/V are written into the layer's cache and the
+    dot-product runs against the cached prefix.  Greedy outputs match the
+    rebuild-everything sampler because every logit depends only on causally
+    visible positions."""
+    ctx = args.ctx
+    cfg = args.cfg
+    dc = ctx.decode
+    t = args.tensor
+    batch_axis = t.names[0]
+    order = (batch_axis, dim, HEADS, KEY)
+    tmp = anonymize_name(dim)
+    cdtype = cfg.calculation_dtype
+
+    cache_id = f"attn{ctx.attention_idx}"
+    k_cur = key.transpose_to(order).x.astype(cdtype)   # [b, 1, h, dk]
+    v_cur = val.transpose_to(order).x.astype(cdtype)
+    if cache_id in dc.caches:
+        k_cache, v_cache = dc.caches[cache_id]
+    else:  # template-building call: allocate zeroed full-length caches
+        shape = (k_cur.shape[0], dc.seq) + k_cur.shape[2:]
+        k_cache = jnp.zeros(shape, cdtype)
+        v_cache = jnp.zeros(shape, cdtype)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_cur, dc.pos, 1)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_cur, dc.pos, 1)
+    dc.caches[cache_id] = (k_cache, v_cache)
+
+    kn = NT(k_cache, (batch_axis, tmp, HEADS, KEY))
+    logit = nd.einsum([qry.transpose_to(order), kn],
+                      (batch_axis, dim, HEADS, tmp))
+    # causal mask: cached positions beyond `pos` are invisible
+    vis = (jnp.arange(dc.seq) <= dc.pos).astype(cdtype)
+    logit = logit + NT((1 - vis) * jnp.asarray(-2e38, cdtype), (tmp,))
+    logit = logit - nd.stop_gradient(nd.reduce_max(logit, reduced=[tmp]))
+    logit = NT(jnp.exp(logit.x), logit.names)
+    logit = logit / nd.reduce_sum(logit, reduced=[tmp])
+    out = nd.einsum([logit, NT(v_cache, (batch_axis, tmp, HEADS, KEY))],
+                    t.names)
+    return out
 
 
 def _ring_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
@@ -311,6 +364,9 @@ def attention(args: Args) -> NT:
 
     dim = get_attention_dim(args).dim
     qry, key, val_src = _qkv(args, base, dim)
+    if (ctx.decode is not None and dim == SEQUENCE
+            and "dot_product" in args):
+        return _cached_attention(args, qry, key, val_src, dim)
     if _ring_eligible(args, dim):
         return _ring_attention(args, qry, key, val_src, dim)
     tmp = anonymize_name(dim)

@@ -170,21 +170,19 @@ def einsum(inputs: typing.Sequence[NT], out_names: typing.Sequence[str],
     # Accumulate half-precision matmuls in f32 (free on the MXU, strictly
     # better numerically — same policy as ops/losses.py) and cast the result
     # back to the input dtype so activation storage stays half-precision.
-    # TPU runs the native bf16 x bf16 -> f32 MXU dot; other backends (the
-    # CPU test mesh can't execute that thunk) upcast the operands instead —
-    # bit-identical, since half-precision products are exact in f32.
     in_dtype = inputs[0].dtype
     arrays = [t.x for t in inputs]
     if in_dtype in (jnp.bfloat16, jnp.float16):
-        def _tpu(*xs):
-            return jnp.einsum(spec, *xs, precision=precision,
-                              preferred_element_type=jnp.float32)
-
-        def _generic(*xs):
-            return jnp.einsum(spec, *[x.astype(jnp.float32) for x in xs],
-                              precision=precision)
-
-        x = jax.lax.platform_dependent(*arrays, tpu=_tpu, default=_generic)
+        if jax.default_backend() in ("tpu", "gpu", "axon"):
+            # native half-precision MXU dot with f32 accumulator
+            x = jnp.einsum(spec, *arrays, precision=precision,
+                           preferred_element_type=jnp.float32)
+        else:
+            # XLA:CPU's thunk runtime rejects BF16xBF16=F32 dots for some
+            # shapes; upcast operands instead — bit-identical, since
+            # half-precision products are exact in f32.
+            x = jnp.einsum(spec, *[a.astype(jnp.float32) for a in arrays],
+                           precision=precision)
         x = x.astype(in_dtype)
     else:
         x = jnp.einsum(spec, *arrays, precision=precision,

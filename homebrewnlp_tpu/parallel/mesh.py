@@ -1,10 +1,10 @@
 """Device-mesh construction from the config's parallelism knobs.
 
-Axis order is (data, sequence, pipeline, model): model innermost so tensor-
-parallel collectives ride the fastest ICI links, data outermost so gradient
-all-reduce tolerates DCN hops on multi-host — the same intent as the
-reference's ``mesh_shape="b:N,h:H"`` ordering (dataclass.py:247-252) where the
-head axis maps to the minor mesh dimension.
+Axis order is (data, sequence, model): model innermost so tensor-parallel
+collectives ride the fastest ICI links, data outermost so gradient all-reduce
+tolerates DCN hops on multi-host — the same intent as the reference's
+``mesh_shape="b:N,h:H"`` ordering (dataclass.py:247-252) where the head axis
+maps to the minor mesh dimension.
 """
 from __future__ import annotations
 
@@ -19,7 +19,6 @@ from ..config import Config
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "sequence_parallel"
-PIPE_AXIS = "pipeline"
 
 
 def axis_sizes(cfg: Config, n_devices: int) -> typing.Dict[str, int]:
@@ -28,25 +27,23 @@ def axis_sizes(cfg: Config, n_devices: int) -> typing.Dict[str, int]:
     b = tpu_size / heads)."""
     model = cfg.mesh_model
     seq = cfg.sequence_parallel
-    pipe = cfg.pipeline_parallel
-    denom = model * seq * pipe
+    denom = model * seq
     if n_devices % denom:
         # shrink the model axis to the largest divisor that fits
         model = 1
         for cand in range(min(cfg.mesh_model, n_devices), 0, -1):
             # the model axis must also divide the head count or head-sharded
             # parameters cannot be placed on the mesh
-            if n_devices % (cand * seq * pipe) == 0 and cfg.heads % cand == 0:
+            if n_devices % (cand * seq) == 0 and cfg.heads % cand == 0:
                 model = cand
                 break
-        denom = model * seq * pipe
+        denom = model * seq
         if n_devices % denom:
             raise ValueError(
-                f"cannot factor {n_devices} devices into seq={seq} pipe={pipe}")
+                f"cannot factor {n_devices} devices into seq={seq}")
         print(f"WARNING: model axis shrunk from {cfg.mesh_model} to {model} "
-              f"to factor {n_devices} devices (seq={seq}, pipe={pipe})")
-    return {DATA_AXIS: n_devices // denom, SEQ_AXIS: seq,
-            PIPE_AXIS: pipe, MODEL_AXIS: model}
+              f"to factor {n_devices} devices (seq={seq})")
+    return {DATA_AXIS: n_devices // denom, SEQ_AXIS: seq, MODEL_AXIS: model}
 
 
 def make_mesh(cfg: Config,
@@ -62,10 +59,10 @@ def make_mesh(cfg: Config,
                    if batch % d == 0)
         print(f"WARNING: data axis shrunk from {sizes[DATA_AXIS]} to {data} "
               f"(train_batch_size={batch}); "
-              f"{(sizes[DATA_AXIS] - data) * sizes[SEQ_AXIS] * sizes[PIPE_AXIS] * sizes[MODEL_AXIS]}"
+              f"{(sizes[DATA_AXIS] - data) * sizes[SEQ_AXIS] * sizes[MODEL_AXIS]}"
               " device(s) left unused")
         sizes[DATA_AXIS] = data
-    names = (DATA_AXIS, SEQ_AXIS, PIPE_AXIS, MODEL_AXIS)
+    names = (DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
     n_used = 1
     for n in names:
         n_used *= sizes[n]

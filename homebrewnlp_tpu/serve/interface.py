@@ -59,12 +59,24 @@ class CompletionEngine:
     the prompt is padded to full context with random tokens which the sampler
     overwrites)."""
 
-    def __init__(self, cfg: Config, params: dict):
+    def __init__(self, cfg: Config, params: dict,
+                 force_rebuild: bool = False):
+        """``force_rebuild`` pins the rebuild-everything sampler even for
+        KV-cache-eligible configs (the similarity debug mode exercises the
+        production rebuild path, reference interface.py:283-302)."""
+        from ..infer.kv_cache import cache_eligible, make_cached_text_sampler
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer_for(cfg)
-        self._sampler = make_text_sampler(cfg, params)
+        # prompt completion is inherently autoregressive: the engine always
+        # uses an AR sampler (use_autoregressive_sampling=False only affects
+        # the dataset-driven sample run mode, reference inference.py:136-170)
+        if cache_eligible(cfg) and not force_rebuild:
+            self._sampler = make_cached_text_sampler(cfg, params)
+        else:
+            self._sampler = make_text_sampler(cfg, params)
         self._rng = jax.random.key(cfg.data_seed)
+        self._rng_lock = threading.Lock()
 
     def complete_tokens(self, prompt: typing.Sequence[int],
                         temperature: typing.Optional[float] = None,
@@ -77,7 +89,8 @@ class CompletionEngine:
         patch = cfg.token_patch_size
         rows = cfg.sequence_length // patch
         prompt = list(prompt)[:rows * patch]
-        self._rng, pad_key, sample_key = jax.random.split(self._rng, 3)
+        with self._rng_lock:  # web_workers threads share this engine
+            self._rng, pad_key, sample_key = jax.random.split(self._rng, 3)
         flat = jax.random.randint(pad_key, (rows * patch,), 0, cfg.vocab_size)
         flat = flat.at[:len(prompt)].set(np.asarray(prompt, np.int32))
         toks = flat.reshape(1, rows, patch)
@@ -105,18 +118,31 @@ class CompletionEngine:
 class InterfaceWrapper:
     """Async facade over the engine (reference interface.py:231-280):
     ``complete(..., asynchronous=True)`` returns a handle whose ``fetch()``
-    blocks for the result."""
+    blocks for the result.  ``workers`` (cfg.web_workers, reference
+    rest_api.py:86) sets the number of worker threads; ``fetch`` polls its
+    result queue every cfg.default_sleep_duration seconds (the reference's
+    Manager-dict poll, interface.py:243)."""
 
-    def __init__(self, engine: CompletionEngine):
+    def __init__(self, engine: CompletionEngine,
+                 workers: typing.Optional[int] = None,
+                 sleep_duration: typing.Optional[float] = None):
         self.engine = engine
+        cfg = engine.cfg
+        self.sleep_duration = (cfg.default_sleep_duration
+                               if sleep_duration is None else sleep_duration)
+        n = max(1, int(cfg.web_workers if workers is None else workers))
         self._q: "queue.Queue[tuple]" = queue.Queue()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+        self._threads = []
+        for _ in range(n):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def _worker(self):
         while True:
             item = self._q.get()
             if item is None:
+                self._q.put(None)  # let sibling workers drain too
                 return
             fn, args, out = item
             try:
@@ -131,7 +157,12 @@ class InterfaceWrapper:
                      (prompt, temperature, response_len), out))
 
         def fetch():
-            status, value = out.get()
+            while True:
+                try:
+                    status, value = out.get(timeout=self.sleep_duration)
+                    break
+                except queue.Empty:
+                    continue
             if status == "err":
                 raise value
             return value

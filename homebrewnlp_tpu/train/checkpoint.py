@@ -33,9 +33,17 @@ class Checkpointer:
 
     # -- save ----------------------------------------------------------------
     def save(self, state: TrainState,
-             data_state: typing.Optional[dict] = None) -> None:
+             data_state: typing.Optional[dict] = None,
+             master_dtype=None) -> None:
+        """``master_dtype`` (cfg.storage_dtype): dtype of the checkpointed
+        master copy of the params — MTF's master/slice split (reference
+        dataclass.py:253-255, VariableDType.master_dtype).  Optimizer slots
+        keep their own optimizer_slice_dtype."""
         step = int(state.step)
-        tree = {"params": state.params, "opt_state": state.opt_state,
+        params = state.params
+        if master_dtype is not None:
+            params = {k: v.astype(master_dtype) for k, v in params.items()}
+        tree = {"params": params, "opt_state": state.opt_state,
                 "step": state.step}
         self.manager.save(step, args=ocp.args.StandardSave(tree))
         if data_state is not None:

@@ -62,16 +62,29 @@ class Trainer:
             NamedSharding(self.mesh, PartitionSpec()))
         return TrainState(params, opt_state, step)
 
+    @property
+    def n_micro(self) -> int:
+        """Micro-batches per train step.
+
+        ``macro_batching`` inflates the host batch by M (the pipeline delivers
+        ``train_batch_size * M`` rows, reference dataloader_placement.py:40-44)
+        and ``grad_accumulation`` additionally splits each configured batch
+        into G slices; the step scans all M*G micro-batches and applies ONE
+        optimizer update from the averaged gradients (the reference applies
+        ``fn="update"`` only on the last macro slice, src/run/train.py:50-56).
+        """
+        return self.cfg.grad_accumulation * self.cfg.macro_batching
+
     def _micro_batch(self, batch: typing.Dict[str, NT]) -> typing.Dict[str, NT]:
         """First micro-batch view of a (possibly accumulated) batch."""
-        accum = self.cfg.grad_accumulation
+        accum = self.n_micro
         if accum <= 1:
             return batch
         out = {}
         for k, t in batch.items():
             assert t.x.shape[0] % accum == 0, (
                 f"batch axis {t.x.shape[0]} of {k!r} not divisible by "
-                f"grad_accumulation={accum}")
+                f"micro-batch count {accum}")
             out[k] = NT(t.x[:t.x.shape[0] // accum], t.names)
         return out
 
@@ -106,14 +119,32 @@ class Trainer:
     def _make_step(self):
         cfg = self.cfg
         mesh = self.mesh
-        accum = cfg.grad_accumulation
+        accum = self.n_micro
         opt = self.optimizer
+        # global_step counts macro slices, not updates, when macro-batching
+        # (reference run.py:155-156: assign_add(global_step, macro_batching))
+        step_increment = max(1, cfg.macro_batching)
+
+        def aux_metrics(o):
+            """Per-micro auxiliary losses as a flat dict (missing ones are
+            simply absent — the model emits a consistent set per config)."""
+            m = {}
+            if o.token_loss is not None:
+                m["token_loss"] = o.token_loss
+            if o.video_loss is not None:
+                m["video_loss"] = o.video_loss
+            if o.accuracy is not None:
+                m["accuracy"] = o.accuracy
+            return m
 
         def step_fn(state: TrainState, batch: typing.Dict[str, NT],
                     rng: jax.Array):
             batch = {k: constraint(t, mesh) for k, t in batch.items()}
+            metrics = {}
             if accum <= 1:
                 grads, out = self._grads(state.params, batch, rng)
+                loss = out.loss
+                metrics.update(aux_metrics(out))
             else:
                 # scan over micro-batches, averaging gradients — the JAX form
                 # of the reference's graph-stitched macro-batching
@@ -121,7 +152,7 @@ class Trainer:
                 def micro(i, t):
                     assert t.x.shape[0] % accum == 0, (
                         f"batch axis {t.x.shape[0]} not divisible by "
-                        f"grad_accumulation={accum}")
+                        f"micro-batch count {accum}")
                     bsz = t.x.shape[0] // accum
                     return NT(jax.lax.dynamic_slice_in_dim(t.x, i * bsz, bsz, 0),
                               t.names)
@@ -131,32 +162,39 @@ class Trainer:
                     g, o = self._grads(state.params,
                                        mb, jax.random.fold_in(rng, i))
                     acc = jax.tree_util.tree_map(jnp.add, carry, g)
-                    return acc, o.loss
+                    return acc, dict(loss=o.loss, **aux_metrics(o))
 
                 zeros = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-                grads, losses = jax.lax.scan(body, zeros, jnp.arange(accum))
+                grads, per_micro = jax.lax.scan(body, zeros, jnp.arange(accum))
                 grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
-                out = None
-                mean_loss = jnp.mean(losses)
+                losses = per_micro.pop("loss")
+                # reference reports first/last/mean of the macro batch
+                # (src/run/train.py:48-52, run.py:123-132); the smoothing knob
+                # picks which figure is THE loss
+                metrics["first_loss"] = losses[0]
+                metrics["last_loss"] = losses[-1]
+                loss = (jnp.mean(losses) if cfg.macro_batch_loss_smoothing
+                        else losses[-1])
+                metrics.update({k: jnp.mean(v) for k, v in per_micro.items()})
             new_params, new_opt, lr = opt.update(
                 state.params, grads, state.opt_state, state.step)
             gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                                  for g in grads.values()))
-            metrics = {
-                "loss": out.loss if out is not None else mean_loss,
+            metrics.update({
+                "loss": loss,
                 "learning_rate": lr,
                 "grad_norm": gnorm,
                 "step": state.step,
-            }
-            if out is not None:
-                if out.token_loss is not None:
-                    metrics["token_loss"] = out.token_loss
-                if out.video_loss is not None:
-                    metrics["video_loss"] = out.video_loss
-                if out.accuracy is not None:
-                    metrics["accuracy"] = out.accuracy
-            new_state = TrainState(new_params, new_opt, state.step + 1)
+            })
+            if cfg.debug_gradients:
+                # per-variable gradient norms (the reference's --debug_grad
+                # histogram stream, src/run/run.py:147-153)
+                for name, g in grads.items():
+                    metrics[f"grad_norm/{name}"] = jnp.sqrt(
+                        jnp.sum(jnp.square(g.astype(jnp.float32))))
+            new_state = TrainState(new_params, new_opt,
+                                   state.step + step_increment)
             return new_state, metrics
 
         return jax.jit(step_fn, donate_argnums=(0,))
@@ -167,6 +205,21 @@ class Trainer:
             self._step_fn = self._make_step()
         with self.mesh:
             return self._step_fn(state, batch, rng)
+
+    def step_cost_analysis(self, state: TrainState,
+                           batch: typing.Dict[str, NT]
+                           ) -> typing.Dict[str, float]:
+        """XLA cost analysis (flops, bytes accessed) of the compiled train
+        step — feeds the bench's FLOPs/step and MFU reporting."""
+        if self._step_fn is None:
+            self._step_fn = self._make_step()
+        with self.mesh:
+            compiled = self._step_fn.lower(
+                state, batch, jax.random.key(0)).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns per-device list
+            cost = cost[0] if cost else {}
+        return dict(cost or {})
 
     # -- reporting -----------------------------------------------------------
     def param_census(self, params: typing.Dict[str, jnp.ndarray]

@@ -27,7 +27,8 @@ def random_text_batch(cfg, seed: int = 0) -> typing.Dict[str, typing.Any]:
     import jax
     from ..data.feed import TEXT_AXES as names
     from ..nd import NT
-    shape = (cfg.train_batch_size, cfg.sequence_length // cfg.token_patch_size,
+    shape = (cfg.train_batch_size * cfg.macro_batching,
+             cfg.sequence_length // cfg.token_patch_size,
              cfg.token_patch_size)
     kx, ky = jax.random.split(jax.random.key(seed))
     return {

@@ -57,7 +57,8 @@ def mixer_config(**overrides) -> Config:
 
 def text_batch(cfg: Config, seed: int = 0) -> typing.Dict[str, NT]:
     key = jax.random.key(seed)
-    shape = (cfg.train_batch_size, cfg.sequence_length, cfg.token_patch_size)
+    shape = (cfg.train_batch_size * cfg.macro_batching, cfg.sequence_length,
+             cfg.token_patch_size)
     names = ("batch", "sequence", "language_token_patch")
     kx, ky = jax.random.split(key)
     return {

@@ -103,13 +103,22 @@ def test_relative_embedding_finite_large_features():
 
 
 def test_dtype_policy_bf16():
+    """Device-resident params live in slice_dtype (MTF's per-device slice
+    copy); storage_dtype only affects the checkpoint master (see
+    test_checkpoint_master_dtype_roundtrip)."""
     cfg = mixer_config(calculation_dtype="bfloat16", storage_dtype="bfloat16",
                        slice_dtype="float32")
     params, axes, batch, loss_fn = init_and_loss(cfg)
-    assert all(v.dtype == jnp.bfloat16 for v in params.values())
+    assert all(v.dtype == jnp.float32 for v in params.values())
     loss = jax.jit(loss_fn)(params, jax.random.key(0))
     assert jnp.isfinite(loss)
     assert loss.dtype == jnp.float32  # losses accumulate in f32
+
+    cfg2 = mixer_config(calculation_dtype="bfloat16",
+                        storage_dtype="bfloat16", slice_dtype="bfloat16")
+    params2, _, _, loss_fn2 = init_and_loss(cfg2)
+    assert all(v.dtype == jnp.bfloat16 for v in params2.values())
+    assert jnp.isfinite(jax.jit(loss_fn2)(params2, jax.random.key(0)))
 
 
 def test_einsum_f32_accumulation():

@@ -104,3 +104,41 @@ def test_video2tfrecord_end_to_end(tmp_path):
     batch = next(iter(pipe))
     assert batch["frame"].shape == (2, 5, 2, 4, 16 * 16 * 3)
     assert not batch["cat_mask_x"].all()  # first frame concat flag present
+
+
+def test_manifest_chunk_and_split(tmp_path):
+    import json as jsonlib
+    from tools.manifest import chunk, load_manifests, main, split
+
+    manifest = {"id": [f"v{i}" for i in range(20)],
+                "duration": [float(10 + i * 3) for i in range(20)]}
+    src = tmp_path / "manifest.json"
+    src.write_text(jsonlib.dumps(manifest))
+
+    # chunk: every chunk but possibly the last reaches min duration; nothing
+    # is lost
+    cids, cdur = chunk(manifest["id"], manifest["duration"], 60.0, seed=1)
+    assert sorted(i for c in cids for i in c) == sorted(manifest["id"])
+    assert all(sum(d) >= 60.0 for d in cdur[:-1])
+
+    # split: balanced by duration, everything kept
+    parts = split(manifest["id"], manifest["duration"], 4)
+    totals = [sum(p["duration"]) for p in parts]
+    assert sum(len(p["id"]) for p in parts) == 20
+    assert max(totals) - min(totals) <= max(manifest["duration"])
+
+    # CLI end-to-end: chunk then split the chunks across 3 workers
+    main(["chunk", str(src), "--min-duration", "60", "--seed", "2",
+          "--prefix", str(tmp_path) + "/"])
+    chunks_path = tmp_path / "work_chunks.json"
+    assert chunks_path.exists()
+    main(["split", str(chunks_path), "--splits", "3",
+          "--prefix", str(tmp_path) + "/"])
+    outs = sorted(tmp_path.glob("work_split_*.json"))
+    assert len(outs) == 3
+    seen = []
+    for p in outs:
+        data = jsonlib.loads(p.read_text())
+        for c in data["id"]:
+            seen.extend(c)
+    assert sorted(seen) == sorted(manifest["id"])

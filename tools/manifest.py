@@ -1,0 +1,120 @@
+"""Video-manifest work-list sharding: chunk + split.
+
+Port of the reference's fleet tooling tail (/root/reference/scripts/
+chunk_video_json.py:1-86, split_video_json.py:1-89): a manifest is a JSON
+``{"id": [...], "duration": [...]}`` of video ids and durations (seconds);
+``chunk`` groups shuffled videos into chunks of at least ``--min-duration``
+seconds; ``split`` balances manifests (or chunks) across N workers by total
+duration (greedy lightest-bucket, the same ``split_equal`` the downloader
+uses for its per-worker balance, video2tfrecord.py).
+
+Usage:
+  python tools/manifest.py chunk  MANIFEST_OR_DIR --min-duration 3600 \
+      [--prefix out/] [--seed 0]
+  python tools/manifest.py split  MANIFEST_OR_DIR --splits 8 [--prefix out/]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import typing
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.video2tfrecord import split_equal  # noqa: E402
+
+
+def load_manifests(path: str) -> typing.Tuple[list, list]:
+    """One file or every file of a directory -> concatenated (ids, durations).
+    Entries may be scalars (one video) or lists (a chunk)."""
+    paths = ([os.path.join(path, p) for p in sorted(os.listdir(path))]
+             if os.path.isdir(path) else [path])
+    ids: list = []
+    durations: list = []
+    for p in paths:
+        with open(p) as f:
+            data = json.load(f)
+        ids.extend(data["id"])
+        durations.extend(data["duration"])
+    if len(ids) != len(durations):
+        raise ValueError(f"id/duration length mismatch in {path}")
+    return ids, durations
+
+
+def chunk(ids: list, durations: list, min_duration: float,
+          seed: typing.Optional[int] = None
+          ) -> typing.Tuple[list, list]:
+    """Shuffle, then greedily close a chunk once it reaches min_duration
+    (reference chunk_video_json.py:44-65)."""
+    videos = list(zip(ids, durations))
+    rng = random.Random(seed)
+    rng.shuffle(videos)
+    chunk_ids: list = []
+    chunk_durations: list = []
+    cur_i: list = []
+    cur_d: list = []
+    total = 0.0
+    for i, d in videos:
+        cur_i.append(i)
+        cur_d.append(d)
+        total += d
+        if total >= min_duration:
+            chunk_ids.append(cur_i)
+            chunk_durations.append(cur_d)
+            cur_i, cur_d, total = [], [], 0.0
+    if cur_i:  # trailing partial chunk (reference keeps it too)
+        chunk_ids.append(cur_i)
+        chunk_durations.append(cur_d)
+    return chunk_ids, chunk_durations
+
+
+def split(ids: list, durations: list, n: int) -> typing.List[dict]:
+    """Balance entries over n workers by total duration."""
+    totals = [sum(d) if isinstance(d, list) else float(d) for d in durations]
+    buckets = split_equal(totals, n)
+    return [{"id": [ids[i] for i in b],
+             "duration": [durations[i] for i in b]} for b in buckets]
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> None:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    c = sub.add_parser("chunk")
+    c.add_argument("load_path")
+    c.add_argument("--min-duration", type=float, required=True)
+    c.add_argument("--prefix", default="")
+    c.add_argument("--seed", type=int, default=None)
+    s = sub.add_parser("split")
+    s.add_argument("load_path")
+    s.add_argument("--splits", type=int, required=True)
+    s.add_argument("--prefix", default="")
+    args = ap.parse_args(argv)
+
+    ids, durations = load_manifests(args.load_path)
+    if args.cmd == "chunk":
+        cids, cdur = chunk(ids, durations, args.min_duration, args.seed)
+        for i, d in enumerate(cdur):
+            print(f"chunk: {i} videos: {len(d)} duration: {sum(d)}")
+        print(f"total num of videos: {sum(len(d) for d in cdur)} "
+              f"total video duration: {sum(sum(d) for d in cdur)}")
+        out = f"{args.prefix}work_chunks.json"
+        with open(out, "w") as f:
+            json.dump({"id": cids, "duration": cdur}, f)
+        print(out)
+        return
+    parts = split(ids, durations, args.splits)
+    for i, part in enumerate(parts):
+        total = sum(sum(d) if isinstance(d, list) else d
+                    for d in part["duration"])
+        print(f"split: {i} entries: {len(part['id'])} duration: {total}")
+        out = f"{args.prefix}work_split_{i}.json"
+        with open(out, "w") as f:
+            json.dump(part, f)
+        print(out)
+
+
+if __name__ == "__main__":
+    main()

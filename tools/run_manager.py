@@ -56,6 +56,10 @@ def main() -> None:
                     help="run dir (heartbeat = metrics.jsonl mtime)")
     ap.add_argument("--log", default="", help="log file (default: "
                     "<model-path>/manager.log)")
+    ap.add_argument("--log-remote", default="",
+                    help="remote URL (gs://...) the log is uploaded to at "
+                         "every health poll (the reference streams logs to "
+                         "GCS, scripts/run_manager.py:26-56)")
     ap.add_argument("--poll", type=int, default=300, help="seconds between "
                     "health checks (reference polls every 5-10 min)")
     ap.add_argument("--stall-timeout", type=int, default=1800,
@@ -78,6 +82,14 @@ def main() -> None:
               flush=True)
         while True:
             time.sleep(args.poll)
+            if args.log_remote:
+                try:
+                    sys.path.insert(0, os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))))
+                    from homebrewnlp_tpu.data import fs
+                    fs.put_with_retry(log_path, args.log_remote, retries=1)
+                except Exception as e:  # keep supervising even if upload fails
+                    print(f"[manager] log upload failed: {e!r}", flush=True)
             rc = proc.poll()
             if rc is not None:
                 if rc == 0:

@@ -4,8 +4,10 @@ Port of /root/reference/scripts/text2tfrecord.py + local_text2tfrecord.pyx:
 multiprocess encoding of text files into TFRecord shards, byte-level or BPE
 (a tools/train_tokenizer.py artifact), with the token count embedded in the
 filename (``..._<n>.tfrecord``) the way the run-log replay resume expects
-(src/inputs.py:34).  GCS upload becomes a ``--post-cmd`` hook (zero-egress
-image); framing + CRC go through native/hbnlp_native.cc.
+(src/inputs.py:34).  A remote ``--output-dir`` (gs://...) uploads each shard
+with bounded-retry backoff (reference scripts/text2tfrecord.py:61-89) via
+data/fs.py; ``--post-cmd`` remains as a hook.  Framing + CRC go through
+native/hbnlp_native.cc.
 
 Usage:
   python tools/text2tfrecord.py --input *.txt --output-dir datasets/pile \
@@ -53,7 +55,19 @@ def _work(job) -> str:
         payload, n = encode_file(p, merges)
         payloads.append(payload)
         total += n
-    out = os.path.join(out_dir, f"shard{suffix}{shard_idx:05d}_{total}.tfrecord")
+    name = f"shard{suffix}{shard_idx:05d}_{total}.tfrecord"
+    from homebrewnlp_tpu.data import fs
+    if fs.is_remote(out_dir):
+        # write locally, then upload with bounded-retry backoff (the
+        # reference's GCS loop, scripts/text2tfrecord.py:61-89)
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            local = os.path.join(td, name)
+            write_records(local, payloads)
+            out = out_dir.rstrip("/") + "/" + name
+            fs.put_with_retry(local, out)
+        return out
+    out = os.path.join(out_dir, name)
     write_records(out, payloads)
     return out
 
@@ -71,7 +85,9 @@ def main() -> None:
                     help="shell command run per finished shard, {} = path "
                          "(e.g. 'gsutil cp {} gs://bucket/')")
     args = ap.parse_args()
-    os.makedirs(args.output_dir, exist_ok=True)
+    from homebrewnlp_tpu.data import fs
+    if not fs.is_remote(args.output_dir):
+        os.makedirs(args.output_dir, exist_ok=True)
 
     jobs = []
     for i in range(0, len(args.input), args.files_per_shard):
