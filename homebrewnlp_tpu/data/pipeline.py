@@ -438,8 +438,10 @@ class Prefetcher:
 
 
 def dataset(cfg: Config, sub_batch_size: int, slice_index: int = 0,
-            slice_count: int = 1):
-    """Mixture entry point mirroring the reference API (inputs.py:486-525)."""
+            slice_count: int = 1, prefetch: bool = True):
+    """Mixture entry point mirroring the reference API (inputs.py:486-525).
+    ``prefetch=False`` skips the background-thread Prefetcher (for probe
+    pipelines that read one template batch and are discarded)."""
     from .video import VideoPipeline
     children: typing.List[typing.Iterable] = []
     weights: typing.List[float] = []
@@ -462,6 +464,6 @@ def dataset(cfg: Config, sub_batch_size: int, slice_index: int = 0,
         weights.append(dset.get("weight", 1.0))
     pipe = (children[0] if len(children) == 1
             else MixturePipeline(children, weights, cfg.data_seed))
-    if cfg.buffer_size and cfg.buffer_size > 0:
+    if prefetch and cfg.buffer_size and cfg.buffer_size > 0:
         pipe = Prefetcher(pipe, cfg.buffer_size)
     return pipe

@@ -32,6 +32,10 @@ def parse_args(argv: typing.Optional[typing.Sequence[str]] = None):
                    help="override cfg.web_workers (reference src/main.py:60)")
     p.add_argument("--debug_grad", action="store_true")
     p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--profile", type=str, default="",
+                   help="directory for a jax.profiler trace of a few "
+                        "steady-state train steps (upgrade over the "
+                        "reference's phase timers, SURVEY.md §5.1)")
     return p.parse_args(argv)
 
 
@@ -82,9 +86,13 @@ def train(cfg, args) -> None:
     local_batch = cfg.train_batch_size * cfg.macro_batching // slice_count
 
     if have_data:
-        pipe = dataset(cfg, local_batch, slice_index, slice_count)
-        batches = iter(pipe)
-        first_np = next(batches)
+        # probe pipeline (no prefetch thread): one template batch for init,
+        # then discarded — the real pipeline is built after checkpoint
+        # restore so its cursor and prefetcher start from the right place
+        probe = dataset(cfg, local_batch, slice_index, slice_count,
+                        prefetch=False)
+        first_np = next(iter(probe))
+        pipe = True  # real pipeline constructed below
     else:
         color_print("no dataset files found; using synthetic data")
         pipe = None
@@ -101,15 +109,15 @@ def train(cfg, args) -> None:
         import jax.numpy as jnp
         state = state._replace(step=jnp.asarray(cfg.current_step, jnp.int32))
     step0 = int(state.step)
-    if pipe is not None and data_state and "pipeline" in data_state:
-        # resume the cursor on a *fresh* pipeline, then draw the first batch
-        # from the restored position (first_np above came from the start of
-        # the stream and was only used as the init template)
+    if pipe is not None:
+        # the real (prefetched) pipeline, with the checkpointed cursor
+        # restored before the first read
         pipe = dataset(cfg, local_batch, slice_index, slice_count)
-        pipe.load_state_dict(data_state["pipeline"])
+        if data_state and "pipeline" in data_state:
+            pipe.load_state_dict(data_state["pipeline"])
         batches = iter(pipe)
         first_np = next(batches)
-    elif pipe is None and step0:
+    elif step0:
         # synthetic batches are indexed by UPDATE count (the loop below)
         first_np = synthetic_text_batch(cfg, step0 // max(1, cfg.macro_batching))
 
@@ -127,9 +135,19 @@ def train(cfg, args) -> None:
     rng = jax.random.key(cfg.data_seed)
     t0 = time.time()
     np_batch = first_np
+    profile_window = range(u0 + 3, u0 + 6)  # steady state: past compile
+    tracing = False
     for u in range(u0, updates_total):
+        if args.profile and u == profile_window.start:
+            jax.profiler.start_trace(args.profile)
+            tracing = True
         gb = to_global(np_batch, cfg, trainer.mesh)
         state, metrics = trainer.step(state, gb, jax.random.fold_in(rng, u))
+        if tracing and u >= profile_window.stop:
+            jax.block_until_ready(metrics["loss"])
+            jax.profiler.stop_trace()
+            tracing = False
+            color_print(f"profiler trace written to {args.profile}")
         writer.write(int(state.step) - m, metrics)
         if cfg.debug_train_step or (u + 1) % 10 == 0:
             # debug_train_step: per-step prints (reference run.py:252-261)
@@ -145,6 +163,10 @@ def train(cfg, args) -> None:
             np_batch = next(batches)
         else:
             np_batch = synthetic_text_batch(cfg, u + 1)
+    if tracing:  # run ended inside the profile window
+        jax.block_until_ready(metrics["loss"])
+        jax.profiler.stop_trace()
+        color_print(f"profiler trace written to {args.profile}")
     if ckpt is not None:
         ckpt.save(state, {"pipeline": pipe.state_dict()} if pipe else None,
                   master_dtype=cfg.storage_dtype)
@@ -241,16 +263,25 @@ def sample(cfg, args) -> None:
     params = _params_for_serving(cfg)
     if not cfg.use_autoregressive_sampling:
         # dataset-driven single forward: print target vs one-step prediction
-        # (reference interface.py:165-170)
+        # (reference interface.py:165-170); synthetic only when no dataset
+        # files exist
         import jax
         import numpy as np
+        from .data import dataset, fs
         from .data.synthetic import synthetic_text_batch
         from .infer.sampler import make_single_forward
         from .serve.interface import tokenizer_for
         tok = tokenizer_for(cfg)
         fwd = make_single_forward(cfg, params)
+        have_data = bool(cfg.dataset_configs) and any(
+            fs.glob(d["path"]) for d in cfg.dataset_configs)
+        if have_data:
+            batches = iter(dataset(cfg, cfg.train_batch_size, prefetch=False))
+        else:
+            batches = ({"token_x": synthetic_text_batch(cfg, i)["token_x"]}
+                       for i in __import__("itertools").count())
         for i in range(cfg.num_of_sample):
-            nt = _np_to_nt(synthetic_text_batch(cfg, i), cfg)["token_x"]
+            nt = _np_to_nt(next(batches), cfg)["token_x"]
             out = np.asarray(fwd(nt, np.int32(0), np.float32(0.0),
                                  jax.random.key(i)))
             print("target:")

@@ -39,6 +39,7 @@ class Trainer:
         self.axes: typing.Dict[str, typing.Tuple[str, ...]] = {}
         self.optimizer: typing.Optional[Optimizer] = None
         self._step_fn = None
+        self._compiled = None  # AOT executable (see step_cost_analysis)
 
     # -- initialization ------------------------------------------------------
     def init(self, batch: typing.Dict[str, NT], seed: int = 0) -> TrainState:
@@ -203,6 +204,13 @@ class Trainer:
              rng: jax.Array):
         if self._step_fn is None:
             self._step_fn = self._make_step()
+        if self._compiled is not None:
+            # AOT executable from step_cost_analysis (jit's dispatch cache is
+            # separate, so calling the jit fn would compile a second time)
+            try:
+                return self._compiled(state, batch, rng)
+            except TypeError:  # shapes/dtypes changed since the AOT compile
+                self._compiled = None
         with self.mesh:
             return self._step_fn(state, batch, rng)
 
@@ -210,13 +218,14 @@ class Trainer:
                            batch: typing.Dict[str, NT]
                            ) -> typing.Dict[str, float]:
         """XLA cost analysis (flops, bytes accessed) of the compiled train
-        step — feeds the bench's FLOPs/step and MFU reporting."""
+        step.  The compiled executable is kept and reused by ``step`` so the
+        analysis does not cost a second compilation (bench.py)."""
         if self._step_fn is None:
             self._step_fn = self._make_step()
         with self.mesh:
-            compiled = self._step_fn.lower(
+            self._compiled = self._step_fn.lower(
                 state, batch, jax.random.key(0)).compile()
-        cost = compiled.cost_analysis()
+        cost = self._compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax returns per-device list
             cost = cost[0] if cost else {}
         return dict(cost or {})

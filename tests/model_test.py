@@ -145,3 +145,31 @@ def test_einsum_f32_accumulation():
     af = NT(jnp.ones((4, 8), jnp.float32), ("row", "inner"))
     bf = NT(jnp.ones((8, 3), jnp.float32), ("inner", "col"))
     assert nd.einsum([af, bf], ("row", "col")).dtype == jnp.float32
+
+
+def test_pallas_causal_map_attention_parity():
+    """Interpret-mode parity of the (measured-and-rejected) pallas mixer
+    kernel against the production masked einsum (docs/perf/README.md)."""
+    import numpy as np
+
+    from homebrewnlp_tpu.ops.pallas_attn import (_fwd_einsum, _fwd_pallas,
+                                                 causal_map_attention)
+    k1, k2 = jax.random.split(jax.random.key(0))
+    bias = jax.random.normal(k1, (2, 256, 256), jnp.float32)
+    val = jax.random.normal(k2, (2, 256, 2, 128), jnp.float32)
+    a = np.asarray(_fwd_einsum(bias, val))
+    b = np.asarray(_fwd_pallas(bias, val, interpret=True))
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+    # custom_vjp grads match autodiff through the einsum form
+    def loss_k(bias, val):
+        return jnp.sum(jnp.square(causal_map_attention(bias, val, False)))
+
+    def loss_e(bias, val):
+        return jnp.sum(jnp.square(_fwd_einsum(bias, val)))
+
+    ga = jax.grad(loss_k, argnums=(0, 1))(bias, val)
+    ge = jax.grad(loss_e, argnums=(0, 1))(bias, val)
+    for x, y in zip(ga, ge):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-4, atol=2e-4)

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Build the video-worker image from the repo root and push it; pass the image
+# tag as $1 (reference scripts/build_and_push.sh).
+set -ex
+TAG="${1:?usage: build_and_push.sh <registry/image:tag>}"
+ROOT="$(dirname "$0")/../.."
+cp "$ROOT"/tools/video2tfrecord.py "$ROOT"/tools/manifest.py "$(dirname "$0")/"
+cp -r "$ROOT"/homebrewnlp_tpu "$(dirname "$0")/homebrewnlp_tpu"
+docker build -t "$TAG" "$(dirname "$0")"
+docker push "$TAG"

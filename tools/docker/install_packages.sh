@@ -1,0 +1,9 @@
+#!/bin/bash
+set -euvo pipefail
+export DEBIAN_FRONTEND=noninteractive
+apt-get update
+apt-get install -y python3 python3-pip ffmpeg libgl-dev git
+python3 -m pip install -U pip
+pip3 install -r requirements.txt
+apt-get clean
+rm -rf /var/lib/apt/lists/*
