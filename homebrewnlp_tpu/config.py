@@ -28,6 +28,7 @@ HEIGHT = "height"
 WIDTH = "width"
 COLOR_CHANNELS = "color_channels"
 EXPERTS = "experts"
+ROUTED_EXPERTS = "routed_experts"
 PKM_AXES = "pkm_axes"
 PKM_VALUES = "product_key_value_dim"
 
@@ -157,6 +158,7 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     intermediate_feed_forward_multiplier_multiplier=None,
     embedding_stddev=0.04,
     experts=64,
+    moe_balance_weight=0.01,  # routed_moe load-balance aux loss (extension)
     pkm_axes=2,
     convolution_size=16,
     scale_by_depth=True,

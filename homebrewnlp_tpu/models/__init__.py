@@ -167,7 +167,7 @@ def _body(ctx: Ctx, src: NT) -> NT:
             p2 = f"{root}/shared_{c}/"
             return [k for k in all_keys if k.startswith(p1) or k.startswith(p2)]
 
-        def make_f(k: int, i: int, c: int):
+        def make_f(k: int, i: int, c: int, aux_sink=None):
             conf = cfg.block_config[c]
             a_start = attn_starts[k]
             rng = None if ctx.rng is None else jax.random.fold_in(ctx.rng, 1000 + k)
@@ -178,11 +178,17 @@ def _body(ctx: Ctx, src: NT) -> NT:
                 bctx._scope = [mode_scope, "body"]
                 bctx.attention_idx = a_start
                 with bctx.scope(_block_scope(i, c)):
-                    return block_part_fn(bctx, conf, x)
+                    out = block_part_fn(bctx, conf, x)
+                if aux_sink is not None:
+                    # only safe when f is NOT wrapped in custom_vjp /
+                    # jax.checkpoint (tracers may not cross those boundaries)
+                    aux_sink.extend(bctx.aux_losses)
+                return out
 
             return f
 
-        fs = [make_f(k, i, c) for k, (i, c) in enumerate(seq)]
+        sink = ctx.aux_losses if strategy == "none" else None
+        fs = [make_f(k, i, c, aux_sink=sink) for k, (i, c) in enumerate(seq)]
         subparams = tuple({k: ctx.params[k] for k in keys_for(i, c)} for i, c in seq)
         ctx.attention_idx = acc
 
@@ -298,6 +304,12 @@ def build(ctx: Ctx, batch: typing.Dict[str, NT]) -> ModelOutput:
         frame_out, token_out = ctx.scoped("output", _output, ctx, out, spatial_ctx)
         loss_list, token_loss, acc, video_loss = ctx.scoped(
             "loss", _loss, ctx, frame_out, token_out, batch, vid_tgt)
+        if ctx.aux_losses:
+            # layer-collected auxiliary terms (routed-MoE load balance)
+            aux = ctx.aux_losses[0]
+            for a in ctx.aux_losses[1:]:
+                aux = aux + a
+            loss_list = [loss_list[0] + aux] + list(loss_list[1:])
     total = loss_list[0]
     for l in loss_list[1:]:
         total = total + l

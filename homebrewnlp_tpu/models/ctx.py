@@ -69,6 +69,9 @@ class Ctx:
         self.attention_idx = 0
         # stash for contrastive loss (reference dataclass.py:29-31)
         self.text_input_embedding: typing.Optional[NT] = None
+        # layer-collected auxiliary loss terms (routed-MoE load balance);
+        # only propagated out of non-reversible bodies — see _body
+        self.aux_losses: typing.List[jnp.ndarray] = []
         self.param_count = 0
 
     # -- scoping ------------------------------------------------------------

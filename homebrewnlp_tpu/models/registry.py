@@ -73,4 +73,7 @@ LAYER_FUNCTIONS: typing.Dict[str, typing.Callable[[Args], NT]] = {
     "transpose_sequence_features": layers.transpose_sequence_features,
     "bottleneck_group_linear": layers.bottleneck_group_linear,
     "sum_heads": layers.sum_heads,
+    # extension: top-k routed MoE with expert-parallel all-to-all dispatch
+    # (SURVEY.md §2.12 row EP; the reference only has the dense soft MoE)
+    "routed_moe": layers.routed_mixture_of_experts,
 }

@@ -14,19 +14,23 @@ import typing
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..config import ANON_PREFIX, BATCH, EXPERTS, HEADS, SEQUENCE
+from ..config import ANON_PREFIX, BATCH, EXPERTS, HEADS, ROUTED_EXPERTS, SEQUENCE
 from ..nd import NT
 from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 # logical axis -> mesh axis.  Everything else is replicated — the reference
-# layout splits only batch and heads (SURVEY.md §2.12); the experts mapping
-# is our expert-parallel extension (the reference's MoE expert axis is never
-# laid out, §2.12 row EP).
+# layout splits only batch and heads (SURVEY.md §2.12); the experts mappings
+# are our expert-parallel extension (the reference's MoE expert axis is never
+# laid out, §2.12 row EP).  Routed (top-k) experts shard over the DATA axis:
+# tokens live data-sharded, expert shards own disjoint experts, and the
+# dispatch/combine einsums make GSPMD emit the token<->expert all-to-all
+# across that axis while features stay head-sharded on the model axis.
 RULES: typing.Dict[str, str] = {
     BATCH: DATA_AXIS,
     HEADS: MODEL_AXIS,
     SEQUENCE: SEQ_AXIS,
     EXPERTS: MODEL_AXIS,
+    ROUTED_EXPERTS: DATA_AXIS,
 }
 
 

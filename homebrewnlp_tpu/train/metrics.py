@@ -18,6 +18,11 @@ import typing
 
 import numpy as np
 
+# log2-|grad| histogram bucket edges shared between the train step (which
+# bins on-device, train/state.py) and the TensorBoard rendering below
+GRAD_HIST_EDGES = np.arange(-30.0, 7.0, 1.0)
+GRAD_HIST_PREFIX = "grad_hist/"
+
 
 def color_print(*args, color: str = "\x1b[32;1m") -> None:
     now = datetime.datetime.now().strftime("%H:%M:%S.%f")[:-3]
@@ -43,11 +48,19 @@ class MetricWriter:
     def write(self, step: int, metrics: typing.Dict[str, typing.Any]) -> None:
         now = time.time()
         scalars = {}
+        hists = {}
         for k, v in metrics.items():
             try:
-                scalars[k] = float(np.asarray(v))
+                arr = np.asarray(v)
             except Exception:
                 continue
+            if arr.size == 1:
+                scalars[k] = float(arr)
+            elif k.startswith(GRAD_HIST_PREFIX) and arr.ndim == 1:
+                # histogram counts over GRAD_HIST_EDGES buckets emitted by
+                # debug_gradients (train/state.py); other non-scalar metrics
+                # are skipped
+                hists[k] = arr.astype(np.float64)
         scalars["step"] = int(step)
         scalars["wall_time"] = now
         scalars["step_seconds"] = now - self._last_step_time
@@ -60,6 +73,20 @@ class MetricWriter:
             for k, v in scalars.items():
                 if k not in ("step", "wall_time"):
                     self._tb.add_scalar(k, v, step)
+            for k, counts in hists.items():
+                # counts over GRAD_HIST_EDGES buckets: reconstruct the
+                # raw-stat form add_histogram_raw expects
+                limits = GRAD_HIST_EDGES[1:][:len(counts)]
+                n = float(counts.sum())
+                if n <= 0:
+                    continue
+                centers = limits - 0.5
+                self._tb.add_histogram_raw(
+                    k, min=float(limits[0] - 1), max=float(limits[-1]),
+                    num=n, sum=float((centers * counts).sum()),
+                    sum_squares=float((centers ** 2 * counts).sum()),
+                    bucket_limits=limits.tolist(),
+                    bucket_counts=counts.tolist(), global_step=step)
 
     def close(self) -> None:
         self._f.close()

@@ -189,11 +189,19 @@ class Trainer:
                 "step": state.step,
             })
             if cfg.debug_gradients:
-                # per-variable gradient norms (the reference's --debug_grad
-                # histogram stream, src/run/run.py:147-153)
+                # per-variable gradient norms + log2-magnitude histograms
+                # (the reference's --debug_grad histogram stream,
+                # src/run/run.py:147-153); the metric writer renders the
+                # grad_hist/ vectors as TensorBoard histograms
+                from .metrics import GRAD_HIST_EDGES
+                edges = jnp.asarray(GRAD_HIST_EDGES)
                 for name, g in grads.items():
+                    gf = g.astype(jnp.float32)
                     metrics[f"grad_norm/{name}"] = jnp.sqrt(
-                        jnp.sum(jnp.square(g.astype(jnp.float32))))
+                        jnp.sum(jnp.square(gf)))
+                    mag = jnp.log2(jnp.abs(gf).reshape(-1) + 1e-38)
+                    hist, _ = jnp.histogram(mag, bins=edges)
+                    metrics[f"grad_hist/{name}"] = hist
             new_state = TrainState(new_params, new_opt,
                                    state.step + step_increment)
             return new_state, metrics

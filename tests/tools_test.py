@@ -142,3 +142,32 @@ def test_manifest_chunk_and_split(tmp_path):
         for c in data["id"]:
             seen.extend(c)
     assert sorted(seen) == sorted(manifest["id"])
+
+
+def test_text2tfrecord_jsonl_zst(tmp_path):
+    """Pile-style streaming ingestion: .jsonl.zst shards -> TFRecords, one
+    record per document, token count in the filename."""
+    import json as jsonlib
+    import subprocess
+    zstandard = pytest.importorskip("zstandard")
+    docs = ["hello world", "the quick brown fox", "pile document three"]
+    src = tmp_path / "shard0.jsonl.zst"
+    raw = "\n".join(jsonlib.dumps({"text": d, "meta": {}}) for d in docs)
+    src.write_bytes(zstandard.ZstdCompressor().compress(raw.encode()))
+
+    out = tmp_path / "out"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/text2tfrecord.py"),
+         "--input", str(src), "--output-dir", str(out), "--jsonl-zst",
+         "--procs", "1"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+    from homebrewnlp_tpu.data.tfrecord import decode_example, read_records
+    shards = sorted(out.glob("*.tfrecord"))
+    assert len(shards) == 1
+    total = int(shards[0].stem.split("_")[-1])
+    payloads = list(read_records(str(shards[0]), verify=True))
+    assert len(payloads) == 3
+    texts = [decode_example(p)["text"][0].decode() for p in payloads]
+    assert texts == docs
+    assert total == sum(len(d) for d in docs)

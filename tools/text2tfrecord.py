@@ -35,6 +35,11 @@ def encode_file(path: str, merges: typing.Optional[np.ndarray]
                 ) -> typing.Tuple[bytes, int]:
     with open(path, "rb") as f:
         raw = clean_text(f.read())
+    return encode_payload(raw, merges)
+
+
+def encode_payload(raw: bytes, merges: typing.Optional[np.ndarray]
+                   ) -> typing.Tuple[bytes, int]:
     if merges is None:
         return encode_example({"text": raw}), len(raw)
     toks = np.frombuffer(raw, np.uint8).astype(np.int32)
@@ -42,34 +47,73 @@ def encode_file(path: str, merges: typing.Optional[np.ndarray]
     return encode_example({"text": [int(t) for t in toks]}), len(toks)
 
 
+def iter_jsonl_zst(path: str) -> typing.Iterator[str]:
+    """Stream documents out of a Pile-style ``.jsonl.zst`` shard — local path
+    or URL (http/gs via data/fs.py), mirroring the reference's streaming
+    downloader (scripts/text2tfrecord.py:35-54)."""
+    import io
+
+    import zstandard
+
+    from homebrewnlp_tpu.data import fs
+    with fs.open_stream(path, "rb") as raw:
+        reader = zstandard.ZstdDecompressor().stream_reader(raw)
+        for line in io.TextIOWrapper(reader, encoding="utf-8",
+                                     errors="replace"):
+            line = line.strip()
+            if not line:
+                continue
+            yield json.loads(line).get("text", "")
+
+
 def _work(job) -> str:
-    shard_idx, paths, out_dir, tokenizer_path = job
+    shard_idx, paths, out_dir, tokenizer_path, jsonl_zst = job
     merges = None
     suffix = "bytes"
     if tokenizer_path:
         with open(tokenizer_path) as f:
             merges = np.asarray(json.load(f)["merges"], np.int32)
         suffix = "int64"
-    payloads, total = [], 0
-    for p in paths:
-        payload, n = encode_file(p, merges)
-        payloads.append(payload)
-        total += n
-    name = f"shard{suffix}{shard_idx:05d}_{total}.tfrecord"
+    import tempfile
+
     from homebrewnlp_tpu.data import fs
-    if fs.is_remote(out_dir):
-        # write locally, then upload with bounded-retry backoff (the
-        # reference's GCS loop, scripts/text2tfrecord.py:61-89)
-        import tempfile
-        with tempfile.TemporaryDirectory() as td:
-            local = os.path.join(td, name)
-            write_records(local, payloads)
+    from homebrewnlp_tpu.data.tfrecord import RecordWriter
+
+    remote = fs.is_remote(out_dir)
+    # the token total goes in the FILENAME (run-log replay convention), so
+    # records stream to a temp file that is renamed/uploaded once known —
+    # a Pile shard decompresses to GBs and must not be buffered in RAM
+    tmpdir = tempfile.mkdtemp(prefix="t2t_")
+    tmp = os.path.join(tmpdir, f"shard{shard_idx:05d}.part")
+    total = 0
+    try:
+        with RecordWriter(tmp) as w:
+            for p in paths:
+                if jsonl_zst:
+                    # one TFRecord record per document (documents never
+                    # cross records — the pipeline's windowing assumption)
+                    for doc in iter_jsonl_zst(p):
+                        payload, n = encode_payload(clean_text(doc.encode()),
+                                                    merges)
+                        w.write(payload)
+                        total += n
+                else:
+                    payload, n = encode_file(p, merges)
+                    w.write(payload)
+                    total += n
+        name = f"shard{suffix}{shard_idx:05d}_{total}.tfrecord"
+        if remote:
+            # upload with bounded-retry backoff (the reference's GCS loop,
+            # scripts/text2tfrecord.py:61-89)
             out = out_dir.rstrip("/") + "/" + name
-            fs.put_with_retry(local, out)
+            fs.put_with_retry(tmp, out)
+        else:
+            out = os.path.join(out_dir, name)
+            os.replace(tmp, out)
         return out
-    out = os.path.join(out_dir, name)
-    write_records(out, payloads)
-    return out
+    finally:
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def main() -> None:
@@ -80,6 +124,9 @@ def main() -> None:
                     help="tokenizer.json from tools/train_tokenizer.py "
                          "(omit for byte-level)")
     ap.add_argument("--files-per-shard", type=int, default=16)
+    ap.add_argument("--jsonl-zst", action="store_true",
+                    help="inputs are Pile-style .jsonl.zst shards (local or "
+                         "URL), streamed document-by-document")
     ap.add_argument("--procs", type=int, default=os.cpu_count())
     ap.add_argument("--post-cmd", default="",
                     help="shell command run per finished shard, {} = path "
@@ -90,9 +137,10 @@ def main() -> None:
         os.makedirs(args.output_dir, exist_ok=True)
 
     jobs = []
-    for i in range(0, len(args.input), args.files_per_shard):
-        jobs.append((len(jobs), args.input[i:i + args.files_per_shard],
-                     args.output_dir, args.tokenizer))
+    per = 1 if args.jsonl_zst else args.files_per_shard
+    for i in range(0, len(args.input), per):
+        jobs.append((len(jobs), args.input[i:i + per],
+                     args.output_dir, args.tokenizer, args.jsonl_zst))
     with multiprocessing.Pool(min(args.procs, len(jobs))) as pool:
         for out in pool.imap_unordered(_work, jobs):
             print(out, flush=True)
