@@ -379,3 +379,15 @@ def test_local_row_slice_two_process_layout():
     with pytest.raises(ValueError):
         local_row_slice((slice(0, 8), slice(None)), local, global_rows)
     assert local_row_slice((slice(0, 8), slice(None)), 8, 8) == slice(0, 8)
+
+
+def test_metric_writer_scalars_and_histograms(tmp_path):
+    import json as jsonlib
+    from homebrewnlp_tpu.train.metrics import MetricWriter
+    w = MetricWriter(str(tmp_path))
+    w.write(0, {"loss": 1.5, "grad_hist/x": np.array([0, 3, 5, 1]),
+                "grad_norm/x": np.float32(2.0)})
+    w.close()
+    line = jsonlib.loads((tmp_path / "metrics.jsonl").read_text().splitlines()[0])
+    assert line["loss"] == 1.5 and line["grad_norm/x"] == 2.0
+    assert "grad_hist/x" not in line  # vectors go to TB only

@@ -270,3 +270,32 @@ def test_kv_cache_engine_routing():
     engine_rb = CompletionEngine(cfg, params, force_rebuild=True)
     out_rb = engine_rb.complete_tokens([1, 2, 3], temperature=0.0, max_tokens=4)
     assert len(out_rb) == 7
+
+
+def test_cli_debug_video_similarity(tmp_path, capsys):
+    from homebrewnlp_tpu.main import main
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(dict(
+        model_mode="jannet", use_video=True, use_language=False,
+        frame_height=32, frame_width=32, patch_size=16, sequence_length=4,
+        experts=1, depth=1, heads=2, features_per_head=16,
+        memory_reduction_strategy="none", initial_autoregressive_position=1,
+        intermediate_feed_forward_multiplier_multiplier=0.5,
+        block_config=[{"layer": ["norm-shift-scale", "feed_forward-in:relu"]}],
+        model_path=str(tmp_path / "run"))))
+    main(["--model", str(cfg_path), "--run_mode", "debug"])
+    assert "similarity: 100.00%" in capsys.readouterr().out
+
+
+def test_cli_debug_text_similarity(tmp_path, capsys):
+    from homebrewnlp_tpu.main import main
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(dict(
+        model_mode="gpt", use_video=False, sequence_length=12, heads=2,
+        features_per_head=16, depth=1, vocab_size=32,
+        memory_reduction_strategy="none",
+        intermediate_feed_forward_multiplier_multiplier=0.5,
+        block_config=[{"layer": ["norm-shift-scale", "feed_forward-in:relu"]}],
+        model_path=str(tmp_path / "run"))))
+    main(["--model", str(cfg_path), "--run_mode", "debug"])
+    assert "similarity: 100.00%" in capsys.readouterr().out
