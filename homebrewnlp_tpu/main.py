@@ -314,29 +314,27 @@ def debug(cfg, args) -> None:
     cfg.use_autoregressive_sampling = True
     cfg.sampling_temperature = 0
     params = _params_for_serving(cfg)
+    n_samples = max(2, min(4, cfg.equal_debugging_items_per_check))
     if cfg.use_video:
-        # video self-similarity: two identical greedy video rollouts must
-        # produce bit-equal frames
+        # video self-similarity: identical greedy rollouts must produce
+        # bit-equal frames
         import jax
 
         from .data.synthetic import synthetic_video_batch
         from .infer.sampler import autoregressive_video
         batch = _np_to_nt(synthetic_video_batch(cfg, 0), cfg)
         fn = jax.jit(lambda p, b: autoregressive_video(cfg, p, b)[1])
-        outs = [np.asarray(fn(params, batch), np.float32) for _ in range(2)]
-        if not all(np.isfinite(o).all() for o in outs):
+        samples = [np.asarray(fn(params, batch), np.float32)
+                   for _ in range(n_samples)]
+        if not all(np.isfinite(s).all() for s in samples):
             raise SystemExit("non-finite frames generated — check the "
                              "checkpoint, not sampler determinism")
-        score = similarity_score(outs)
-        print(f"similarity: {score * 100:.2f}%")
-        if score < 1.0:
-            raise SystemExit("nondeterministic sampling detected")
-        return
-    engine = CompletionEngine(cfg, params, force_rebuild=True)
-    prompt = list(range(min(16, cfg.vocab_size)))
-    samples = [engine.complete_tokens(prompt, temperature=0.0)
-               for _ in range(max(2, min(4, cfg.equal_debugging_items_per_check)))]
-    score = similarity_score([np.asarray(s) for s in samples])
+    else:
+        engine = CompletionEngine(cfg, params, force_rebuild=True)
+        prompt = list(range(min(16, cfg.vocab_size)))
+        samples = [np.asarray(engine.complete_tokens(prompt, temperature=0.0))
+                   for _ in range(n_samples)]
+    score = similarity_score(samples)
     print(f"similarity: {score * 100:.2f}%")
     if score < 1.0:
         raise SystemExit("nondeterministic sampling detected")

@@ -1,0 +1,58 @@
+"""Worker for the real multi-process SPMD test (multiprocess_test.py).
+
+Runs as ``python multiprocess_worker.py <rank> <port>``: joins a 2-process
+jax.distributed cluster (4 virtual CPU devices per process -> 8 global),
+builds the framework's data x model mesh spanning both processes, feeds its
+local half of the batch through data/feed.py, and runs 5 train steps.  The
+cross-process gradient all-reduce and head-sharded matmul collectives ride
+the gloo backend — the CPU stand-in for the reference's multi-host story
+(SURVEY.md §5.8: TF distributed session over DCN)."""
+import os
+import sys
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+import jax.extend  # noqa: E402
+
+# the sitecustomize-registered accelerator plugin initializes backends at
+# interpreter start; clear them so the distributed CPU cluster forms
+jax.extend.backend.clear_backends()
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 4)
+jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=2,
+                           process_id=rank)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from homebrewnlp_tpu.config import Config  # noqa: E402
+from homebrewnlp_tpu.data import synthetic_text_batch, to_global  # noqa: E402
+from homebrewnlp_tpu.parallel import make_mesh  # noqa: E402
+from homebrewnlp_tpu.train import Trainer  # noqa: E402
+
+cfg = Config(dict(
+    model_mode="gpt", use_video=False, sequence_length=16, heads=4,
+    features_per_head=32, vocab_size=64, depth=1, train_batch_size=8,
+    memory_reduction_strategy="none", optimizer="adam-learning_rate",
+    learning_rate=1e-2, weight_decay=0.0,
+    intermediate_feed_forward_multiplier_multiplier=0.5,
+    block_config=[{"layer": ["norm-shift-scale", "feed_forward-in:relu"]}]))
+mesh = make_mesh(cfg)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8
+trainer = Trainer(cfg, mesh)
+
+# each process feeds ITS half of the global batch (data/feed.py)
+full = synthetic_text_batch(cfg, 0)
+local = {k: v[rank * 4:(rank + 1) * 4] for k, v in full.items()}
+state = trainer.init(to_global(local, cfg, mesh))
+losses = []
+for i in range(5):
+    gb = to_global(local, cfg, mesh)
+    state, m = trainer.step(state, gb, jax.random.key(i))
+    losses.append(float(m["loss"]))
+assert losses[-1] < losses[0], losses
+print(f"rank{rank}: mesh={dict(mesh.shape)} "
+      f"losses {losses[0]:.4f}->{losses[-1]:.4f} MULTIPROC_OK", flush=True)
