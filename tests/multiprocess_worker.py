@@ -32,27 +32,46 @@ from homebrewnlp_tpu.data import synthetic_text_batch, to_global  # noqa: E402
 from homebrewnlp_tpu.parallel import make_mesh  # noqa: E402
 from homebrewnlp_tpu.train import Trainer  # noqa: E402
 
-cfg = Config(dict(
-    model_mode="gpt", use_video=False, sequence_length=16, heads=4,
-    features_per_head=32, vocab_size=64, depth=1, train_batch_size=8,
-    memory_reduction_strategy="none", optimizer="adam-learning_rate",
-    learning_rate=1e-2, weight_decay=0.0,
-    intermediate_feed_forward_multiplier_multiplier=0.5,
-    block_config=[{"layer": ["norm-shift-scale", "feed_forward-in:relu"]}]))
-mesh = make_mesh(cfg)
 assert jax.process_count() == 2, jax.process_count()
 assert len(jax.devices()) == 8
-trainer = Trainer(cfg, mesh)
 
-# each process feeds ITS half of the global batch (data/feed.py)
-full = synthetic_text_batch(cfg, 0)
-local = {k: v[rank * 4:(rank + 1) * 4] for k, v in full.items()}
-state = trainer.init(to_global(local, cfg, mesh))
-losses = []
-for i in range(5):
-    gb = to_global(local, cfg, mesh)
-    state, m = trainer.step(state, gb, jax.random.key(i))
-    losses.append(float(m["loss"]))
-assert losses[-1] < losses[0], losses
-print(f"rank{rank}: mesh={dict(mesh.shape)} "
-      f"losses {losses[0]:.4f}->{losses[-1]:.4f} MULTIPROC_OK", flush=True)
+
+def run_case(name, **over):
+    base = dict(
+        model_mode="gpt", use_video=False, sequence_length=16, heads=4,
+        features_per_head=32, vocab_size=64, depth=1, train_batch_size=8,
+        memory_reduction_strategy="none", optimizer="adam-learning_rate",
+        learning_rate=1e-2, weight_decay=0.0,
+        intermediate_feed_forward_multiplier_multiplier=0.5,
+        block_config=[{"layer": ["norm-shift-scale", "feed_forward-in:relu"]}])
+    base.update(over)
+    cfg = Config(base)
+    mesh = make_mesh(cfg)
+    trainer = Trainer(cfg, mesh)
+    full = synthetic_text_batch(cfg, 0)
+    rows = full["token_x"].shape[0] // 2
+    local = {k: v[rank * rows:(rank + 1) * rows] for k, v in full.items()}
+    state = trainer.init(to_global(local, cfg, mesh))
+    losses = []
+    for i in range(5):
+        gb = to_global(local, cfg, mesh)
+        state, m = trainer.step(state, gb, jax.random.key(i))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], (name, losses)
+    # full-precision full sequence: the harness compares this line across
+    # ranks to catch any cross-process divergence, not just the endpoints
+    print(f"rank{rank}: {name} mesh={dict(mesh.shape)} "
+          f"losses={[x.hex() for x in losses]}", flush=True)
+
+
+# 1) data x model parallel: cross-process gradient all-reduce + head-sharded
+#    matmul collectives
+run_case("dp_tp")
+# 2) data x sequence x model: ring attention's ppermute ring crosses the
+#    process boundary (long-context sequence parallelism over "DCN")
+run_case("dp_sp_tp", heads=2, sequence_parallel=2, sequence_length=32,
+         block_config=[
+             {"layer": ["norm-shift-scale",
+                        "attention-in:relu-dot_product-embedded-relative"]},
+             {"layer": ["norm-shift-scale", "feed_forward-in:relu"]}])
+print(f"rank{rank}: MULTIPROC_OK", flush=True)
