@@ -39,7 +39,7 @@ def glob(pattern: str) -> typing.List[str]:
     if not is_remote(pattern):
         return globlib.glob(pattern)
     import fsspec
-    fsys, _, paths = fsspec.get_fs_token_paths(pattern)
+    _, _, paths = fsspec.get_fs_token_paths(pattern)
     protocol = pattern.split("://", 1)[0]
     return [p if is_remote(p) else f"{protocol}://{p}" for p in paths]
 

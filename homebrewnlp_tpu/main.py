@@ -46,6 +46,12 @@ def _init_distributed(tpu_arg: str) -> None:
         jax.distributed.initialize(addr, int(size), int(rank))
 
 
+def _have_dataset_files(cfg) -> bool:
+    from .data import fs
+    return bool(cfg.dataset_configs) and any(
+        fs.glob(d["path"]) for d in cfg.dataset_configs)
+
+
 def _build_state(cfg, batch, mesh=None):
     from .train import Checkpointer, Trainer, color_print
     trainer = Trainer(cfg, mesh)
@@ -76,9 +82,7 @@ def train(cfg, args) -> None:
     from .data.synthetic import synthetic_text_batch
     from .train import MetricWriter, color_print
 
-    from .data import fs
-    have_data = bool(cfg.dataset_configs) and any(
-        fs.glob(d["path"]) for d in cfg.dataset_configs)
+    have_data = _have_dataset_files(cfg)
     slice_index = jax.process_index()
     slice_count = max(1, jax.process_count())
     # macro-batching inflates the per-step host batch by M (reference
@@ -92,10 +96,8 @@ def train(cfg, args) -> None:
         probe = dataset(cfg, local_batch, slice_index, slice_count,
                         prefetch=False)
         first_np = next(iter(probe))
-        pipe = True  # real pipeline constructed below
     else:
         color_print("no dataset files found; using synthetic data")
-        pipe = None
         first_np = synthetic_text_batch(cfg, 0)
 
     from .parallel import make_mesh
@@ -109,7 +111,8 @@ def train(cfg, args) -> None:
         import jax.numpy as jnp
         state = state._replace(step=jnp.asarray(cfg.current_step, jnp.int32))
     step0 = int(state.step)
-    if pipe is not None:
+    pipe = None
+    if have_data:
         # the real (prefetched) pipeline, with the checkpointed cursor
         # restored before the first read
         pipe = dataset(cfg, local_batch, slice_index, slice_count)
@@ -208,7 +211,8 @@ def _video_batches(cfg):
     paths = [p for g in globs for p in fs.glob(g)]
     if paths:
         return iter(VideoPipeline(cfg, cfg.train_batch_size, paths=paths))
-    return (synthetic_video_batch(cfg, i) for i in __import__("itertools").count())
+    import itertools
+    return (synthetic_video_batch(cfg, i) for i in itertools.count())
 
 
 def _np_to_nt(np_batch, cfg):
@@ -267,19 +271,18 @@ def sample(cfg, args) -> None:
         # files exist
         import jax
         import numpy as np
-        from .data import dataset, fs
+        from .data import dataset
         from .data.synthetic import synthetic_text_batch
         from .infer.sampler import make_single_forward
         from .serve.interface import tokenizer_for
         tok = tokenizer_for(cfg)
         fwd = make_single_forward(cfg, params)
-        have_data = bool(cfg.dataset_configs) and any(
-            fs.glob(d["path"]) for d in cfg.dataset_configs)
-        if have_data:
+        if _have_dataset_files(cfg):
             batches = iter(dataset(cfg, cfg.train_batch_size, prefetch=False))
         else:
+            import itertools
             batches = ({"token_x": synthetic_text_batch(cfg, i)["token_x"]}
-                       for i in __import__("itertools").count())
+                       for i in itertools.count())
         for i in range(cfg.num_of_sample):
             nt = _np_to_nt(next(batches), cfg)["token_x"]
             out = np.asarray(fwd(nt, np.int32(0), np.float32(0.0),
@@ -347,7 +350,7 @@ def debug_old(cfg, args) -> None:
     import jax
     import numpy as np
 
-    from .data import dataset, fs
+    from .data import dataset
     from .infer.sampler import make_text_sampler
     from .nd import NT
     from .serve import similarity_score
@@ -355,9 +358,7 @@ def debug_old(cfg, args) -> None:
     from .train import color_print
 
     params = _params_for_serving(cfg)
-    have_data = bool(cfg.dataset_configs) and any(
-        fs.glob(d["path"]) for d in cfg.dataset_configs)
-    if have_data:
+    if _have_dataset_files(cfg):
         np_batch = next(iter(dataset(cfg, 1)))
         token_x = np.asarray(np_batch["token_x"])[:1]
     else:

@@ -29,7 +29,17 @@ def test_two_process_distributed_train_step():
                           stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                           text=True, env=env)
     try:
-        out0, _ = p0.communicate(timeout=420)
+        try:
+            out0, _ = p0.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            # rank0 hung — usually because rank1 died and left it blocked in
+            # a collective; surface rank1's traceback instead of a bare
+            # timeout
+            p1.kill()
+            out1 = p1.communicate()[0] if p1.stdout else ""
+            p0.kill()
+            raise AssertionError(f"rank0 timed out; rank1 output:\n"
+                                 f"{out1[-2000:]}")
         if p0.returncode != 0:
             # a dead rank leaves the peer blocked in a collective — kill it
             # so the failure surfaces rank0's traceback, not a timeout
