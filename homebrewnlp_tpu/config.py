@@ -174,6 +174,7 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # parallelism (the reference's two knobs, plus TPU-native extensions)
     tpu_size=32,
     sequence_parallel=1,  # extension: size of the sequence-parallel mesh axis
+    pipeline_parallel=1,  # extension: GPipe stages over the pipeline axis
     # sampling / serving
     initial_autoregressive_position=128,
     use_autoregressive_sampling=False,
@@ -195,12 +196,6 @@ class Config:
     def __init__(self, config: typing.Optional[dict] = None):
         self.__dict__.update(_DEFAULTS)
         config = dict(config or {})
-        # rejected (not silently ignored): pipeline parallelism is not
-        # implemented — scale via the data/model/sequence_parallel axes
-        if config.pop("pipeline_parallel", 1) != 1:
-            raise NotImplementedError(
-                "pipeline_parallel is not supported; use tpu_size/heads "
-                "(data x model) and sequence_parallel instead")
         for k, v in config.items():
             if k not in _DEFAULTS and k not in ("mesh_shape", "layout"):
                 print(f"WARNING: Unknown Config parameter {k}={v!r}")
@@ -247,6 +242,39 @@ class Config:
             self.multi_loss_strategy = "linear"
         if not self.use_language and not self.use_video:
             raise ValueError("Language and video mode are both disabled")
+        # GPipe pipeline parallelism (ops/pipeline.py): stages must cut the
+        # depth loop evenly, compose with none/checkpoint rematerialization
+        # only (reversible chains carry custom_vjp state across stages), and
+        # v1 excludes the sequence-parallel ring and cross-depth shared
+        # weights (their single tensor cannot be stage-stacked).
+        assert self.pipeline_parallel >= 1
+        if self.pipeline_parallel > 1:
+            if self.depth % self.pipeline_parallel:
+                raise ValueError("pipeline_parallel must divide depth")
+            if self.memory_reduction_strategy not in ("none", "checkpoint"):
+                raise ValueError(
+                    "pipeline_parallel requires memory_reduction_strategy "
+                    "'none' or 'checkpoint'")
+            if self.sequence_parallel > 1:
+                raise ValueError(
+                    "pipeline_parallel and sequence_parallel cannot combine "
+                    "(nested shard_map regions are not supported)")
+            if self.use_video:
+                raise ValueError(
+                    "pipeline_parallel supports text (gpt) models only: the "
+                    "multi-axis attention rotation depends on the global "
+                    "depth index, which is dynamic inside a pipeline stage")
+            specs = [spec for blk in self.block_config
+                     for spec in (blk["layer"] if isinstance(blk, dict)
+                                  else blk.layer)]
+            if any("shared" in s.split("-") for s in specs):
+                raise ValueError(
+                    "pipeline_parallel cannot stage-stack cross-depth "
+                    "'shared' weights")
+            if any(s.split("-")[0] == "routed_moe" for s in specs):
+                raise ValueError(
+                    "pipeline_parallel cannot carry the routed_moe balance "
+                    "aux loss across the pipeline shard_map boundary")
         if self.weight_standardisation and not self.weight_centralisation:
             self.weight_centralisation = True
         if self.features is None and self.features_per_head is None:
@@ -321,7 +349,7 @@ class Config:
         # parallelism synthesis: reference maps batch->b, heads->h
         # (dataclass.py:247-252); we extend with a sequence-parallel axis.
         self.mesh_data = max(1, self.tpu_size // (
-            self.heads * self.sequence_parallel))
+            self.heads * self.sequence_parallel * self.pipeline_parallel))
         self.mesh_model = self.heads if self.heads > 1 else 1
 
     # -- convenience --------------------------------------------------------

@@ -156,6 +156,9 @@ def _body(ctx: Ctx, src: NT) -> NT:
             ctx.attention_idx = acc
             return out
 
+        if cfg.pipeline_parallel > 1 and ctx.mesh is not None:
+            return _pipelined_body(ctx, src, seq, attn_starts, acc)
+
         # apply mode: each block runs in its own Ctx over a param subdict so
         # the reversible chain can take explicit per-block parameters.
         mode_scope = ctx._scope[0]
@@ -206,6 +209,92 @@ def _body(ctx: Ctx, src: NT) -> NT:
             else:
                 out = f(p, out)
         return out
+
+
+def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
+    """GPipe pipeline-parallel body (ops/pipeline.py): the depth loop is cut
+    into ``cfg.pipeline_parallel`` contiguous stages living on the pipeline
+    mesh axis; microbatches stream through with activations hopping stages
+    via ppermute.  Config validation guarantees stage homogeneity (P divides
+    depth, no cross-depth shared weights) so one stage function — scoped with
+    stage 0's parameter names — serves every stage with its own stacked
+    weights."""
+    from ..ops.pipeline import gpipe, stack_stage_params
+    from ..parallel.mesh import PIPE_AXIS
+    cfg = ctx.cfg
+    n_stages = cfg.pipeline_parallel
+    n_groups = len(seq)
+    assert n_groups % n_stages == 0
+    g = n_groups // n_stages
+    mode_scope = ctx._scope[0]
+    root = f"{mode_scope}/body"
+    all_keys = list(ctx.params.keys())
+
+    def keys_for(i: int, c: int) -> typing.List[str]:
+        prefix = f"{root}/{_block_scope(i, c)}/"
+        return sorted(k for k in all_keys if k.startswith(prefix))
+
+    # per stage s, slot j: the params of group seq[s*g + j], REKEYED to the
+    # stage-0 group's names (identical structure by validation)
+    per_slot = []
+    for s in range(n_stages):
+        slots = []
+        for j in range(g):
+            i, c = seq[s * g + j]
+            i0, c0 = seq[j]
+            frm = f"/{_block_scope(i, c)}/"
+            to = f"/{_block_scope(i0, c0)}/"
+            slots.append({k.replace(frm, to): ctx.params[k]
+                          for k in keys_for(i, c)})
+        per_slot.append(slots)
+    stacked = stack_stage_params(per_slot, ctx.mesh, PIPE_AXIS)
+
+    names = src.names
+    rng = ctx.rng
+
+    def make_block_f(j: int):
+        i0, c0 = seq[j]
+        conf = cfg.block_config[c0]
+
+        def f(subparams: dict, x_nt: NT, stage_idx):
+            key = None
+            if rng is not None:
+                key = jax.random.fold_in(
+                    jax.random.fold_in(rng, 2000 + j), stage_idx)
+            bctx = Ctx(cfg, params=subparams, train=ctx.train, seed=ctx.seed,
+                       rng=key, mesh=None)
+            bctx._scope = [mode_scope, "body"]
+            bctx.attention_idx = attn_starts[j]
+            with bctx.scope(_block_scope(i0, c0)):
+                return block_part_fn(bctx, conf, x_nt)
+
+        return f
+
+    block_fs = [make_block_f(j) for j in range(g)]
+    remat = cfg.memory_reduction_strategy == "checkpoint"
+
+    def stage_fn(slot_params, stage_idx, x):
+        out = NT(x, names)
+        for j, f in enumerate(block_fs):
+            run = jax.checkpoint(f, static_argnums=()) if remat else f
+            out = run(slot_params[j], out, stage_idx)
+        return out.x
+
+    batch = src.x.shape[0]
+    # ideal M >= P microbatches keeps every stage busy; fall back to the
+    # largest batch divisor below P (with partial bubble) rather than
+    # silently serializing the whole pipe
+    divisors = [d for d in range(1, batch + 1) if batch % d == 0]
+    at_least_p = [d for d in divisors if d >= n_stages]
+    n_micro = min(at_least_p) if at_least_p else max(divisors)
+    if n_micro < n_stages:
+        print(f"WARNING: batch {batch} yields only {n_micro} pipeline "
+              f"microbatches for {n_stages} stages — pipe utilization "
+              f"{n_micro}/{n_stages}")
+    y = gpipe(stage_fn, stacked, src.x, n_stages, n_micro, ctx.mesh,
+              PIPE_AXIS)
+    ctx.attention_idx = acc
+    return NT(y, names)
 
 
 # -- output -----------------------------------------------------------------

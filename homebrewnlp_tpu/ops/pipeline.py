@@ -1,0 +1,104 @@
+"""GPipe-style pipeline parallelism over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.12 row PP); round 1
+shipped the knob as a dead axis and round 2 first removed it.  This is the
+real implementation: the body's layer stack is split into P contiguous
+stages, each living on one coordinate of the ``pipeline`` mesh axis; the
+batch is split into M microbatches that flow through the stages in the
+classic GPipe schedule (M + P - 1 ticks), activations hopping stages via
+``ppermute`` over ICI.  Gradients flow through the schedule exactly
+(``ppermute`` transposes to the reverse rotation), verified against the
+sequential composition in tests.
+
+Mechanics (jax >= 0.8 shard_map typing):
+- ``shard_map`` is manual over ONLY the pipe axis (``axis_names``); data /
+  model / sequence axes stay automatic, so GSPMD keeps handling batch and
+  head sharding inside each stage.
+- the scan carry is ``pvary``-ed over the pipe axis up front so its
+  varying-manual-axes type is loop-invariant.
+- the output keeps the pipe axis SHARDED (each stage returns its slice;
+  only the last stage's slice holds data) — claiming replication instead
+  breaks the transpose rule and silently corrupts gradients.
+
+Stage parameters arrive STACKED: a pytree whose leaves have a leading
+``[P, ...]`` stage axis, sharded over the pipe axis, so each device holds
+exactly its stage's weights inside the manual region.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def gpipe(stage_fn: typing.Callable, stacked_params, x: jnp.ndarray,
+          n_stages: int, n_micro: int, mesh: Mesh,
+          axis: str = "pipeline") -> jnp.ndarray:
+    """Apply ``n_stages`` sequential stages to ``x`` with GPipe overlap.
+
+    ``stage_fn(stage_params, stage_index, x_micro) -> y_micro`` runs ONE
+    stage on one microbatch (stage_params = the pytree with the leading
+    stage axis already stripped).  ``x`` is [B, ...]; B must divide by
+    ``n_micro``.  Returns [B, ...] after all stages.
+    """
+    assert x.shape[0] % n_micro == 0, (x.shape, n_micro)
+
+    def body(params, xs):
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis)
+        micro = jax.lax.pvary(
+            xs.reshape((n_micro, xs.shape[0] // n_micro) + xs.shape[1:]),
+            (axis,))
+        buf = jnp.zeros_like(micro[0])
+        outs = jnp.zeros_like(micro)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            buf, outs = carry
+            inject = ((idx == 0) & (t < n_micro)).astype(buf.dtype)
+            feed = (inject * micro[jnp.minimum(t, n_micro - 1)]
+                    + (1 - inject) * buf)
+            y = stage_fn(params, idx, feed)
+            emit_t = t - (n_stages - 1)
+            mask = ((jnp.arange(n_micro) == emit_t)
+                    & (idx == n_stages - 1)).astype(y.dtype)
+            mask = mask.reshape((n_micro,) + (1,) * y.ndim)
+            outs = outs * (1 - mask) + y[None] * mask
+            buf = jax.lax.ppermute(y, axis, perm)
+            return (buf, outs), None
+
+        (_, outs), _ = jax.lax.scan(tick, (buf, outs),
+                                    jnp.arange(n_micro + n_stages - 1))
+        return outs[None]  # [1(stage), M, b/M, ...] — pipe stays sharded
+
+    leading = PartitionSpec(axis)
+    param_specs = jax.tree_util.tree_map(lambda _: leading, stacked_params)
+    piped = jax.shard_map(
+        body, mesh=mesh, axis_names=frozenset({axis}),
+        in_specs=(param_specs, PartitionSpec()),
+        out_specs=PartitionSpec(axis))
+    outs = piped(stacked_params, x)      # [P, M, b/M, ...]
+    final = outs[n_stages - 1]           # last stage's slice
+    return final.reshape(x.shape)
+
+
+def stack_stage_params(per_slot: typing.Sequence[typing.Sequence[dict]],
+                       mesh: Mesh, axis: str = "pipeline"):
+    """[stage][slot] param dicts -> one [P, ...]-stacked dict per slot,
+    keyed by stage 0's names (all stages share shapes by construction),
+    constrained to live sharded over the pipe axis."""
+    n_stages = len(per_slot)
+    slots = len(per_slot[0])
+    out = []
+    for j in range(slots):
+        base = per_slot[0][j]
+        stacked = {}
+        for k in base:
+            v = jnp.stack([per_slot[s][j][k] for s in range(n_stages)])
+            spec = PartitionSpec(axis)
+            stacked[k] = jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, spec))
+        out.append(stacked)
+    return out

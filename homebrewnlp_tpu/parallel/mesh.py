@@ -19,31 +19,35 @@ from ..config import Config
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "sequence_parallel"
+PIPE_AXIS = "pipeline"
 
 
 def axis_sizes(cfg: Config, n_devices: int) -> typing.Dict[str, int]:
     """Resolve mesh axis sizes for ``n_devices``.  ``heads`` bounds the model
     axis; remaining devices fold into data parallelism (reference behavior:
-    b = tpu_size / heads)."""
+    b = tpu_size / heads).  The pipeline axis (GPipe stages, ops/pipeline.py)
+    is exactly ``cfg.pipeline_parallel``."""
     model = cfg.mesh_model
     seq = cfg.sequence_parallel
-    denom = model * seq
+    pipe = cfg.pipeline_parallel
+    denom = model * seq * pipe
     if n_devices % denom:
         # shrink the model axis to the largest divisor that fits
         model = 1
         for cand in range(min(cfg.mesh_model, n_devices), 0, -1):
             # the model axis must also divide the head count or head-sharded
             # parameters cannot be placed on the mesh
-            if n_devices % (cand * seq) == 0 and cfg.heads % cand == 0:
+            if n_devices % (cand * seq * pipe) == 0 and cfg.heads % cand == 0:
                 model = cand
                 break
-        denom = model * seq
+        denom = model * seq * pipe
         if n_devices % denom:
             raise ValueError(
-                f"cannot factor {n_devices} devices into seq={seq}")
+                f"cannot factor {n_devices} devices into seq={seq} pipe={pipe}")
         print(f"WARNING: model axis shrunk from {cfg.mesh_model} to {model} "
-              f"to factor {n_devices} devices (seq={seq})")
-    return {DATA_AXIS: n_devices // denom, SEQ_AXIS: seq, MODEL_AXIS: model}
+              f"to factor {n_devices} devices (seq={seq}, pipe={pipe})")
+    return {DATA_AXIS: n_devices // denom, SEQ_AXIS: seq, PIPE_AXIS: pipe,
+            MODEL_AXIS: model}
 
 
 def make_mesh(cfg: Config,
@@ -59,10 +63,10 @@ def make_mesh(cfg: Config,
                    if batch % d == 0)
         print(f"WARNING: data axis shrunk from {sizes[DATA_AXIS]} to {data} "
               f"(train_batch_size={batch}); "
-              f"{(sizes[DATA_AXIS] - data) * sizes[SEQ_AXIS] * sizes[MODEL_AXIS]}"
+              f"{(sizes[DATA_AXIS] - data) * sizes[SEQ_AXIS] * sizes[PIPE_AXIS] * sizes[MODEL_AXIS]}"
               " device(s) left unused")
         sizes[DATA_AXIS] = data
-    names = (DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+    names = (DATA_AXIS, SEQ_AXIS, PIPE_AXIS, MODEL_AXIS)
     n_used = 1
     for n in names:
         n_used *= sizes[n]

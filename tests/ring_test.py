@@ -100,7 +100,7 @@ def test_dp_tp_sp_mesh_step(eight_devices):
                        block_config=ATTN_BLOCK)
     mesh = make_mesh(cfg)
     assert dict(mesh.shape) == {"data": 2, "sequence_parallel": 2,
-                                "model": 2}
+                                "pipeline": 1, "model": 2}
     trainer = Trainer(cfg, mesh)
     batch = random_text_batch(cfg)
     state = trainer.init(batch)
