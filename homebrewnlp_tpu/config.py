@@ -247,7 +247,8 @@ class Config:
         # only (reversible chains carry custom_vjp state across stages), and
         # v1 excludes the sequence-parallel ring and cross-depth shared
         # weights (their single tensor cannot be stage-stacked).
-        assert self.pipeline_parallel >= 1
+        if self.pipeline_parallel < 1:
+            raise ValueError("pipeline_parallel must be a positive integer")
         if self.pipeline_parallel > 1:
             if self.depth % self.pipeline_parallel:
                 raise ValueError("pipeline_parallel must divide depth")

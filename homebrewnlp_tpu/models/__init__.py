@@ -108,6 +108,18 @@ def _block_scope(i: int, c: int) -> str:
     return f"{DEPTH_TOKEN}{i}_{c}"
 
 
+def _block_param_keys(all_keys, root: str, i: int, c: int,
+                      include_shared: bool = True) -> typing.List[str]:
+    """Param keys of the (depth i, config c) block group.  ``include_shared``
+    adds the cross-depth shared_{c} tensors (reference backend.py:43-94) —
+    the pipelined body excludes them because a single shared tensor cannot be
+    stage-stacked (config validation rejects the combination)."""
+    p1 = f"{root}/{_block_scope(i, c)}/"
+    p2 = f"{root}/shared_{c}/"
+    return sorted(k for k in all_keys
+                  if k.startswith(p1) or (include_shared and k.startswith(p2)))
+
+
 def _body(ctx: Ctx, src: NT) -> NT:
     cfg = ctx.cfg
     with ctx.scope("body"):
@@ -166,9 +178,7 @@ def _body(ctx: Ctx, src: NT) -> NT:
         all_keys = list(ctx.params.keys())
 
         def keys_for(i: int, c: int) -> typing.List[str]:
-            p1 = f"{root}/{_block_scope(i, c)}/"
-            p2 = f"{root}/shared_{c}/"
-            return [k for k in all_keys if k.startswith(p1) or k.startswith(p2)]
+            return _block_param_keys(all_keys, root, i, c)
 
         def make_f(k: int, i: int, c: int, aux_sink=None):
             conf = cfg.block_config[c]
@@ -231,8 +241,7 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
     all_keys = list(ctx.params.keys())
 
     def keys_for(i: int, c: int) -> typing.List[str]:
-        prefix = f"{root}/{_block_scope(i, c)}/"
-        return sorted(k for k in all_keys if k.startswith(prefix))
+        return _block_param_keys(all_keys, root, i, c, include_shared=False)
 
     # per stage s, slot j: the params of group seq[s*g + j], REKEYED to the
     # stage-0 group's names (identical structure by validation)

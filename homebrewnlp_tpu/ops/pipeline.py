@@ -23,6 +23,16 @@ Mechanics (jax >= 0.8 shard_map typing):
 Stage parameters arrive STACKED: a pytree whose leaves have a leading
 ``[P, ...]`` stage axis, sharded over the pipe axis, so each device holds
 exactly its stage's weights inside the manual region.
+
+Known v1 trade-off (documented, not accidental): the flat per-depth param
+dict stays pipe-REPLICATED (sharding rules map no param dim to the pipe
+axis) and the stage-stacked copy is materialized in-graph each step, so
+pipeline parallelism currently buys COMPUTE overlap across stages, not
+per-stage weight residency — each device still holds the full body's params
+and optimizer state, and the stack/unstack costs one body-params-sized
+gather/scatter per step.  True per-stage residency needs the params created
+stage-stacked from init (a naming/checkpoint-format change) — the natural
+next iteration.
 """
 from __future__ import annotations
 

@@ -396,3 +396,43 @@ def test_pipeline_parallel_config_validation():
                     use_video=True, memory_reduction_strategy="none",
                     frame_height=32, frame_width=32, patch_size=16,
                     experts=1))
+
+
+def test_pipeline_parallel_checkpoint_strategy(eight_devices):
+    """The remat branch (memory_reduction_strategy=checkpoint) composes with
+    the pipelined body and still matches the sequential model."""
+    from homebrewnlp_tpu.config import Config
+    from homebrewnlp_tpu.models import build, init_params
+    from homebrewnlp_tpu.models.ctx import Ctx
+    base = dict(model_mode="gpt", use_video=False, sequence_length=16,
+                heads=1, features_per_head=32, vocab_size=64, depth=2,
+                train_batch_size=8, weight_decay=0.0,
+                optimizer="adam-learning_rate", learning_rate=1e-2,
+                calc_accuracy=False,
+                intermediate_feed_forward_multiplier_multiplier=0.5,
+                block_config=[{"layer": ["norm-shift-scale",
+                                         "feed_forward-in:relu"]}])
+    cfg1 = Config(dict(base, memory_reduction_strategy="none"))
+    cfgp = Config(dict(base, memory_reduction_strategy="checkpoint",
+                       pipeline_parallel=2))
+    batch = text_batch(cfg1)
+    params, _ = init_params(cfg1, batch)
+    meshp = make_mesh(cfgp)
+
+    def loss1(p, b):
+        return build(Ctx(cfg1, params=p, train=True,
+                         rng=jax.random.key(0)), b).loss
+
+    def lossp(p, b):
+        return build(Ctx(cfgp, params=p, train=True, rng=jax.random.key(0),
+                         mesh=meshp), b).loss
+
+    l1 = float(jax.jit(loss1)(params, batch))
+    with meshp:
+        lp = float(jax.jit(lossp)(params, batch))
+        gp = jax.jit(jax.grad(lossp))(params, batch)
+    np.testing.assert_allclose(lp, l1, rtol=1e-5)
+    g1 = jax.jit(jax.grad(loss1))(params, batch)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(g1[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
