@@ -299,3 +299,15 @@ def test_cli_debug_text_similarity(tmp_path, capsys):
         model_path=str(tmp_path / "run"))))
     main(["--model", str(cfg_path), "--run_mode", "debug"])
     assert "similarity: 100.00%" in capsys.readouterr().out
+
+
+def test_repl_smoke(cfg_params, monkeypatch, capsys):
+    """The interactive query REPL completes a prompt and exits on EOF."""
+    from homebrewnlp_tpu.serve import repl
+    cfg, params = cfg_params
+    feeds = iter(["ab"])
+    monkeypatch.setattr("builtins.input",
+                        lambda *a: next(feeds, None) or (_ for _ in ()).throw(EOFError()))
+    repl(cfg, params)
+    out = capsys.readouterr().out
+    assert out  # printed a completion before EOF ended the loop
