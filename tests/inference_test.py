@@ -305,9 +305,15 @@ def test_repl_smoke(cfg_params, monkeypatch, capsys):
     """The interactive query REPL completes a prompt and exits on EOF."""
     from homebrewnlp_tpu.serve import repl
     cfg, params = cfg_params
-    feeds = iter(["ab"])
-    monkeypatch.setattr("builtins.input",
-                        lambda *a: next(feeds, None) or (_ for _ in ()).throw(EOFError()))
+    feeds = ["ab"]
+
+    def fake_input(*_):
+        if feeds:
+            return feeds.pop(0)
+        raise EOFError
+
+    monkeypatch.setattr("builtins.input", fake_input)
     repl(cfg, params)
     out = capsys.readouterr().out
-    assert out  # printed a completion before EOF ended the loop
+    # more than the banner: a completion line was actually printed
+    assert len([l for l in out.splitlines() if l.strip()]) >= 2
