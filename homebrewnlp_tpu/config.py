@@ -249,6 +249,9 @@ class Config:
         # weights (their single tensor cannot be stage-stacked).
         if self.pipeline_parallel < 1:
             raise ValueError("pipeline_parallel must be a positive integer")
+        body_specs = [spec for blk in self.block_config
+                      for spec in (blk["layer"] if isinstance(blk, dict)
+                                   else blk.layer)]
         if self.pipeline_parallel > 1:
             if self.depth % self.pipeline_parallel:
                 raise ValueError("pipeline_parallel must divide depth")
@@ -265,17 +268,29 @@ class Config:
                     "pipeline_parallel supports text (gpt) models only: the "
                     "multi-axis attention rotation depends on the global "
                     "depth index, which is dynamic inside a pipeline stage")
-            specs = [spec for blk in self.block_config
-                     for spec in (blk["layer"] if isinstance(blk, dict)
-                                  else blk.layer)]
-            if any("shared" in s.split("-") for s in specs):
+            if any("shared" in s.split("-") for s in body_specs):
                 raise ValueError(
                     "pipeline_parallel cannot stage-stack cross-depth "
                     "'shared' weights")
-            if any(s.split("-")[0] == "routed_moe" for s in specs):
+            if any(s.split("-")[0] == "routed_moe" for s in body_specs):
                 raise ValueError(
                     "pipeline_parallel cannot carry the routed_moe balance "
                     "aux loss across the pipeline shard_map boundary")
+        # routed_moe's load-balance aux loss cannot cross the reversible
+        # custom_vjp boundary (models/__init__.py _body); 'none' collects it
+        # directly and 'checkpoint' threads it through jax.checkpoint as a
+        # real output, but revnet/momentum would silently drop it — reject
+        # rather than train with different semantics than the config names.
+        if self.moe_balance_weight > 0 and self.memory_reduction_strategy in (
+                "revnet", "momentum"):
+            if any(s.split("-")[0] == "routed_moe" for s in body_specs):
+                raise ValueError(
+                    f"routed_moe with moe_balance_weight > 0 cannot combine "
+                    f"with memory_reduction_strategy="
+                    f"'{self.memory_reduction_strategy}': the balance aux "
+                    f"loss cannot cross the reversible custom_vjp boundary. "
+                    f"Use 'none' or 'checkpoint', or set "
+                    f"moe_balance_weight=0 to train without the balance term")
         if self.weight_standardisation and not self.weight_centralisation:
             self.weight_centralisation = True
         if self.features is None and self.features_per_head is None:

@@ -180,44 +180,50 @@ def _body(ctx: Ctx, src: NT) -> NT:
         def keys_for(i: int, c: int) -> typing.List[str]:
             return _block_param_keys(all_keys, root, i, c)
 
-        def make_f(k: int, i: int, c: int, aux_sink=None):
+        def make_f(k: int, i: int, c: int, with_aux: bool = False):
             conf = cfg.block_config[c]
             a_start = attn_starts[k]
             rng = None if ctx.rng is None else jax.random.fold_in(ctx.rng, 1000 + k)
 
-            def f(subparams: dict, x: NT) -> NT:
+            def f(subparams: dict, x: NT):
                 bctx = Ctx(cfg, params=subparams, train=ctx.train, seed=ctx.seed,
                            rng=rng, mesh=ctx.mesh)
                 bctx._scope = [mode_scope, "body"]
                 bctx.attention_idx = a_start
                 with bctx.scope(_block_scope(i, c)):
                     out = block_part_fn(bctx, conf, x)
-                if aux_sink is not None:
-                    # only safe when f is NOT wrapped in custom_vjp /
-                    # jax.checkpoint (tracers may not cross those boundaries)
-                    aux_sink.extend(bctx.aux_losses)
+                if with_aux:
+                    # aux losses (routed-MoE balance term) returned as real
+                    # outputs so they cross jax.checkpoint with gradients
+                    # intact; the per-block count is static (set by the
+                    # block's layer specs), so the pytree structure is stable
+                    return out, tuple(bctx.aux_losses)
                 return out
 
             return f
 
-        sink = ctx.aux_losses if strategy == "none" else None
-        fs = [make_f(k, i, c, aux_sink=sink) for k, (i, c) in enumerate(seq)]
-        subparams = tuple({k: ctx.params[k] for k in keys_for(i, c)} for i, c in seq)
         ctx.attention_idx = acc
+        subparams = tuple({k: ctx.params[k] for k in keys_for(i, c)} for i, c in seq)
 
         if strategy in ("revnet", "momentum"):
+            # aux losses cannot cross the reversible custom_vjp boundary;
+            # config validation rejects routed_moe here when
+            # moe_balance_weight > 0 (config.py)
+            fs = [make_f(k, i, c) for k, (i, c) in enumerate(seq)]
             chain = make_reversible_chain(fs, mode=strategy, alpha=cfg.momentumnet_alpha)
             if strategy == "revnet":
                 y1, y2 = chain(subparams, src, src)
             else:
                 y1, y2 = chain(subparams, src, nd.zeros_like(src))
             return y1 + y2
+        fs = [make_f(k, i, c, with_aux=True) for k, (i, c) in enumerate(seq)]
         out = src
         for f, p in zip(fs, subparams):
             if strategy == "checkpoint":
-                out = jax.checkpoint(f)(p, out)
+                out, aux = jax.checkpoint(f)(p, out)
             else:
-                out = f(p, out)
+                out, aux = f(p, out)
+            ctx.aux_losses.extend(aux)
         return out
 
 

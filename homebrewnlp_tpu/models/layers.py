@@ -159,10 +159,12 @@ def routed_mixture_of_experts(args: Args) -> NT:
 
     A Switch-style load-balance auxiliary loss (E * sum_e f_e*P_e per group,
     scaled by ``cfg.moe_balance_weight``) is collected via ``ctx.aux_losses``
-    and added to the first loss term.  Inside reversible/checkpointed bodies
-    the side channel cannot cross the custom_vjp boundary, so the balance
-    term is only active under ``memory_reduction_strategy="none"`` (and in
-    input/output blocks) — documented limitation."""
+    and added to the first loss term.  Under ``memory_reduction_strategy``
+    "none" it is collected directly; under "checkpoint" it is threaded
+    through ``jax.checkpoint`` as a real block output.  The reversible
+    strategies (revnet/momentum) cannot carry it across their custom_vjp
+    boundary, so config validation rejects that combination whenever
+    ``moe_balance_weight > 0`` (config.py)."""
     from ..parallel.sharding import constraint
     cfg = args.cfg
     ctx = args.ctx

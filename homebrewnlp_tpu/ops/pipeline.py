@@ -67,15 +67,17 @@ def gpipe(stage_fn: typing.Callable, stacked_params, x: jnp.ndarray,
 
         def tick(carry, t):
             buf, outs = carry
-            inject = ((idx == 0) & (t < n_micro)).astype(buf.dtype)
-            feed = (inject * micro[jnp.minimum(t, n_micro - 1)]
-                    + (1 - inject) * buf)
+            # boolean where-selects, not arithmetic masking: warm-up/drain
+            # ticks compute on zero or stale rotated activations, and a
+            # non-finite garbage y would poison real lanes via NaN*0=NaN
+            inject = (idx == 0) & (t < n_micro)
+            feed = jnp.where(inject, micro[jnp.minimum(t, n_micro - 1)], buf)
             y = stage_fn(params, idx, feed)
             emit_t = t - (n_stages - 1)
             mask = ((jnp.arange(n_micro) == emit_t)
-                    & (idx == n_stages - 1)).astype(y.dtype)
+                    & (idx == n_stages - 1))
             mask = mask.reshape((n_micro,) + (1,) * y.ndim)
-            outs = outs * (1 - mask) + y[None] * mask
+            outs = jnp.where(mask, y[None], outs)
             buf = jax.lax.ppermute(y, axis, perm)
             return (buf, outs), None
 

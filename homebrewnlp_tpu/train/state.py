@@ -217,7 +217,9 @@ class Trainer:
             # separate, so calling the jit fn would compile a second time)
             try:
                 return self._compiled(state, batch, rng)
-            except TypeError:  # shapes/dtypes changed since the AOT compile
+            except (TypeError, ValueError):
+                # shapes/dtypes/shardings changed since the AOT compile —
+                # the exact exception type varies by jax version
                 self._compiled = None
         with self.mesh:
             return self._step_fn(state, batch, rng)

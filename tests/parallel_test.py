@@ -316,6 +316,52 @@ def test_routed_moe_balance_loss_collected(eight_devices):
     assert 0.1 < delta < 1.5, delta
 
 
+def test_routed_moe_balance_loss_under_checkpoint(eight_devices):
+    """The balance aux loss threads through jax.checkpoint as a real block
+    output: same total loss as strategy 'none', and its gradient reaches the
+    router weights."""
+    from homebrewnlp_tpu.models import build, init_params
+    from homebrewnlp_tpu.models.ctx import Ctx
+    cfg_none = _routed_cfg(moe_balance_weight=0.5)
+    cfg_ckpt = _routed_cfg(moe_balance_weight=0.5,
+                           memory_reduction_strategy="checkpoint")
+    batch = text_batch(cfg_none)
+    params, _ = init_params(cfg_none, batch)
+
+    def loss_fn(cfg):
+        def f(p):
+            return build(Ctx(cfg, params=p, train=True,
+                             rng=jax.random.key(0)), batch).loss
+        return f
+
+    l_none = float(jax.jit(loss_fn(cfg_none))(params))
+    l_ckpt = float(jax.jit(loss_fn(cfg_ckpt))(params))
+    np.testing.assert_allclose(l_ckpt, l_none, rtol=1e-5)
+
+    g_none = jax.jit(jax.grad(loss_fn(cfg_none)))(params)
+    g_ckpt = jax.jit(jax.grad(loss_fn(cfg_ckpt)))(params)
+    router = [k for k in params if "router" in k]
+    assert router, sorted(params)
+    for k in g_none:
+        np.testing.assert_allclose(np.asarray(g_ckpt[k]),
+                                   np.asarray(g_none[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+    assert any(float(np.abs(np.asarray(g_ckpt[k])).max()) > 0
+               for k in router)
+
+
+def test_routed_moe_rejects_reversible_strategies():
+    """revnet/momentum would silently drop the balance aux loss — the config
+    must reject the combination unless the weight is zero."""
+    for strategy in ("revnet", "momentum"):
+        with pytest.raises(ValueError, match="custom_vjp"):
+            _routed_cfg(memory_reduction_strategy=strategy, depth=2)
+    # weight 0: nothing to drop, combination allowed
+    cfg = _routed_cfg(memory_reduction_strategy="revnet", depth=2,
+                      moe_balance_weight=0.0)
+    assert cfg.memory_reduction_strategy == "revnet"
+
+
 def test_pipeline_parallel_parity_and_training(eight_devices):
     """GPipe pipelined body (pipeline_parallel=4 on a data x pipe mesh) must
     match the sequential body exactly — same flat params, same loss, same
