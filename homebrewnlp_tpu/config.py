@@ -31,6 +31,9 @@ EXPERTS = "experts"
 ROUTED_EXPERTS = "routed_experts"
 PKM_AXES = "pkm_axes"
 PKM_VALUES = "product_key_value_dim"
+# leading axis of stage-stacked pipeline-parallel body parameters; maps to
+# the pipeline mesh axis so each device holds only its stage's weights
+PIPE_STAGE = "pipe_stage"
 
 ANON_PREFIX = "_"
 

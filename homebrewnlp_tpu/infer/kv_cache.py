@@ -9,11 +9,12 @@ attention, cumsum/cummean, convolution and transpose_sequence_features carry
 different cross-position state and keep the rebuild-everything sampler
 (infer/sampler.py).
 
-The cached sampler runs one model call per position on a length-1 row:
-attention layers write the row's K/V into per-layer caches
-(models/layers.py::_cached_attention) and attend over the cached prefix, so a
-full sample costs O(seq) length-1 forwards instead of O(seq) full-length
-forwards.  Greedy (temperature 0) token outputs match the rebuild sampler:
+The cached sampler PREFILLS the prompt with one full-length forward that
+writes every prompt position's K/V at once, then runs one model call per
+generated position on a length-1 row: attention layers write the row's K/V
+into per-layer caches (models/layers.py::_cached_attention) and attend over
+the cached prefix, so a full sample costs one full forward plus
+O(generated) length-1 forwards instead of O(seq) full-length forwards.  Greedy (temperature 0) token outputs match the rebuild sampler:
 both paths compute the same math, differing only in XLA fusion order, so
 logits agree to float-rounding (measured <= 4e-3 absolute at seq 512 with
 random weights, argmax identical at every teacher-forced position); a
@@ -113,6 +114,19 @@ def make_cached_text_sampler(cfg: Config, params: dict):
         seq = toks.shape[seq_axis]
         end = jnp.int32(seq) if end_iterations is None else end_iterations
         caches = init_caches(cfg, params, toks.shape[0], seq)
+        # PREFILL: one full-length forward writes every position's K/V in a
+        # single pass, so the incremental loop below starts at the end of the
+        # prompt instead of decoding it token by token.  Rows past the prompt
+        # hold padding K/V, but each is rewritten by the loop at its own
+        # position before any later query can see it causally.  An empty
+        # prompt (initial_pos 0) has nothing to prefill — the loop generates
+        # every row anyway, so skip the full-length forward entirely.
+        caches = jax.lax.cond(
+            jnp.int32(initial_pos) > 0,
+            lambda c: _decode_logits(cfg, params, toks, jnp.int32(0), c,
+                                     seq, names)[1],
+            lambda c: c, caches)
+        start = jnp.maximum(jnp.int32(initial_pos) - 1, 0)
 
         def body(carry):
             pos, toks, caches, key = carry
@@ -137,7 +151,7 @@ def make_cached_text_sampler(cfg: Config, params: dict):
             return pos < end - 1
 
         _, out, _, _ = jax.lax.while_loop(
-            cond, body, (jnp.int32(0), toks, caches, rng))
+            cond, body, (start, toks, caches, rng))
         return out
 
     return jax.jit(fn)

@@ -196,9 +196,15 @@ def _params_for_serving(cfg):
         from .train import Checkpointer, Trainer
         state = Trainer(cfg).init(batch)
         state, _ = Checkpointer(os.path.join(cfg.model_path, "ckpt")).restore(state)
-        return state.params
-    from .models import init_params
-    params, _ = init_params(cfg, batch)
+        params = state.params
+    else:
+        from .models import init_params
+        params, _ = init_params(cfg, batch)
+    from .models import pipeline_params_stacked, unstack_pipeline_params
+    if pipeline_params_stacked(cfg, params):
+        # pipeline-trained checkpoints store body params stage-stacked;
+        # every serving/sampling consumer runs the plain sequential chain
+        params = unstack_pipeline_params(cfg, params)
     return params
 
 

@@ -234,8 +234,13 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
     via ppermute.  Config validation guarantees stage homogeneity (P divides
     depth, no cross-depth shared weights) so one stage function — scoped with
     stage 0's parameter names — serves every stage with its own stacked
-    weights."""
-    from ..ops.pipeline import gpipe, stack_stage_params
+    weights.
+
+    Parameters arrive STAGE-STACKED (``stack_pipeline_params``): the flat
+    dict holds one ``[P, ...]`` leaf per stage-0 group key, sharded over the
+    pipeline mesh axis, so each device holds only its own stage's weights —
+    and optimizer state — with no per-step gather."""
+    from ..ops.pipeline import gpipe
     from ..parallel.mesh import PIPE_AXIS
     cfg = ctx.cfg
     n_stages = cfg.pipeline_parallel
@@ -245,24 +250,16 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
     mode_scope = ctx._scope[0]
     root = f"{mode_scope}/body"
     all_keys = list(ctx.params.keys())
-
-    def keys_for(i: int, c: int) -> typing.List[str]:
-        return _block_param_keys(all_keys, root, i, c, include_shared=False)
-
-    # per stage s, slot j: the params of group seq[s*g + j], REKEYED to the
-    # stage-0 group's names (identical structure by validation)
-    per_slot = []
-    for s in range(n_stages):
-        slots = []
-        for j in range(g):
-            i, c = seq[s * g + j]
-            i0, c0 = seq[j]
-            frm = f"/{_block_scope(i, c)}/"
-            to = f"/{_block_scope(i0, c0)}/"
-            slots.append({k.replace(frm, to): ctx.params[k]
-                          for k in keys_for(i, c)})
-        per_slot.append(slots)
-    stacked = stack_stage_params(per_slot, ctx.mesh, PIPE_AXIS)
+    if not pipeline_params_stacked(cfg, ctx.params):
+        raise ValueError(
+            "pipelined body expects stage-stacked parameters "
+            "(models.stack_pipeline_params) but found per-depth keys for "
+            f"stage-1 group {_block_scope(*seq[g])!r}")
+    stacked = []
+    for j in range(g):
+        i0, c0 = seq[j]
+        keys = _block_param_keys(all_keys, root, i0, c0, include_shared=False)
+        stacked.append({k: ctx.params[k] for k in keys})
 
     names = src.names
     rng = ctx.rng
@@ -419,6 +416,87 @@ def build(ctx: Ctx, batch: typing.Dict[str, NT]) -> ModelOutput:
         total = total + l
     return ModelOutput(total, tuple(loss_list), video_loss, acc, token_loss,
                        frame_out, token_out)
+
+
+def _pipeline_seq(cfg: Config):
+    """(depth, block-config) group order + stage slot count for the
+    pipelined body's stage-stacked parameter layout."""
+    seq = [(i, c) for i in range(cfg.depth) for c in range(len(cfg.block_config))]
+    assert len(seq) % cfg.pipeline_parallel == 0
+    return seq, len(seq) // cfg.pipeline_parallel
+
+
+def pipeline_params_stacked(cfg: Config, params) -> bool:
+    """True when ``params`` carry the stage-stacked pipeline layout (no
+    per-depth keys for stage-1's first block group)."""
+    if cfg.pipeline_parallel <= 1:
+        return False
+    seq, g = _pipeline_seq(cfg)
+    probe = f"{cfg.model_mode}/body/{_block_scope(*seq[g])}/"
+    return not any(k.startswith(probe) for k in params)
+
+
+def stack_pipeline_params(cfg: Config, params, axes=None):
+    """Flat per-depth params -> the stage-stacked pipeline layout.
+
+    Body block groups are cut into ``cfg.pipeline_parallel`` contiguous
+    stages; each stage-0 group key keeps its name but its leaf becomes
+    ``[P, ...]`` (stage s's slice = the corresponding group of stage s), and
+    the other stages' per-depth keys disappear.  With ``axes`` metadata the
+    new leaves gain a leading ``PIPE_STAGE`` axis name, which the sharding
+    rules map to the pipeline mesh axis — params AND optimizer slots then
+    live 1/P-sharded per device with no per-step gather (the residency the
+    reference's model parallelism never had; our PP extension, SURVEY.md
+    §2.12).  Returns ``params`` or ``(params, axes)`` matching the input."""
+    from ..config import PIPE_STAGE
+    seq, g = _pipeline_seq(cfg)
+    P = cfg.pipeline_parallel
+    root = f"{cfg.model_mode}/body"
+    all_keys = list(params.keys())
+    out = dict(params)
+    new_axes = None if axes is None else dict(axes)
+    for j in range(g):
+        i0, c0 = seq[j]
+        for k in _block_param_keys(all_keys, root, i0, c0, include_shared=False):
+            parts = []
+            for s in range(P):
+                i, c = seq[s * g + j]
+                src = k.replace(f"/{_block_scope(i0, c0)}/",
+                                f"/{_block_scope(i, c)}/")
+                parts.append(params[src])
+                if s > 0:
+                    del out[src]
+                    if new_axes is not None:
+                        del new_axes[src]
+            out[k] = jnp.stack(parts)
+            if new_axes is not None:
+                new_axes[k] = (PIPE_STAGE,) + tuple(new_axes[k])
+    return out if axes is None else (out, new_axes)
+
+
+def unstack_pipeline_params(cfg: Config, params, axes=None):
+    """Inverse of :func:`stack_pipeline_params`: recover the flat per-depth
+    layout (used by inference/decode, which runs the plain chain)."""
+    seq, g = _pipeline_seq(cfg)
+    P = cfg.pipeline_parallel
+    root = f"{cfg.model_mode}/body"
+    all_keys = list(params.keys())
+    out = dict(params)
+    new_axes = None if axes is None else dict(axes)
+    for j in range(g):
+        i0, c0 = seq[j]
+        for k in _block_param_keys(all_keys, root, i0, c0, include_shared=False):
+            v = out.pop(k)
+            assert v.shape[0] == P, (k, v.shape, P)
+            base = None if new_axes is None else tuple(new_axes.pop(k))[1:]
+            for s in range(P):
+                i, c = seq[s * g + j]
+                dst = k.replace(f"/{_block_scope(i0, c0)}/",
+                                f"/{_block_scope(i, c)}/")
+                out[dst] = v[s]
+                if new_axes is not None:
+                    new_axes[dst] = base
+    return out if axes is None else (out, new_axes)
 
 
 def init_params(cfg: Config, batch: typing.Dict[str, NT], seed: int = 0

@@ -410,9 +410,11 @@ def _qkv(args: Args, base: typing.Optional[Args], dim: str
             full = dc.seq if dc is not None else t.dim_size(dim)
             pos = embed(args, [(dim, full)] + fdims)
             if dc is not None:
+                # slice the current row(s): width 1 for incremental decode,
+                # the whole prompt for the prefill pass
                 ax = pos.names.index(dim)
-                pos = NT(jax.lax.dynamic_slice_in_dim(pos.x, dc.pos, 1, ax),
-                         pos.names)
+                pos = NT(jax.lax.dynamic_slice_in_dim(
+                    pos.x, dc.pos, t.dim_size(dim), ax), pos.names)
             key = pos if key is None else key + pos
         scale = (dc.seq if dc is not None else t.dim_size(dim)) ** -0.5
         qry = activated_linear_out(base) * scale
@@ -426,12 +428,14 @@ def _qkv(args: Args, base: typing.Optional[Args], dim: str
 
 
 def _cached_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
-    """KV-cache incremental decode (the fast path the reference lacks,
-    SURVEY.md §7 item 7): the layer sees ONE row at absolute position
-    ``ctx.decode.pos``; its K/V are written into the layer's cache and the
-    dot-product runs against the cached prefix.  Greedy outputs match the
-    rebuild-everything sampler because every logit depends only on causally
-    visible positions."""
+    """KV-cache decode (the fast path the reference lacks, SURVEY.md §7
+    item 7): the layer sees ``R`` rows starting at absolute position
+    ``ctx.decode.pos`` — R=1 for incremental decode, R=prompt length for the
+    prefill pass that writes the whole prompt's K/V in one forward.  The
+    rows' K/V are written into the layer's cache and the dot-product runs
+    against the cached prefix under a per-row causal mask.  Greedy outputs
+    match the rebuild-everything sampler because every logit depends only on
+    causally visible positions."""
     ctx = args.ctx
     cfg = args.cfg
     dc = ctx.decode
@@ -457,9 +461,11 @@ def _cached_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
     kn = NT(k_cache, (batch_axis, tmp, HEADS, KEY))
     logit = nd.einsum([qry.transpose_to(order), kn],
                       (batch_axis, dim, HEADS, tmp))
-    # causal mask: cached positions beyond `pos` are invisible
-    vis = (jnp.arange(dc.seq) <= dc.pos).astype(cdtype)
-    logit = logit + NT((1 - vis) * jnp.asarray(-2e38, cdtype), (tmp,))
+    # per-row causal mask: query row r (absolute position pos+r) sees cached
+    # positions <= pos+r only
+    q_abs = dc.pos + jnp.arange(k_cur.shape[1])
+    vis = (jnp.arange(dc.seq)[None, :] <= q_abs[:, None]).astype(cdtype)
+    logit = logit + NT((1 - vis) * jnp.asarray(-2e38, cdtype), (dim, tmp))
     logit = logit - nd.stop_gradient(nd.reduce_max(logit, reduced=[tmp]))
     logit = NT(jnp.exp(logit.x), logit.names)
     logit = logit / nd.reduce_sum(logit, reduced=[tmp])

@@ -22,17 +22,11 @@ Mechanics (jax >= 0.8 shard_map typing):
 
 Stage parameters arrive STACKED: a pytree whose leaves have a leading
 ``[P, ...]`` stage axis, sharded over the pipe axis, so each device holds
-exactly its stage's weights inside the manual region.
-
-Known v1 trade-off (documented, not accidental): the flat per-depth param
-dict stays pipe-REPLICATED (sharding rules map no param dim to the pipe
-axis) and the stage-stacked copy is materialized in-graph each step, so
-pipeline parallelism currently buys COMPUTE overlap across stages, not
-per-stage weight residency — each device still holds the full body's params
-and optimizer state, and the stack/unstack costs one body-params-sized
-gather/scatter per step.  True per-stage residency needs the params created
-stage-stacked from init (a naming/checkpoint-format change) — the natural
-next iteration.
+exactly its stage's weights inside the manual region.  Since round 3 the
+body's parameters are CREATED stage-stacked (models.stack_pipeline_params,
+applied at Trainer.init) and their optimizer slots are sharded the same way,
+so per-device body param + optimizer memory is 1/P and there is no per-step
+stack/gather — true per-stage weight residency, not just compute overlap.
 """
 from __future__ import annotations
 
@@ -40,7 +34,7 @@ import typing
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, PartitionSpec
 
 
 def gpipe(stage_fn: typing.Callable, stacked_params, x: jnp.ndarray,
@@ -58,9 +52,9 @@ def gpipe(stage_fn: typing.Callable, stacked_params, x: jnp.ndarray,
     def body(params, xs):
         params = jax.tree_util.tree_map(lambda p: p[0], params)
         idx = jax.lax.axis_index(axis)
-        micro = jax.lax.pvary(
+        micro = jax.lax.pcast(
             xs.reshape((n_micro, xs.shape[0] // n_micro) + xs.shape[1:]),
-            (axis,))
+            (axis,), to="varying")
         buf = jnp.zeros_like(micro[0])
         outs = jnp.zeros_like(micro)
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -94,23 +88,3 @@ def gpipe(stage_fn: typing.Callable, stacked_params, x: jnp.ndarray,
     outs = piped(stacked_params, x)      # [P, M, b/M, ...]
     final = outs[n_stages - 1]           # last stage's slice
     return final.reshape(x.shape)
-
-
-def stack_stage_params(per_slot: typing.Sequence[typing.Sequence[dict]],
-                       mesh: Mesh, axis: str = "pipeline"):
-    """[stage][slot] param dicts -> one [P, ...]-stacked dict per slot,
-    keyed by stage 0's names (all stages share shapes by construction),
-    constrained to live sharded over the pipe axis."""
-    n_stages = len(per_slot)
-    slots = len(per_slot[0])
-    out = []
-    for j in range(slots):
-        base = per_slot[0][j]
-        stacked = {}
-        for k in base:
-            v = jnp.stack([per_slot[s][j][k] for s in range(n_stages)])
-            spec = PartitionSpec(axis)
-            stacked[k] = jax.lax.with_sharding_constraint(
-                v, NamedSharding(mesh, spec))
-        out.append(stacked)
-    return out

@@ -20,9 +20,10 @@ from __future__ import annotations
 
 import typing
 
+import jax
 import jax.numpy as jnp
 
-from ..config import Config
+from ..config import PIPE_STAGE, Config
 from ..ops.init import feature_dims_used
 from .multiloss import STRATEGIES
 from .schedule import learning_rate as learning_rate_fn
@@ -67,30 +68,49 @@ class Optimizer:
         self.spec = cfg.optimizer
 
     # -- state ---------------------------------------------------------------
+    def _is_stacked(self, name: str) -> bool:
+        """Stage-stacked pipeline-parallel variable (models.
+        stack_pipeline_params): leading [P] axis over the pipeline mesh axis.
+        The DSL chain runs per STAGE (vmapped over the leading axis) so
+        per-tensor reductions — novograd/sm3 moments, AGC and l2 clip norms,
+        centralisation means, graft magnitudes, weight standardisation —
+        keep the exact semantics of the unstacked per-depth layout."""
+        ax = self.axes.get(name, ())
+        return len(ax) > 0 and ax[0] == PIPE_STAGE
+
     def init(self, params: Params) -> OptState:
         dtype = self.cfg.optimizer_slice_dtype
         state: OptState = {}
         for name, value in params.items():
-            shapes = chain_slot_shapes(self.spec, value.shape)
-            state[name] = {k: jnp.zeros(s, dtype) for k, s in shapes.items()}
+            if self._is_stacked(name):
+                shapes = chain_slot_shapes(self.spec, value.shape[1:])
+                state[name] = {k: jnp.zeros((value.shape[0],) + s, dtype)
+                               for k, s in shapes.items()}
+            else:
+                shapes = chain_slot_shapes(self.spec, value.shape)
+                state[name] = {k: jnp.zeros(s, dtype) for k, s in shapes.items()}
         return state
 
     def slot_axis_names(self) -> typing.Dict[str, typing.Dict[str, typing.Tuple[str, ...]]]:
         """Axis names for every slot (for sharding): full-shape slots inherit
         the variable's axes; per-dim sm3 buffers keep that one axis; scalar
-        slots get ()."""
+        slots get ().  Stage-stacked variables' slots all carry the leading
+        PIPE_STAGE axis (they are vmapped per stage)."""
         out: typing.Dict[str, typing.Dict[str, typing.Tuple[str, ...]]] = {}
         for name, axis_names in self.axes.items():
-            shapes = chain_slot_shapes(self.spec, [1] * len(axis_names))
+            stacked = self._is_stacked(name)
+            per_stage = axis_names[1:] if stacked else axis_names
+            shapes = chain_slot_shapes(self.spec, [1] * len(per_stage))
             slot_axes = {}
             for k, shape in shapes.items():
                 leaf = k.rsplit("/", 1)[-1]
                 if leaf.startswith("dim") and leaf[3:].isdigit():
-                    slot_axes[k] = (axis_names[int(leaf[3:])],)
-                elif len(shape) == len(axis_names):
-                    slot_axes[k] = tuple(axis_names)
+                    ax = (per_stage[int(leaf[3:])],)
+                elif len(shape) == len(per_stage):
+                    ax = tuple(per_stage)
                 else:
-                    slot_axes[k] = tuple(axis_names[:len(shape)])
+                    ax = tuple(per_stage[:len(shape)])
+                slot_axes[k] = ((PIPE_STAGE,) + ax) if stacked else ax
             out[name] = slot_axes
         return out
 
@@ -116,36 +136,49 @@ class Optimizer:
         new_params: Params = {}
         new_state: OptState = {}
         for name, value in params.items():
-            grad = grads[name].astype(cdtype)
-            val = value.astype(cdtype)
-            ctx = VarCtx(grad=grad, value=val, lr=lr,
-                         beta1=cfg.opt_beta1, beta2=cfg.opt_beta2,
-                         step_count=step_count,
-                         global_norm_reciprocal=global_norm_recip)
-            slots = {k: v.astype(cdtype) for k, v in state[name].items()}
-            out, slots = apply_chain(self.spec, ctx, slots)
-            if "rezero" in name:
-                out = out * cfg.rezero_lr_multiplier
-            large = is_large_tensor(
-                name, self.axes.get(name, ()), int(value.size), cfg)
-            if cfg.weight_decay > 0 and large:
-                out = out + val * (lr.astype(cdtype) * cfg.weight_decay)
-            new = val - out
-            if cfg.weight_standardisation and large:
-                # standardize large weights after each update: remove the mean
-                # and restore the pre-centering norm, keeping the weight on the
-                # same sphere while preventing mean drift.  The reference
-                # declares this knob (dataclass.py:49) and its implication of
-                # weight_centralisation (dataclass.py:218) but never consumes
-                # it; here it is honored.
-                centered = new - jnp.mean(new)
-                norm = jnp.sqrt(jnp.sum(jnp.square(new)))
-                cnorm = jnp.sqrt(jnp.maximum(
-                    jnp.sum(jnp.square(centered)), jnp.asarray(1e-12, cdtype)))
-                new = centered * (norm / cnorm)
-            new_params[name] = new.astype(value.dtype)
-            new_state[name] = {k: v.astype(cfg.optimizer_slice_dtype)
-                               for k, v in slots.items()}
+            stacked = self._is_stacked(name)
+            axis_names = self.axes.get(name, ())
+            per_stage_axes = axis_names[1:] if stacked else axis_names
+            size = int(value.size) // (value.shape[0] if stacked else 1)
+            large = is_large_tensor(name, per_stage_axes, size, cfg)
+            rezero = "rezero" in name
+
+            def one(value, grad, raw_slots):
+                """Per-(stage-)tensor chain + decay + standardisation, so
+                per-tensor reductions see one stage's weights at a time."""
+                grad = grad.astype(cdtype)
+                val = value.astype(cdtype)
+                ctx = VarCtx(grad=grad, value=val, lr=lr,
+                             beta1=cfg.opt_beta1, beta2=cfg.opt_beta2,
+                             step_count=step_count,
+                             global_norm_reciprocal=global_norm_recip)
+                slots = {k: v.astype(cdtype) for k, v in raw_slots.items()}
+                out, slots = apply_chain(self.spec, ctx, slots)
+                if rezero:
+                    out = out * cfg.rezero_lr_multiplier
+                if cfg.weight_decay > 0 and large:
+                    out = out + val * (lr.astype(cdtype) * cfg.weight_decay)
+                new = val - out
+                if cfg.weight_standardisation and large:
+                    # standardize large weights after each update: remove the
+                    # mean and restore the pre-centering norm, keeping the
+                    # weight on the same sphere while preventing mean drift.
+                    # The reference declares this knob (dataclass.py:49) and
+                    # its implication of weight_centralisation
+                    # (dataclass.py:218) but never consumes it; honored here.
+                    centered = new - jnp.mean(new)
+                    norm = jnp.sqrt(jnp.sum(jnp.square(new)))
+                    cnorm = jnp.sqrt(jnp.maximum(
+                        jnp.sum(jnp.square(centered)),
+                        jnp.asarray(1e-12, cdtype)))
+                    new = centered * (norm / cnorm)
+                return new.astype(value.dtype), {
+                    k: v.astype(cfg.optimizer_slice_dtype)
+                    for k, v in slots.items()}
+
+            fn = jax.vmap(one) if stacked else one
+            new_params[name], new_state[name] = fn(
+                value, grads[name], state[name])
         return new_params, new_state, lr
 
     # -- multi-loss ----------------------------------------------------------

@@ -14,9 +14,10 @@ import typing
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..config import ANON_PREFIX, BATCH, EXPERTS, HEADS, ROUTED_EXPERTS, SEQUENCE
+from ..config import (ANON_PREFIX, BATCH, EXPERTS, HEADS, PIPE_STAGE,
+                      ROUTED_EXPERTS, SEQUENCE)
 from ..nd import NT
-from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from .mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
 
 # logical axis -> mesh axis.  Everything else is replicated — the reference
 # layout splits only batch and heads (SURVEY.md §2.12); the experts mappings
@@ -31,6 +32,7 @@ RULES: typing.Dict[str, str] = {
     SEQUENCE: SEQ_AXIS,
     EXPERTS: MODEL_AXIS,
     ROUTED_EXPERTS: DATA_AXIS,
+    PIPE_STAGE: PIPE_AXIS,
 }
 
 

@@ -66,6 +66,11 @@ class CompletionEngine:
         production rebuild path, reference interface.py:283-302)."""
         from ..infer.kv_cache import cache_eligible, make_cached_text_sampler
         self.cfg = cfg
+        from ..models import pipeline_params_stacked, unstack_pipeline_params
+        if pipeline_params_stacked(cfg, params):
+            # pipeline-trained checkpoints store body params stage-stacked;
+            # decode runs the plain sequential chain, so flatten once here
+            params = unstack_pipeline_params(cfg, params)
         self.params = params
         self.tokenizer = tokenizer_for(cfg)
         # prompt completion is inherently autoregressive: the engine always

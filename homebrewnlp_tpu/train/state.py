@@ -47,6 +47,12 @@ class Trainer:
         optimizer state."""
         micro = self._micro_batch(batch)
         params, axes = init_params(self.cfg, micro, seed=seed)
+        if self.cfg.pipeline_parallel > 1:
+            # stage-stack the body params from init: leaves gain a leading
+            # [P] axis mapped to the pipeline mesh axis, so params AND
+            # optimizer slots live 1/P per device (ops/pipeline.py)
+            from ..models import stack_pipeline_params
+            params, axes = stack_pipeline_params(self.cfg, params, axes)
         self.axes = axes
         self.optimizer = Optimizer(self.cfg, axes)
         shardings = param_shardings(axes, self.mesh)

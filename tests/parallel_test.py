@@ -377,10 +377,17 @@ def test_pipeline_parallel_parity_and_training(eight_devices):
                 intermediate_feed_forward_multiplier_multiplier=0.5,
                 block_config=[{"layer": ["norm-shift-scale",
                                          "feed_forward-in:relu"]}])
+    from homebrewnlp_tpu.models import (stack_pipeline_params,
+                                        unstack_pipeline_params)
     cfg1 = Config(dict(base))
     cfgp = Config(dict(base, pipeline_parallel=4))
     batch = text_batch(cfg1)
     params, _ = init_params(cfg1, batch)
+    # stage-stacked layout: roundtrip must be exact
+    paramsP = stack_pipeline_params(cfgp, params)
+    assert set(unstack_pipeline_params(cfgp, paramsP)) == set(params)
+    for k, v in unstack_pipeline_params(cfgp, paramsP).items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(params[k]), err_msg=k)
     meshp = make_mesh(cfgp)
     assert meshp.shape["pipeline"] == 4
 
@@ -394,19 +401,30 @@ def test_pipeline_parallel_parity_and_training(eight_devices):
 
     l1 = float(jax.jit(loss1)(params, batch))
     with meshp:
-        lp = float(jax.jit(lossp)(params, batch))
+        lp = float(jax.jit(lossp)(paramsP, batch))
     np.testing.assert_allclose(lp, l1, rtol=1e-5)
 
     g1 = jax.jit(jax.grad(loss1))(params, batch)
     with meshp:
-        gp = jax.jit(jax.grad(lossp))(params, batch)
+        gp = unstack_pipeline_params(
+            cfgp, jax.jit(jax.grad(lossp))(paramsP, batch))
     for k in g1:
         np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(g1[k]),
                                    rtol=2e-4, atol=2e-5, err_msg=k)
 
-    # end-to-end training on the pipelined mesh
+    # end-to-end training on the pipelined mesh: body params + optimizer
+    # slots must live 1/P per device (true per-stage residency)
+    from homebrewnlp_tpu.parallel.mesh import PIPE_AXIS
     trainer = Trainer(cfgp, meshp)
     state = trainer.init(batch)
+    stacked_keys = [k for k in state.params if "/body/@d" in k]
+    assert stacked_keys
+    for k in stacked_keys:
+        v = state.params[k]
+        assert v.sharding.spec[0] == PIPE_AXIS, (k, v.sharding)
+        assert v.addressable_shards[0].data.shape[0] * 4 == v.shape[0], k
+        for slot in state.opt_state[k].values():
+            assert slot.sharding.spec[:1] == (PIPE_AXIS,), (k, slot.sharding)
     first = last = None
     for i in range(6):
         state, m = trainer.step(state, batch, jax.random.key(i))
@@ -458,11 +476,14 @@ def test_pipeline_parallel_checkpoint_strategy(eight_devices):
                 intermediate_feed_forward_multiplier_multiplier=0.5,
                 block_config=[{"layer": ["norm-shift-scale",
                                          "feed_forward-in:relu"]}])
+    from homebrewnlp_tpu.models import (stack_pipeline_params,
+                                        unstack_pipeline_params)
     cfg1 = Config(dict(base, memory_reduction_strategy="none"))
     cfgp = Config(dict(base, memory_reduction_strategy="checkpoint",
                        pipeline_parallel=2))
     batch = text_batch(cfg1)
     params, _ = init_params(cfg1, batch)
+    paramsP = stack_pipeline_params(cfgp, params)
     meshp = make_mesh(cfgp)
 
     def loss1(p, b):
@@ -475,13 +496,55 @@ def test_pipeline_parallel_checkpoint_strategy(eight_devices):
 
     l1 = float(jax.jit(loss1)(params, batch))
     with meshp:
-        lp = float(jax.jit(lossp)(params, batch))
-        gp = jax.jit(jax.grad(lossp))(params, batch)
+        lp = float(jax.jit(lossp)(paramsP, batch))
+        gp = unstack_pipeline_params(
+            cfgp, jax.jit(jax.grad(lossp))(paramsP, batch))
     np.testing.assert_allclose(lp, l1, rtol=1e-5)
     g1 = jax.jit(jax.grad(loss1))(params, batch)
     for k in g1:
         np.testing.assert_allclose(np.asarray(gp[k]), np.asarray(g1[k]),
                                    rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_pipeline_checkpoint_roundtrip_and_decode(eight_devices, tmp_path):
+    """Stage-stacked checkpoints save/restore exactly, and the serving engine
+    flattens the stacked layout for the plain decode chain."""
+    from homebrewnlp_tpu.config import Config
+    from homebrewnlp_tpu.serve.interface import CompletionEngine
+    base = dict(model_mode="gpt", use_video=False, sequence_length=16,
+                heads=1, features_per_head=32, vocab_size=64, depth=2,
+                train_batch_size=8, memory_reduction_strategy="none",
+                weight_decay=0.0, optimizer="adam-learning_rate",
+                learning_rate=1e-2, calc_accuracy=False,
+                intermediate_feed_forward_multiplier_multiplier=0.5,
+                block_config=[{"layer": ["norm-shift-scale",
+                                         "feed_forward-in:relu"]}])
+    cfgp = Config(dict(base, pipeline_parallel=2))
+    batch = text_batch(cfgp)
+    trainer = Trainer(cfgp)
+    state = trainer.init(batch)
+    state, _ = trainer.step(state, batch, jax.random.key(0))
+    ckpt = Checkpointer(str(tmp_path / "pipe_ckpt"))
+    ckpt.save(state, data_state={"pos": 1})
+    ckpt.wait()
+
+    trainer2 = Trainer(cfgp)
+    template = trainer2.init(batch)
+    restored, data_state = Checkpointer(str(tmp_path / "pipe_ckpt")).restore(template)
+    assert data_state == {"pos": 1}
+    for k in state.params:
+        np.testing.assert_array_equal(np.asarray(state.params[k]),
+                                      np.asarray(restored.params[k]), err_msg=k)
+        np.testing.assert_array_equal(
+            np.asarray(restored.params[k].sharding.spec),
+            np.asarray(state.params[k].sharding.spec), err_msg=k)
+
+    # the engine must accept the stage-stacked layout directly
+    host_params = {k: jnp.asarray(np.asarray(v))
+                   for k, v in restored.params.items()}
+    engine = CompletionEngine(cfgp, host_params)
+    out = engine.complete_tokens([1, 2, 3], temperature=0.0, max_tokens=4)
+    assert len(out) >= 7
 
 
 def test_gpipe_op_matches_sequential(eight_devices):
