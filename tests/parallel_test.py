@@ -547,6 +547,61 @@ def test_pipeline_checkpoint_roundtrip_and_decode(eight_devices, tmp_path):
     assert len(out) >= 7
 
 
+_BF16_PIPE_SNIPPET = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from homebrewnlp_tpu.config import Config
+from homebrewnlp_tpu.train import Trainer
+from homebrewnlp_tpu.utils import random_text_batch
+cfg = Config(dict(model_mode="gpt", use_video=False, sequence_length=16,
+                  heads=1, features_per_head=32, vocab_size=64, depth=2,
+                  train_batch_size=8, memory_reduction_strategy="none",
+                  weight_decay=0.0, optimizer="adam-learning_rate",
+                  learning_rate=1e-2, calc_accuracy=False,
+                  pipeline_parallel=2,
+                  calculation_dtype="bfloat16", storage_dtype="bfloat16",
+                  intermediate_feed_forward_multiplier_multiplier=0.5,
+                  block_config=[{"layer": ["norm-shift-scale",
+                                           "feed_forward-in:relu"]}]))
+tr = Trainer(cfg)
+batch = random_text_batch(cfg)
+state = tr.init(batch)
+import math
+for i in range(3):
+    state, m = tr.step(state, batch, jax.random.key(i))
+    assert math.isfinite(float(m["loss"])), m
+print("BF16_PIPE_OK", float(m["loss"]))
+"""
+
+
+def test_bf16_pipeline_probe():
+    """Half-precision pipelined training (VERDICT r2 item 7).  XLA:CPU
+    currently CHECK-aborts compiling a bf16 copy inside the pipeline's
+    manual shard_map region ('Invalid binary instruction opcode copy',
+    re-probed on jax 0.9/2026-07) and the bench env has a single real chip
+    (a pipe axis needs >= 2), so the case cannot run anywhere in this image.
+    The probe runs in a subprocess: the day the toolchain fixes the abort,
+    this test STOPS skipping and becomes real bf16-pipeline coverage."""
+    import os
+    import subprocess
+    import sys
+    proc = subprocess.run([sys.executable, "-c", _BF16_PIPE_SNIPPET],
+                          capture_output=True, text=True, timeout=600,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    if proc.returncode != 0:
+        blob = proc.stdout + proc.stderr
+        assert ("Invalid binary instruction opcode" in blob
+                or "Check failed" in blob), blob[-2000:]
+        pytest.skip("XLA:CPU still aborts on bf16 pipeline copies "
+                    "(known compiler limitation; f32 pipeline is covered)")
+    assert "BF16_PIPE_OK" in proc.stdout
+
+
 def test_gpipe_op_matches_sequential(eight_devices):
     """ops/pipeline.gpipe against the plain sequential composition: exact
     forward and gradients, microbatch count != stage count."""

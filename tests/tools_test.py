@@ -62,15 +62,83 @@ def test_split_equal_balances():
     assert sum(len(b) for b in buckets) == 10
 
 
-def test_parse_subs(tmp_path):
-    from video2tfrecord import parse_subs
-    vtt = tmp_path / "a.vtt"
-    vtt.write_text("WEBVTT\n\n00:00:01.000 --> 00:00:03.500\nhello <i>world</i>\n"
-                   "\n00:00:04.000 --> 00:00:05.000\nsecond line\nmore\n")
-    spans = parse_subs(str(vtt))
-    assert spans[0][:2] == (1.0, 3.5)
-    assert spans[0][2] == "hello world"
-    assert spans[1][2] == "second line more"
+def test_parse_subs_cue_spans():
+    from vtt_align import parse_timed_words
+    words = parse_timed_words(
+        "WEBVTT\n\n00:00:01.000 --> 00:00:03.500\nhello <i>world</i>\n"
+        "\n00:00:04.000 --> 00:00:05.000\nsecond line\nmore\n")
+    assert [w.word for w in words] == ["hello", "world", "second", "line",
+                                       "more"]
+    assert words[0].time == 1.0 and words[1].time == 2.25
+    np.testing.assert_allclose([w.time for w in words[2:]],
+                               [4.0, 4.0 + 1 / 3, 4.0 + 2 / 3])
+
+
+KARAOKE_VTT = """WEBVTT
+Kind: captions
+Language: en
+
+00:00:00.000 --> 00:00:02.100
+hello<00:00:00.700><c> brave</c><00:00:01.400><c> new</c>
+
+00:00:02.100 --> 00:00:04.000
+new
+world<00:00:02.800><c> of</c><00:00:03.400><c> <i>captions</i></c>
+"""
+
+
+def test_vtt_karaoke_word_timing():
+    from vtt_align import parse_timed_words
+    words = parse_timed_words(KARAOKE_VTT)
+    assert [w.word for w in words] == ["hello", "brave", "new", "world",
+                                       "of", "captions"]
+    times = [w.time for w in words]
+    assert times == [0.0, 0.7, 1.4, 2.1, 2.8, 3.4]
+    # rolling repeat line ("new" alone) must NOT duplicate the word;
+    # HTML tags inside <c> are stripped ("captions")
+
+
+def test_vtt_cue_interpolation():
+    from vtt_align import parse_timed_words
+    content = ("WEBVTT\n\n00:00:01.000 --> 00:00:03.000\n"
+               "four words in here\n\n"
+               "00:00:05.000 --> 00:00:06.000\nlast <b>cue</b>\n")
+    words = parse_timed_words(content)
+    assert [w.word for w in words] == ["four", "words", "in", "here",
+                                       "last", "cue"]
+    np.testing.assert_allclose([w.time for w in words],
+                               [1.0, 1.5, 2.0, 2.5, 5.0, 5.5])
+
+
+def test_align_tokens_byte_offsets():
+    from vtt_align import align_tokens, byte_decode, byte_encode
+    words = ["aa", "b", "aa"]  # repeated word: substring matching would slip
+    lists = align_tokens(byte_encode, words)
+    assert [byte_decode(t) for t in lists] == [" aa", " b", " aa"]
+    # non-ASCII: multi-byte chars must not desynchronize the walk
+    words = ["café", "au", "lait"]
+    lists = align_tokens(byte_encode, words)
+    assert [byte_decode(t) for t in lists] == [" café", " au", " lait"]
+    # a multi-byte-merging tokenizer: pairs of bytes as single tokens
+    def enc2(text):
+        bs = text.encode()
+        return [int.from_bytes(bs[i:i + 2].ljust(2, b"\0"), "big")
+                for i in range(0, len(bs), 2)]
+    lists = align_tokens(enc2, words, token_bytes=lambda t: 2)
+    # every token lands on exactly one word, stream order preserved
+    flat = [t for ts in lists for t in ts]
+    assert flat == enc2(" café au lait")
+    assert all(ts for ts in lists)
+
+
+def test_tokens_per_frame_window():
+    from vtt_align import (TimedWord, align_tokens, byte_decode, byte_encode,
+                           tokens_per_frame)
+    timed = [TimedWord(0.0, "hi"), TimedWord(0.9, "mid"), TimedWord(2.5, "far")]
+    lists = align_tokens(byte_encode, [w.word for w in timed])
+    assert byte_decode(tokens_per_frame(timed, lists, 0.0, 1.0)) == " hi mid"
+    assert tokens_per_frame(timed, lists, 1.0, 1.0) == []
+    assert byte_decode(tokens_per_frame(timed, lists, 2.0, 1.0)) == " far"
 
 
 def test_video2tfrecord_end_to_end(tmp_path):

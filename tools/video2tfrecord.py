@@ -5,9 +5,16 @@ scrapes YouTube through proxies, parses VTT subtitles with per-timestamp BPE
 alignment, extracts frames via ffmpeg/cv2 workers, and balances work by
 duration.  The zero-egress port keeps everything after the download: local
 video files -> cv2 frame extraction at a target fps, resize to the config's
-frame geometry, optional subtitle (SRT/VTT) token alignment per frame,
+frame geometry, per-word subtitle timing with token alignment per frame
+(tools/vtt_align.py — karaoke/rolling-caption VTTs and plain SRT/VTT cues),
 ``concat``/``skip_frame`` flags between videos, multiprocess workers balanced
 by duration (the reference's ``split_equal``, :168-183).
+
+The proxied YouTube downloader (reference :57-129) is deliberately NOT run
+or ported as executable code — this image has no egress.  Template for a
+deployment that has it: enumerate video ids, fetch with a rate-limited
+worker pool through rotating proxies, download the ``.vtt`` auto-caption
+track alongside each video, then feed the (video, vtt) pairs to this tool.
 
 Usage:
   python tools/video2tfrecord.py --model configs/video.json \
@@ -20,44 +27,17 @@ import argparse
 import json
 import multiprocessing
 import os
-import re
 import sys
 import typing
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from homebrewnlp_tpu.config import Config  # noqa: E402
 from homebrewnlp_tpu.data.tfrecord import encode_example  # noqa: E402
 from homebrewnlp_tpu.native import write_records  # noqa: E402
-
-TS_RE = re.compile(
-    r"(\d+):(\d\d):(\d\d)[.,](\d+)\s*-->\s*(\d+):(\d\d):(\d\d)[.,](\d+)")
-
-
-def parse_subs(path: str) -> typing.List[typing.Tuple[float, float, str]]:
-    """SRT/VTT -> [(start_s, end_s, text)] (reference :186-360 minus the
-    HTML-tag/karaoke handling its YouTube VTTs need)."""
-    out = []
-    text_lines: typing.List[str] = []
-    span = None
-    for line in open(path, encoding="utf-8", errors="replace"):
-        line = line.strip()
-        m = TS_RE.match(line)
-        if m:
-            if span and text_lines:
-                out.append((*span, " ".join(text_lines)))
-            h1, m1, s1, f1, h2, m2, s2, f2 = m.groups()
-            span = (int(h1) * 3600 + int(m1) * 60 + int(s1) + float(f"0.{f1}"),
-                    int(h2) * 3600 + int(m2) * 60 + int(s2) + float(f"0.{f2}"))
-            text_lines = []
-        elif line and span and not line.isdigit() and "WEBVTT" not in line:
-            text_lines.append(re.sub(r"<[^>]+>", "", line))
-    if span and text_lines:
-        out.append((*span, " ".join(text_lines)))
-    return out
-
 
 def split_equal(durations: typing.Sequence[float], n: int
                 ) -> typing.List[typing.List[int]]:
@@ -73,10 +53,15 @@ def split_equal(durations: typing.Sequence[float], n: int
 
 
 def video_frames(path: str, fps: float, width: int, height: int):
+    """Yields (ts, next_ts, rgb_frame).  ``next_ts`` is the ACTUAL time of
+    the next emitted frame (step/native_fps spacing — not 1/fps, which
+    leaves gaps or overlaps whenever native_fps/fps is fractional), so
+    [ts, next_ts) windows tile the subtitle timeline exactly."""
     import cv2
     cap = cv2.VideoCapture(path)
     native_fps = cap.get(cv2.CAP_PROP_FPS) or 30.0
     step = max(1, round(native_fps / fps))
+    spacing = step / native_fps
     i = 0
     while True:
         ok, frame = cap.read()
@@ -84,7 +69,8 @@ def video_frames(path: str, fps: float, width: int, height: int):
             break
         if i % step == 0:
             frame = cv2.resize(frame, (width, height))
-            yield i / native_fps, cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
+            ts = i / native_fps
+            yield ts, ts + spacing, cv2.cvtColor(frame, cv2.COLOR_BGR2RGB)
         i += 1
     cap.release()
 
@@ -92,15 +78,24 @@ def video_frames(path: str, fps: float, width: int, height: int):
 def _encode_video(job) -> str:
     (worker_idx, video_paths, sub_paths, out_dir, cfg_path, fps) = job
     import cv2
+
+    from vtt_align import (align_tokens, byte_encode, parse_timed_words,
+                           tokens_per_frame)
     cfg = Config.from_json(cfg_path) if cfg_path else None
     width = cfg.frame_width if cfg else 320
     height = cfg.frame_height if cfg else 176
     ltpf = cfg.language_token_per_frame if cfg else 0
     payloads = []
     for vid_idx, path in enumerate(video_paths):
-        subs = parse_subs(sub_paths[vid_idx]) if sub_paths else []
+        timed, token_lists = [], []
+        if sub_paths:
+            with open(sub_paths[vid_idx], encoding="utf-8",
+                      errors="replace") as f:
+                timed = parse_timed_words(f.read())
+            token_lists = align_tokens(byte_encode,
+                                       [w.word for w in timed])
         first = True
-        for ts, frame in video_frames(path, fps, width, height):
+        for ts, next_ts, frame in video_frames(path, fps, width, height):
             ok, jpg = cv2.imencode(".jpg", cv2.cvtColor(frame,
                                                         cv2.COLOR_RGB2BGR))
             assert ok
@@ -110,8 +105,8 @@ def _encode_video(job) -> str:
                 "skip_frame": [0],
             }
             if ltpf:
-                text = " ".join(t for s, e, t in subs if s <= ts < e)
-                toks = list(text.encode())[:ltpf]
+                toks = tokens_per_frame(timed, token_lists, ts, next_ts - ts)
+                toks = toks[:ltpf]
                 feats["tokens"] = toks + [0] * (ltpf - len(toks))
                 feats["mask"] = [len(toks)]
             payloads.append(encode_example(feats))

@@ -1,0 +1,174 @@
+"""Word-level subtitle timing + BPE token alignment.
+
+Covers the subtitle half of the reference's YouTube caption pipeline
+(/root/reference/scripts/video2tfrecord.py:186-360, ``decode_vtt`` +
+``bpe_with_word_split``): YouTube auto-caption VTTs carry per-word
+"karaoke" timing via inline ``<HH:MM:SS.mmm><c> word</c>`` tags and repeat
+each caption line in a rolling two-line window, while plain VTT/SRT cues
+only carry per-cue spans, so word times are interpolated across the cue.
+The scrape/proxy downloader itself stays a documented template
+(tools/video2tfrecord.py module docstring) — this image has zero egress —
+but the parsing is pure and offline-testable.
+
+Design differences from the reference (intentional, not drift):
+- karaoke extraction is regex-anchored on the ``<t><c>...</c>`` pair
+  instead of fixed ``[-12:]`` string slicing, so HTML tags, missing
+  trailing tags, and >99h timestamps don't corrupt words;
+- rolling-caption repeats are dropped by comparing a line's untagged lead
+  text against the previously emitted word (the reference concatenates the
+  repeat into the neighboring word);
+- token alignment walks CHARACTER OFFSETS of the exact decoded pieces
+  instead of substring matching, so repeated words and subword overlaps
+  cannot desynchronize the assignment.
+"""
+from __future__ import annotations
+
+import re
+import typing
+
+TimedWord = typing.NamedTuple("TimedWord", (("time", float), ("word", str)))
+
+_CUE_RE = re.compile(
+    r"(\d+):(\d\d):(\d\d)[.,](\d+)\s*-->\s*(\d+):(\d\d):(\d\d)[.,](\d+)")
+_KARAOKE_RE = re.compile(r"<(\d+):(\d\d):(\d\d)\.(\d+)><c>(.*?)</c>")
+_INLINE_TS_RE = re.compile(r"<\d+:\d\d:\d\d\.\d+>")
+_TAG_RE = re.compile(r"<[^>]*>")
+
+
+def _seconds(h: str, m: str, s: str, frac: str) -> float:
+    return int(h) * 3600 + int(m) * 60 + int(s) + float(f"0.{frac}")
+
+
+def parse_timed_words(content: str) -> typing.List[TimedWord]:
+    """VTT/SRT text -> one ``TimedWord`` per word, times in seconds.
+
+    Karaoke VTTs (``<c>`` present) yield true per-word times; plain cue
+    files interpolate the cue span evenly over its words (the reference's
+    ``time_snip`` rule)."""
+    if "<c>" in content:
+        return _parse_karaoke(content)
+    return _parse_cues(content)
+
+
+def _parse_karaoke(content: str) -> typing.List[TimedWord]:
+    out: typing.List[TimedWord] = []
+    cue_start: typing.Optional[float] = None
+    for raw in content.split("\n"):
+        m = _CUE_RE.search(raw)
+        if m:
+            cue_start = _seconds(*m.groups()[:4])
+            continue
+        if "<c>" not in raw:
+            # rolling-window repeat of the previous line (or header/blank)
+            continue
+        # lead text before the first inline timestamp: the cue's first word
+        # when fresh, or a rolling repeat of the last emitted word (YouTube's
+        # tagged line restates the previous line's final word as its lead).
+        # Equality with the previous word is the discriminator; a GENUINE
+        # immediate duplicate spanning a cue boundary ("yeah | yeah right")
+        # therefore collapses to one occurrence — preferred over the rolling
+        # repeat duplicating a word at every cue boundary (the reference
+        # instead concatenates repeats into the neighboring word,
+        # video2tfrecord.py:218-241, which double-counts them)
+        lead = _TAG_RE.sub("", _INLINE_TS_RE.split(raw, 1)[0]).strip()
+        if lead and not (out and out[-1].word == lead):
+            out.append(TimedWord(cue_start if cue_start is not None else 0.0,
+                                 lead))
+        for h, mi, s, frac, word in _KARAOKE_RE.findall(raw):
+            word = _TAG_RE.sub("", word).strip()
+            if word:
+                out.append(TimedWord(_seconds(h, mi, s, frac), word))
+    return out
+
+
+def _parse_cues(content: str) -> typing.List[TimedWord]:
+    out: typing.List[TimedWord] = []
+    span: typing.Optional[typing.Tuple[float, float]] = None
+    lines: typing.List[str] = []
+
+    def flush():
+        if span is None or not lines:
+            return
+        words = " ".join(lines).split()
+        if not words:
+            return
+        start, end = span
+        step = (end - start) / len(words)
+        out.extend(TimedWord(start + i * step, w)
+                   for i, w in enumerate(words))
+
+    for raw in content.split("\n"):
+        m = _CUE_RE.search(raw)
+        if m:
+            flush()
+            span = (_seconds(*m.groups()[:4]), _seconds(*m.groups()[4:]))
+            lines = []
+            continue
+        text = _TAG_RE.sub("", raw).strip()
+        if (text and span is not None and not text.isdigit()
+                and "WEBVTT" not in text):
+            lines.append(text)
+    flush()
+    return out
+
+
+def align_tokens(encode: typing.Callable[[str], typing.Sequence[int]],
+                 words: typing.Sequence[str],
+                 token_bytes: typing.Optional[
+                     typing.Callable[[int], int]] = None
+                 ) -> typing.List[typing.List[int]]:
+    """Tokenize the words' joined text ONCE and split the token stream back
+    into one token list per word (the reference's ``bpe_with_word_split``).
+
+    Tokenizing per word would produce different tokens than tokenizing the
+    running text (BPE merges across word boundaries with the leading-space
+    convention), so the stream is cut by BYTE offset: each token goes to the
+    word whose UTF-8 span contains the token's first byte.  Byte space, not
+    character space, because a token covering part of a multi-byte character
+    has no well-defined character length (decoding it yields a replacement
+    char and desynchronizes the walk on any non-ASCII caption).
+
+    ``token_bytes(tok)`` -> decoded byte length of one token; the default is
+    raw byte-level tokens (1 byte per id, the production tokenizer here).
+    For a BPE vocabulary pass the piece's byte length from the merges
+    table."""
+    text = "".join(" " + w for w in words)
+    tokens = list(encode(text))
+    if token_bytes is None:
+        token_bytes = lambda _tok: 1  # noqa: E731 — byte-level ids
+    bounds = []
+    pos = 0
+    for w in words:
+        pos += len((" " + w).encode("utf-8"))
+        bounds.append(pos)
+    out: typing.List[typing.List[int]] = [[] for _ in words]
+    byte = 0
+    wi = 0
+    for tok in tokens:
+        while wi + 1 < len(words) and byte >= bounds[wi]:
+            wi += 1
+        out[wi].append(int(tok))
+        byte += token_bytes(tok)
+    return out
+
+
+def byte_encode(text: str) -> typing.List[int]:
+    return list(text.encode("utf-8", errors="replace"))
+
+
+def byte_decode(ids: typing.Sequence[int]) -> str:
+    return bytes(int(i) & 0xFF for i in ids).decode("utf-8", errors="replace")
+
+
+def tokens_per_frame(timed: typing.Sequence[TimedWord],
+                     token_lists: typing.Sequence[typing.Sequence[int]],
+                     frame_time: float, frame_step: float
+                     ) -> typing.List[int]:
+    """Tokens of every word whose timestamp falls inside the frame's window
+    ``[frame_time, frame_time + frame_step)`` — the per-frame assignment the
+    TFRecord builder writes next to each frame."""
+    out: typing.List[int] = []
+    for tw, toks in zip(timed, token_lists):
+        if frame_time <= tw.time < frame_time + frame_step:
+            out.extend(toks)
+    return out
