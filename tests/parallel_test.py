@@ -362,6 +362,20 @@ def test_routed_moe_rejects_reversible_strategies():
     assert cfg.memory_reduction_strategy == "revnet"
 
 
+def _pipe_base(**overrides):
+    """Shared tiny-gpt config dict for the pipeline-parallel tests."""
+    base = dict(model_mode="gpt", use_video=False, sequence_length=16,
+                heads=1, features_per_head=32, vocab_size=64, depth=2,
+                train_batch_size=8, memory_reduction_strategy="none",
+                weight_decay=0.0, optimizer="adam-learning_rate",
+                learning_rate=1e-2, calc_accuracy=False,
+                intermediate_feed_forward_multiplier_multiplier=0.5,
+                block_config=[{"layer": ["norm-shift-scale",
+                                         "feed_forward-in:relu"]}])
+    base.update(overrides)
+    return base
+
+
 def test_pipeline_parallel_parity_and_training(eight_devices):
     """GPipe pipelined body (pipeline_parallel=4 on a data x pipe mesh) must
     match the sequential body exactly — same flat params, same loss, same
@@ -369,14 +383,7 @@ def test_pipeline_parallel_parity_and_training(eight_devices):
     from homebrewnlp_tpu.config import Config
     from homebrewnlp_tpu.models import build, init_params
     from homebrewnlp_tpu.models.ctx import Ctx
-    base = dict(model_mode="gpt", use_video=False, sequence_length=16,
-                heads=1, features_per_head=32, vocab_size=64, depth=4,
-                train_batch_size=8, memory_reduction_strategy="none",
-                weight_decay=0.0, optimizer="adam-learning_rate",
-                learning_rate=1e-2, calc_accuracy=False,
-                intermediate_feed_forward_multiplier_multiplier=0.5,
-                block_config=[{"layer": ["norm-shift-scale",
-                                         "feed_forward-in:relu"]}])
+    base = _pipe_base(depth=4)
     from homebrewnlp_tpu.models import (stack_pipeline_params,
                                         unstack_pipeline_params)
     cfg1 = Config(dict(base))
@@ -435,11 +442,9 @@ def test_pipeline_parallel_parity_and_training(eight_devices):
 
 def test_pipeline_parallel_config_validation():
     from homebrewnlp_tpu.config import Config
-    base = dict(model_mode="gpt", use_video=False, sequence_length=16,
-                heads=1, features_per_head=32, vocab_size=64, depth=4,
-                train_batch_size=8,
-                intermediate_feed_forward_multiplier_multiplier=0.5,
-                block_config=[{"layer": ["feed_forward-in:relu"]}])
+    base = _pipe_base(depth=4,
+                      block_config=[{"layer": ["feed_forward-in:relu"]}])
+    del base["memory_reduction_strategy"]  # each case sets its own
     with pytest.raises(ValueError, match="divide depth"):
         Config(dict(base, pipeline_parallel=3,
                     memory_reduction_strategy="none"))
@@ -468,14 +473,7 @@ def test_pipeline_parallel_checkpoint_strategy(eight_devices):
     from homebrewnlp_tpu.config import Config
     from homebrewnlp_tpu.models import build, init_params
     from homebrewnlp_tpu.models.ctx import Ctx
-    base = dict(model_mode="gpt", use_video=False, sequence_length=16,
-                heads=1, features_per_head=32, vocab_size=64, depth=2,
-                train_batch_size=8, weight_decay=0.0,
-                optimizer="adam-learning_rate", learning_rate=1e-2,
-                calc_accuracy=False,
-                intermediate_feed_forward_multiplier_multiplier=0.5,
-                block_config=[{"layer": ["norm-shift-scale",
-                                         "feed_forward-in:relu"]}])
+    base = _pipe_base()
     from homebrewnlp_tpu.models import (stack_pipeline_params,
                                         unstack_pipeline_params)
     cfg1 = Config(dict(base, memory_reduction_strategy="none"))
@@ -511,15 +509,7 @@ def test_pipeline_checkpoint_roundtrip_and_decode(eight_devices, tmp_path):
     flattens the stacked layout for the plain decode chain."""
     from homebrewnlp_tpu.config import Config
     from homebrewnlp_tpu.serve.interface import CompletionEngine
-    base = dict(model_mode="gpt", use_video=False, sequence_length=16,
-                heads=1, features_per_head=32, vocab_size=64, depth=2,
-                train_batch_size=8, memory_reduction_strategy="none",
-                weight_decay=0.0, optimizer="adam-learning_rate",
-                learning_rate=1e-2, calc_accuracy=False,
-                intermediate_feed_forward_multiplier_multiplier=0.5,
-                block_config=[{"layer": ["norm-shift-scale",
-                                         "feed_forward-in:relu"]}])
-    cfgp = Config(dict(base, pipeline_parallel=2))
+    cfgp = Config(_pipe_base(pipeline_parallel=2))
     batch = text_batch(cfgp)
     trainer = Trainer(cfgp)
     state = trainer.init(batch)
@@ -545,6 +535,28 @@ def test_pipeline_checkpoint_roundtrip_and_decode(eight_devices, tmp_path):
     engine = CompletionEngine(cfgp, host_params)
     out = engine.complete_tokens([1, 2, 3], temperature=0.0, max_tokens=4)
     assert len(out) >= 7
+
+
+def test_pipeline_with_grad_accumulation(eight_devices):
+    """GPipe composes with the micro-batch accumulation scan: the pipelined
+    trainer under grad_accumulation=2 must track the non-pipelined trainer's
+    loss trajectory exactly (pipeline is an exact execution strategy, not an
+    approximation)."""
+    from homebrewnlp_tpu.config import Config
+    base = _pipe_base(grad_accumulation=2)
+    losses = {}
+    for name, cfg in (("plain", Config(dict(base))),
+                      ("piped", Config(dict(base, pipeline_parallel=2)))):
+        trainer = Trainer(cfg)
+        batch = text_batch(cfg)
+        state = trainer.init(batch)
+        ls = []
+        for i in range(4):
+            state, m = trainer.step(state, batch, jax.random.key(7))
+            ls.append(float(m["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["piped"], losses["plain"], rtol=2e-5)
+    assert losses["piped"][-1] < losses["piped"][0]
 
 
 _BF16_PIPE_SNIPPET = """
