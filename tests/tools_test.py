@@ -131,6 +131,36 @@ def test_align_tokens_byte_offsets():
     assert all(ts for ts in lists)
 
 
+def test_align_tokens_bpe_vocab():
+    """align_tokens over a real trained-BPE vocabulary: byte lengths from
+    the merges table keep the walk synchronized."""
+    from vtt_align import align_tokens, bpe_token_bytes
+    from homebrewnlp_tpu.native import bpe_encode, bpe_train_words
+    words = ["the", "theme", "of", "the", "day"]
+    text = "".join(" " + w for w in words)
+    corpus = {np.frombuffer(w.encode(), np.uint8).astype(np.int32).tobytes(): 5
+              for w in set(words)}
+    merges = bpe_train_words(corpus, 10, first_new_id=256)
+    assert len(merges)  # multi-byte tokens exist, so lengths really vary
+
+    def enc(t):
+        toks = np.frombuffer(t.encode(), np.uint8).astype(np.int32)
+        return bpe_encode(toks, merges).tolist()
+
+    # expand a token id back to its bytes via the merge table
+    expand = {i: bytes([i]) for i in range(256)}
+    for i, (l, r) in enumerate(merges.tolist()):
+        expand[256 + i] = expand[int(l)] + expand[int(r)]
+
+    tb = bpe_token_bytes(merges.tolist())
+    lists = align_tokens(enc, words, token_bytes=tb)
+    # every word's token sublist must decode to exactly that word's span —
+    # the real alignment property (a wrong token_bytes breaks this)
+    for w, l in zip(words, lists):
+        assert b"".join(expand[t] for t in l) == (" " + w).encode(), (w, l)
+    assert sum(tb(t) for t in enc(text)) == len(text.encode())
+
+
 def test_tokens_per_frame_window():
     from vtt_align import (TimedWord, align_tokens, byte_decode, byte_encode,
                            tokens_per_frame)

@@ -152,6 +152,19 @@ def align_tokens(encode: typing.Callable[[str], typing.Sequence[int]],
     return out
 
 
+def bpe_token_bytes(merges: typing.Sequence[typing.Sequence[int]],
+                    first_new_id: int = 256
+                    ) -> typing.Callable[[int], int]:
+    """``token_bytes`` for :func:`align_tokens` over a
+    ``tools/train_tokenizer.py`` vocabulary: ids < ``first_new_id`` are raw
+    bytes (length 1); merge id ``first_new_id + i`` covers the combined byte
+    length of the pair it merges."""
+    lens: typing.List[int] = [1] * first_new_id
+    for left, right in merges:
+        lens.append(lens[int(left)] + lens[int(right)])
+    return lambda tok: lens[int(tok)]
+
+
 def byte_encode(text: str) -> typing.List[int]:
     return list(text.encode("utf-8", errors="replace"))
 
