@@ -106,7 +106,8 @@ def make_cached_text_sampler(cfg: Config, params: dict):
     if not cache_eligible(cfg):
         raise ValueError("config is not KV-cache eligible; use make_text_sampler")
 
-    def fn(token_x: NT, initial_pos, temperature, rng, end_iterations=None):
+    def fn(params, token_x: NT, initial_pos, temperature, rng,
+           end_iterations=None):
         names = token_x.names
         toks = token_x.x.astype(jnp.int32)
         seq_axis = names.index(SEQUENCE)
@@ -154,4 +155,5 @@ def make_cached_text_sampler(cfg: Config, params: dict):
             cond, body, (start, toks, caches, rng))
         return out
 
-    return jax.jit(fn)
+    from .sampler import jit_bound
+    return jit_bound(fn, params)

@@ -39,6 +39,16 @@ def forward_logits(cfg: Config, params: dict, batch: typing.Dict[str, NT]
 _logits = forward_logits
 
 
+def jit_bound(fn, params):
+    """jit ``fn(params, ...)`` and bind ``params`` as its first ARGUMENT.
+
+    Closing over the weights instead would bake them into the program as
+    HLO constants — hundreds of MB at real sizes, enough to exceed a
+    remote-compile service's request limit, duplicated per compilation."""
+    import functools
+    return functools.partial(jax.jit(fn), params)
+
+
 def _gumbel_argmax(logits: jnp.ndarray, temperature, key: jax.Array) -> jnp.ndarray:
     u = jax.random.uniform(key, logits.shape, jnp.float32, 1e-9, 1.0)
     noisy = logits.astype(jnp.float32) - temperature * jnp.log(-jnp.log(u))
@@ -149,9 +159,15 @@ def make_single_forward(cfg: Config, params: dict):
     reference inference.py:136-170): ONE forward pass; positions from
     ``initial_pos`` up to ``end_iterations`` receive the one-step-ahead
     (teacher-forced) prediction, the prompt keeps its tokens.  Same signature
-    as the autoregressive sampler so the engine can swap them."""
+    as the autoregressive sampler so the engine can swap them.
 
-    def fn(token_x: NT, initial_pos, temperature, rng, end_iterations=None):
+    ``params`` ride as a jit ARGUMENT, not a closure: closed-over arrays
+    become HLO constants, which duplicates the weights into the program
+    (hundreds of MB at real sizes — enough to exceed a remote-compile
+    service's request limit) and recompiles per weight set."""
+
+    def fn(params, token_x: NT, initial_pos, temperature, rng,
+           end_iterations=None):
         names = token_x.names
         seq_axis = names.index(SEQUENCE)
         toks = token_x.x.astype(jnp.int32)
@@ -171,7 +187,7 @@ def make_single_forward(cfg: Config, params: dict):
         keep = (pos < initial_pos) | (pos >= end)
         return jnp.where(keep, toks, sampled)
 
-    return jax.jit(fn)
+    return jit_bound(fn, params)
 
 
 def make_text_sampler(cfg: Config, params: dict):
@@ -179,12 +195,14 @@ def make_text_sampler(cfg: Config, params: dict):
     end_iterations) -> int32 tokens.  initial_pos / temperature /
     end_iterations are traced so one compilation serves every prompt and
     response length (the reference feeds them via infeed placeholders,
-    src/run/dataloader_placement.py:234-271)."""
+    src/run/dataloader_placement.py:234-271).  ``params`` are a jit
+    argument, not closed-over constants (see make_single_forward)."""
 
-    def fn(token_x: NT, initial_pos, temperature, rng, end_iterations=None):
+    def fn(params, token_x: NT, initial_pos, temperature, rng,
+           end_iterations=None):
         end = (jnp.int32(cfg.sequence_length) if end_iterations is None
                else end_iterations)
         return autoregressive_text(cfg, params, token_x, initial_pos,
                                    temperature, end_iterations=end, rng=rng)
 
-    return jax.jit(fn)
+    return jit_bound(fn, params)
