@@ -91,7 +91,7 @@ def main() -> None:
     state, metrics = run_steps(3, state)
     float(metrics["loss"])
 
-    # best-of-3 windows of 10 steps.  The window ends with a HOST PULL of the
+    # best-of-N windows of 10 steps.  The window ends with a HOST PULL of the
     # loss scalar, not block_until_ready: the experimental axon relay acks
     # readiness before execution completes (round-1 bench measured 6.5 ms/step
     # = 12x chip peak), but a device->host transfer of the final step's output
@@ -99,11 +99,20 @@ def main() -> None:
     # ms/step, a physically sane 41% MFU on v5e.
     n_steps = 10
     best_dt = float("inf")
-    for _ in range(3):
+    loss_after = None
+    # best-of-5: the relay's wall-clock jitter between windows is several
+    # percent; min() needs enough samples to reach the true step time.  The
+    # fixed-seed comparison loss stays pinned to the end of window 3 (step
+    # 33 under the 3-warmup/10-step constants — the figure rounds 1-2
+    # recorded) regardless of how many timing windows run.
+    pin_step = step_i + 3 * n_steps
+    for _ in range(5):
         t0 = time.perf_counter()
         state, metrics = run_steps(n_steps, state)
-        loss_after = float(metrics["loss"])
+        window_loss = float(metrics["loss"])
         best_dt = min(best_dt, time.perf_counter() - t0)
+        if step_i == pin_step or loss_after is None and step_i >= pin_step:
+            loss_after = window_loss
     dt = best_dt
     tokens = cfg.train_batch_size * cfg.sequence_length * n_steps
     n_chips = max(1, len(jax.devices()))
