@@ -44,10 +44,9 @@ def cache_eligible(cfg: Config) -> bool:
     """True when the config's whole layer stack decodes against a KV cache."""
     if cfg.use_video:
         return False
-    if cfg.use_initial_position_embedding:
-        # the initial position table is added full-length before the body;
-        # decode-mode slicing of it is not wired up
-        return False
+    # use_initial_position_embedding is cache-compatible: the body builds
+    # the table full-length and slices the decoded rows at ctx.decode.pos
+    # (models/__init__.py::_body), same as attention's positional keys
     for block in (list(cfg.input_block_config) + list(cfg.block_config)
                   + list(cfg.output_block_config)):
         for spec in block.layer:

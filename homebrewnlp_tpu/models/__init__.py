@@ -21,7 +21,7 @@ from ..ops.losses import accuracy as _accuracy_fn
 from ..ops.losses import softmax_cross_entropy_with_logits, video_l1_loss
 from ..ops.reversible import make_reversible_chain
 from .ctx import Args, Ctx, DEPTH_TOKEN
-from .embedding import embed, gather, gather_embed
+from .embedding import embed, gather, gather_embed, positional_embed
 from .linear import linear, linear_from_features, linear_to_features
 from .registry import block_part_fn
 
@@ -127,8 +127,9 @@ def _body(ctx: Ctx, src: NT) -> NT:
             base_args = Args(ctx, src, [""])
             for dim in [n for n in src.names if n not in cfg.feature_dims][1:]:
                 fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
-                src = src + embed(base_args(list(cfg.position_embedding)),
-                                  [(dim, src.dim_size(dim))] + fdims)
+                src = src + positional_embed(
+                    base_args(list(cfg.position_embedding)), dim,
+                    src.dim_size(dim), fdims)
 
         strategy = cfg.memory_reduction_strategy
         seq = [(i, c) for i in range(cfg.depth) for c in range(len(cfg.block_config))]

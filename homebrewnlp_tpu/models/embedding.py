@@ -137,6 +137,26 @@ def embed(args: Args, dims: typing.Sequence[Dim]) -> NT:
     return args.ctx.scoped("embed", _embed, args, dims)
 
 
+def positional_embed(args: Args, dim: str, size: int,
+                     fdims: typing.Sequence[Dim]) -> NT:
+    """Position table over ``dim`` with KV-cache decode handling: under
+    ``ctx.decode`` the table is built FULL-LENGTH (same scope walk and
+    shape as training, so checkpointed weights resolve) and the current
+    rows — width ``size`` at absolute position ``decode.pos`` — are sliced
+    out.  Shared by the body's initial position embedding and attention's
+    positional keys so the slicing invariant lives in one place."""
+    from ..config import SEQUENCE
+    dc = args.ctx.decode
+    sliced = dc is not None and dim == SEQUENCE
+    full = dc.seq if sliced else size
+    out = embed(args, [(dim, full)] + list(fdims))
+    if sliced:
+        ax = out.names.index(dim)
+        out = NT(jax.lax.dynamic_slice_in_dim(out.x, dc.pos, size, ax),
+                 out.names)
+    return out
+
+
 def gather(args: Args, table: NT, squeeze_dims: typing.Sequence[str] = ()) -> NT:
     """Embedding lookup: ids (int NT) index axis 0 of ``table``.
 

@@ -415,18 +415,9 @@ def _qkv(args: Args, base: typing.Optional[Args], dim: str
         if "embedded" in args or "context" in args:
             key = activated_linear_out(base)
         if "embedded" in args or "positional" in args:
+            from .embedding import positional_embed
             fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
-            # the embedding table is always built full-size (same scope path
-            # as training, so checkpointed weights resolve); decode mode
-            # slices the current row
-            full = dc.seq if dc is not None else t.dim_size(dim)
-            pos = embed(args, [(dim, full)] + fdims)
-            if dc is not None:
-                # slice the current row(s): width 1 for incremental decode,
-                # the whole prompt for the prefill pass
-                ax = pos.names.index(dim)
-                pos = NT(jax.lax.dynamic_slice_in_dim(
-                    pos.x, dc.pos, t.dim_size(dim), ax), pos.names)
+            pos = positional_embed(args, dim, t.dim_size(dim), fdims)
             key = pos if key is None else key + pos
         scale = (dc.seq if dc is not None else t.dim_size(dim)) ** -0.5
         qry = activated_linear_out(base) * scale

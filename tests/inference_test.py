@@ -227,11 +227,32 @@ def _kv_cfg(**over):
 def test_kv_cache_eligibility():
     from homebrewnlp_tpu.infer import cache_eligible
     assert cache_eligible(_kv_cfg())
+    # decode-mode slicing of the initial position table is wired up
+    assert cache_eligible(_kv_cfg(use_initial_position_embedding=True))
     # mixer bias maps keep the rebuild path
     assert not cache_eligible(mixer_config())
     assert not cache_eligible(_kv_cfg(block_config=[
         {"layer": ["attention-biased_attention_map-absolute-input_as_value"]}]))
     assert not cache_eligible(_kv_cfg(block_config=[{"layer": ["cummean"]}]))
+
+
+def test_kv_cache_initial_position_embedding_parity():
+    """Greedy cached decode under use_initial_position_embedding: the table
+    is added full-length in training but sliced per decoded row in cache
+    mode — tokens must match the rebuild sampler exactly."""
+    from homebrewnlp_tpu.infer import make_cached_text_sampler
+    cfg = _kv_cfg(use_initial_position_embedding=True)
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    assert any("position_embedding" in k or "body/embed" in k
+               for k in params), sorted(params)[:8]
+    toks = np.zeros((1, cfg.sequence_length, 1), np.int32)
+    toks[0, :5, 0] = [3, 14, 15, 9, 2]
+    nt = NT(jax.numpy.asarray(toks), TEXT_AXES)
+    a = np.asarray(make_text_sampler(cfg, params)(
+        nt, np.int32(5), np.float32(0.0), jax.random.key(0)))
+    b = np.asarray(make_cached_text_sampler(cfg, params)(
+        nt, np.int32(5), np.float32(0.0), jax.random.key(0)))
+    np.testing.assert_array_equal(a, b)
 
 
 def test_kv_cache_greedy_matches_rebuild():
