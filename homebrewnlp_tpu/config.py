@@ -182,6 +182,11 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     initial_autoregressive_position=128,
     use_autoregressive_sampling=False,
     sampling_temperature=0.0,
+    # extension: truncated sampling (the reference only has temperature).
+    # top_k=0 and top_p=1.0 disable truncation; both knobs are compile-time
+    # static (changing them recompiles the sampler).
+    sampling_top_k=0,
+    sampling_top_p=1.0,
     num_of_sample=10,
     web_workers=1,
     equal_debugging_items_per_check=16,
@@ -245,6 +250,13 @@ class Config:
             self.multi_loss_strategy = "linear"
         if not self.use_language and not self.use_video:
             raise ValueError("Language and video mode are both disabled")
+        if self.sampling_top_k < 0 or self.sampling_top_k > self.vocab_size:
+            raise ValueError(
+                f"sampling_top_k must be in [0, vocab_size]; got "
+                f"{self.sampling_top_k}")
+        if not 0.0 < self.sampling_top_p <= 1.0:
+            raise ValueError(
+                f"sampling_top_p must be in (0, 1]; got {self.sampling_top_p}")
         # GPipe pipeline parallelism (ops/pipeline.py): stages must cut the
         # depth loop evenly, compose with none/checkpoint rematerialization
         # only (reversible chains carry custom_vjp state across stages), and

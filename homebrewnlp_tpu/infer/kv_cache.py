@@ -134,7 +134,8 @@ def make_cached_text_sampler(cfg: Config, params: dict):
             row = jax.lax.dynamic_slice_in_dim(toks, pos, 1, seq_axis)
             logits, caches = _decode_logits(cfg, params, row, pos, caches,
                                             seq, names)
-            sampled = _gumbel_argmax(logits, jnp.float32(temperature), sub)
+            sampled = _gumbel_argmax(logits, jnp.float32(temperature), sub,
+                                     cfg.sampling_top_k, cfg.sampling_top_p)
             # the sampled row is the prediction for position pos+1; write it
             # only into sampleable positions [initial_pos, end)
             nxt = pos + 1

@@ -49,10 +49,42 @@ def jit_bound(fn, params):
     return functools.partial(jax.jit(fn), params)
 
 
-def _gumbel_argmax(logits: jnp.ndarray, temperature, key: jax.Array) -> jnp.ndarray:
+def _truncate_logits(logits: jnp.ndarray, top_k: int, top_p: float
+                     ) -> jnp.ndarray:
+    """Top-k / nucleus truncation (extension — the reference samples with
+    temperature only, inference.py:88-92).  Masked entries go to -inf so the
+    Gumbel trick can never pick them; ties at the threshold are all kept."""
+    if top_p >= 1.0:  # top_k only: k-th threshold without a full vocab sort
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        return jnp.where(logits < kth, -jnp.inf, logits)
+    # one descending sort serves both cuts on the hot decode path
+    desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    if top_k:
+        desc = jnp.where(jnp.arange(desc.shape[-1]) < top_k, desc, -jnp.inf)
+    probs = jax.nn.softmax(desc, axis=-1)  # top_k-masked entries carry 0 mass
+    # keep tokens whose preceding cumulative mass is < p (the set always
+    # includes the argmax and just crosses p)
+    keep = ((jnp.cumsum(probs, axis=-1) - probs) < top_p) & jnp.isfinite(desc)
+    thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def _gumbel_argmax(logits: jnp.ndarray, temperature, key: jax.Array,
+                   top_k: int = 0, top_p: float = 1.0) -> jnp.ndarray:
+    logits = logits.astype(jnp.float32)
     u = jax.random.uniform(key, logits.shape, jnp.float32, 1e-9, 1.0)
-    noisy = logits.astype(jnp.float32) - temperature * jnp.log(-jnp.log(u))
-    return jnp.argmax(noisy, axis=-1).astype(jnp.int32)
+    gumbel = -jnp.log(-jnp.log(u))
+    if top_k or top_p < 1.0:
+        # standard truncation semantics: temper FIRST, then cut, then sample
+        # at Gumbel scale 1 — so top_p measures cumulative mass on the
+        # distribution actually being sampled (softmax(logits/T)), matching
+        # the nucleus-sampling definition.  T=0 stays exact greedy.
+        t = jnp.float32(temperature)
+        hot = (t > 0).astype(jnp.float32)
+        logits = _truncate_logits(logits / jnp.where(t > 0, t, 1.0),
+                                  top_k, top_p)
+        return jnp.argmax(logits + hot * gumbel, axis=-1).astype(jnp.int32)
+    return jnp.argmax(logits + temperature * gumbel, axis=-1).astype(jnp.int32)
 
 
 def autoregressive_text(cfg: Config, params: dict, token_x: NT,
@@ -80,7 +112,8 @@ def autoregressive_text(cfg: Config, params: dict, token_x: NT,
         batch = dict(batch_template)
         batch["token_x"] = NT(toks, names)
         logits, _ = _logits(cfg, params, batch)  # [b, seq, patch, vocab]
-        sampled = _gumbel_argmax(logits, jnp.float32(temperature), sub)
+        sampled = _gumbel_argmax(logits, jnp.float32(temperature), sub,
+                                 cfg.sampling_top_k, cfg.sampling_top_p)
         # shift +1 along sequence (zero-fill, not wrap-around — reference
         # inference.py:94 shift(wrap=False)): position p receives the argmax
         # of the logits at p-1
@@ -176,7 +209,8 @@ def make_single_forward(cfg: Config, params: dict):
         batch = {"token_x": NT(toks, names),
                  "token_y": NT(jnp.zeros_like(toks), names)}
         logits, _ = _logits(cfg, params, batch)
-        sampled = _gumbel_argmax(logits, jnp.float32(temperature), rng)
+        sampled = _gumbel_argmax(logits, jnp.float32(temperature), rng,
+                                 cfg.sampling_top_k, cfg.sampling_top_p)
         zeros = jnp.zeros_like(jax.lax.slice_in_dim(sampled, 0, 1, axis=seq_axis))
         sampled = jnp.concatenate(
             [zeros, jax.lax.slice_in_dim(sampled, 0,

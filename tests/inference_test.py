@@ -279,6 +279,47 @@ def test_kv_cache_greedy_matches_rebuild():
     np.testing.assert_array_equal(a, b)
 
 
+def test_truncated_sampling():
+    """top-k / nucleus truncation (extension; reference is temperature-only):
+    top_k=1 is greedy at any temperature, top_k=k confines hot samples to
+    the top-k set, a tiny top_p collapses to greedy, bad knobs are
+    rejected."""
+    import jax.numpy as jnp
+
+    from homebrewnlp_tpu.infer.sampler import _gumbel_argmax
+    logits = np.random.RandomState(0).standard_normal((4, 32)).astype(np.float32)
+    greedy = np.argmax(logits, -1)
+    for key in range(3):
+        s = np.asarray(_gumbel_argmax(jnp.asarray(logits), jnp.float32(5.0),
+                                      jax.random.key(key), top_k=1))
+        np.testing.assert_array_equal(s, greedy)
+    top3 = np.argsort(logits, -1)[:, -3:]
+    hits = set()
+    for key in range(8):
+        s = np.asarray(_gumbel_argmax(jnp.asarray(logits), jnp.float32(3.0),
+                                      jax.random.key(key), top_k=3))
+        for r in range(4):
+            assert s[r] in top3[r], (r, s[r], top3[r])
+            hits.add((r, int(s[r])))
+    assert len(hits) > 4  # actually stochastic within the set
+    s = np.asarray(_gumbel_argmax(jnp.asarray(logits), jnp.float32(5.0),
+                                  jax.random.key(0), top_p=1e-6))
+    np.testing.assert_array_equal(s, greedy)
+
+    # engine level: knobs are honored by both sampler paths
+    cfg = _kv_cfg(sampling_top_k=1, sampling_temperature=9.0)
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    a = CompletionEngine(cfg, params).complete_tokens([1, 2, 3], None, 4)
+    b = CompletionEngine(cfg, params).complete_tokens([1, 2, 3], None, 4)
+    np.testing.assert_array_equal(a[:3], [1, 2, 3])
+    np.testing.assert_array_equal(a, b)  # top_k=1: greedy despite T=9
+
+    with pytest.raises(ValueError, match="sampling_top_k"):
+        _kv_cfg(sampling_top_k=999)
+    with pytest.raises(ValueError, match="sampling_top_p"):
+        _kv_cfg(sampling_top_p=0.0)
+
+
 def test_kv_cache_engine_routing():
     from homebrewnlp_tpu.infer.kv_cache import make_cached_text_sampler
     cfg = _kv_cfg(sequence_length=12, initial_autoregressive_position=4,
