@@ -64,7 +64,6 @@ class CompletionEngine:
         """``force_rebuild`` pins the rebuild-everything sampler even for
         KV-cache-eligible configs (the similarity debug mode exercises the
         production rebuild path, reference interface.py:283-302)."""
-        from ..infer.kv_cache import cache_eligible, make_cached_text_sampler
         self.cfg = cfg
         from ..models import pipeline_params_stacked, unstack_pipeline_params
         if pipeline_params_stacked(cfg, params):
@@ -73,19 +72,60 @@ class CompletionEngine:
             params = unstack_pipeline_params(cfg, params)
         self.params = params
         self.tokenizer = tokenizer_for(cfg)
+        self._force_rebuild = force_rebuild
         # prompt completion is inherently autoregressive: the engine always
         # uses an AR sampler (use_autoregressive_sampling=False only affects
         # the dataset-driven sample run mode, reference inference.py:136-170)
-        if cache_eligible(cfg) and not force_rebuild:
-            self._sampler = make_cached_text_sampler(cfg, params)
-        else:
-            self._sampler = make_text_sampler(cfg, params)
+        self._sampler = self._make_sampler(cfg)
+        self._samplers: typing.Dict[tuple, typing.Callable] = {}
+        self._samplers_lock = threading.Lock()
         self._rng = jax.random.key(cfg.data_seed)
         self._rng_lock = threading.Lock()
 
+    def _make_sampler(self, cfg: Config):
+        from ..infer.kv_cache import cache_eligible, make_cached_text_sampler
+        if cache_eligible(cfg) and not self._force_rebuild:
+            return make_cached_text_sampler(cfg, self.params)
+        return make_text_sampler(cfg, self.params)
+
+    def _sampler_for(self, top_k, top_p):
+        """Per-request truncation: the knobs are compile-time static, so
+        REQUESTED values are BUCKETED (k -> next power of two, p -> 0.05
+        grid) and one sampler is compiled and cached per bucket — a handful
+        of compilations serves every request mix.  An absent knob keeps the
+        config's exact value, un-bucketed."""
+        if top_k is None and top_p is None:
+            return self._sampler
+        cfg = self.cfg
+        if top_k is None:
+            k = cfg.sampling_top_k
+        else:
+            k = max(0, int(top_k))
+            if k > 0:
+                k = min(1 << (k - 1).bit_length(), cfg.vocab_size)
+        if top_p is None:
+            p = cfg.sampling_top_p
+        else:
+            p = float(top_p)
+            p = (1.0 if p >= 1.0
+                 else max(0.05, round(round(p / 0.05) * 0.05, 2)))
+        if (k, p) == (cfg.sampling_top_k, cfg.sampling_top_p):
+            return self._sampler
+        # a dedicated lock: a cold-bucket compile must not stall the RNG
+        # splits of concurrent knob-free requests
+        with self._samplers_lock:
+            if (k, p) not in self._samplers:
+                import copy
+                bcfg = copy.copy(cfg)
+                bcfg.sampling_top_k, bcfg.sampling_top_p = k, p
+                self._samplers[(k, p)] = self._make_sampler(bcfg)
+            return self._samplers[(k, p)]
+
     def complete_tokens(self, prompt: typing.Sequence[int],
                         temperature: typing.Optional[float] = None,
-                        max_tokens: typing.Optional[int] = None) -> np.ndarray:
+                        max_tokens: typing.Optional[int] = None,
+                        top_k: typing.Optional[int] = None,
+                        top_p: typing.Optional[float] = None) -> np.ndarray:
         """Returns the flat token stream (prompt + completion), truncated to
         ``len(prompt) + max_tokens`` tokens.  The sampler works in rows of
         ``token_patch_size`` tokens; the prompt is laid out row-major and the
@@ -104,7 +144,7 @@ class CompletionEngine:
             end_row = rows
         else:
             end_row = min(rows, -(-(len(prompt) + max_tokens) // patch))
-        out = self._sampler(
+        out = self._sampler_for(top_k, top_p)(
             NT(toks, TEXT_AXES), np.int32(prompt_rows),
             np.float32(cfg.sampling_temperature if temperature is None
                        else temperature),
@@ -114,9 +154,10 @@ class CompletionEngine:
                else min(rows * patch, len(prompt) + max_tokens))
         return out[:end]
 
-    def complete_text(self, prompt: str, temperature=None, max_tokens=None) -> str:
+    def complete_text(self, prompt: str, temperature=None, max_tokens=None,
+                      top_k=None, top_p=None) -> str:
         ids = self.tokenizer.encode(prompt)
-        out = self.complete_tokens(ids, temperature, max_tokens)
+        out = self.complete_tokens(ids, temperature, max_tokens, top_k, top_p)
         return self.tokenizer.decode(out[len(ids):])
 
 
@@ -156,10 +197,12 @@ class InterfaceWrapper:
                 out.put(("err", e))
 
     def complete(self, prompt: typing.Sequence[int], temperature: float = 0.0,
-                 response_len: int = 64, asynchronous: bool = False):
+                 response_len: int = 64, asynchronous: bool = False,
+                 top_k: typing.Optional[int] = None,
+                 top_p: typing.Optional[float] = None):
         out: "queue.Queue[tuple]" = queue.Queue(1)
         self._q.put((self.engine.complete_tokens,
-                     (prompt, temperature, response_len), out))
+                     (prompt, temperature, response_len, top_k, top_p), out))
 
         def fetch():
             while True:

@@ -44,19 +44,28 @@ class RestAPI:
         toks = body["prompt"]
         return {"tokens": _sanitize_tokens(toks, self.cfg.vocab_size)}
 
+    @staticmethod
+    def _truncation(body: dict) -> dict:
+        """Optional per-request top_k/top_p (bucketed compile per
+        CompletionEngine._sampler_for; absent keys keep the config's)."""
+        return {"top_k": (None if body.get("top_k") is None
+                          else int(body["top_k"])),
+                "top_p": (None if body.get("top_p") is None
+                          else float(body["top_p"]))}
+
     def token_completion(self, body: dict) -> dict:
         toks = _sanitize_tokens(body.get("prompt", body.get("tokens", [])),
                                 self.cfg.vocab_size)
         out = self.wrapper.complete(
             toks, float(body.get("temperature", self.cfg.sampling_temperature)),
-            int(body.get("response_len", 64)))
+            int(body.get("response_len", 64)), **self._truncation(body))
         return {"completion": np.asarray(out).tolist()}
 
     def completion(self, body: dict) -> dict:
         ids = self.engine.tokenizer.encode(body["prompt"])
         out = self.wrapper.complete(
             ids, float(body.get("temperature", self.cfg.sampling_temperature)),
-            int(body.get("response_len", 64)))
+            int(body.get("response_len", 64)), **self._truncation(body))
         return {"completion": self.engine.tokenizer.decode(
             np.asarray(out)[len(ids):])}
 

@@ -107,6 +107,10 @@ def test_rest_api_endpoints(cfg_params):
     comp = post("token_completion", {"prompt": [1, 2], "temperature": 0.0,
                                      "response_len": 3})
     assert comp["completion"][:2] == [1, 2]
+    # per-request truncation rides through the wrapper to the engine
+    trunc = post("token_completion", {"prompt": [1, 2], "temperature": 5.0,
+                                      "response_len": 3, "top_k": 1})
+    assert trunc["completion"][:2] == [1, 2]
     server.shutdown()
 
 
@@ -318,6 +322,27 @@ def test_truncated_sampling():
         _kv_cfg(sampling_top_k=999)
     with pytest.raises(ValueError, match="sampling_top_p"):
         _kv_cfg(sampling_top_p=0.0)
+
+
+def test_per_request_truncation_buckets():
+    """Per-request top_k/top_p: bucketed compile cache — k rounds to the
+    next power of two, repeated requests reuse one sampler, top_k=1 forces
+    greedy even though the engine's config is unrestricted and hot."""
+    cfg = _kv_cfg(sampling_temperature=9.0)
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    eng = CompletionEngine(cfg, params)
+    a = eng.complete_tokens([1, 2, 3], None, 4, top_k=1)
+    b = eng.complete_tokens([1, 2, 3], None, 4, top_k=1)
+    np.testing.assert_array_equal(a, b)  # greedy despite T=9
+    # k=3 and k=4 share the power-of-two bucket; p grid at 0.05
+    eng.complete_tokens([1], None, 2, top_k=3)
+    eng.complete_tokens([1], None, 2, top_k=4)
+    eng.complete_tokens([1], None, 2, top_p=0.52)
+    eng.complete_tokens([1], None, 2, top_p=0.50)
+    assert set(eng._samplers) == {(1, 1.0), (4, 1.0), (0, 0.5)}, eng._samplers
+    # no-knob requests keep using the default sampler (no extra compiles)
+    eng.complete_tokens([1], None, 2)
+    assert len(eng._samplers) == 3
 
 
 def test_kv_cache_engine_routing():
