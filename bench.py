@@ -1,8 +1,10 @@
 """Benchmark: tokens/sec/chip on the 32big_mixer architecture (BASELINE.md).
 
 Runs the flagship mixer LM (full 32big_mixer DSL/optimizer/dtype config,
-batch shrunk to fit one chip) for timed windows of train steps on whatever
-accelerator JAX selects, and prints ONE JSON line:
+batch shrunk to fit one chip) for 5 timed windows of train steps on whatever
+accelerator JAX selects, and prints ONE JSON line whose ``value`` is the
+MEDIAN window (``best`` and the raw ``windows_tok_s`` list expose the
+spread):
 
     {"metric": "tokens_per_sec_per_chip", "value": N, "unit": "tok/s/chip",
      "vs_baseline": R, ...}
@@ -51,7 +53,10 @@ def _peak_flops(device_kind: str):
 
 def main() -> None:
     from homebrewnlp_tpu.train import Trainer
-    from homebrewnlp_tpu.utils import load_config, random_text_batch
+    from homebrewnlp_tpu.utils import (enable_compilation_cache, load_config,
+                                       random_text_batch)
+
+    t_compile0 = time.perf_counter()
 
     # full 32big_mixer architecture (d_model 4096, depth 32x2 blocks, seq 512,
     # bf16, revnet, AGC+SM3+momentum); batch shrunk from the pod-scale 1024 to
@@ -64,6 +69,10 @@ def main() -> None:
     cfg = load_config("configs/32big_mixer.json", train_batch_size=8,
                       use_checkpointing=False, calc_accuracy=False, tpu_size=1,
                       slice_dtype="bfloat16")
+    # persistent XLA cache: a warm re-run of this script skips the flagship
+    # step compile (the cache key covers program + compile options + backend);
+    # honors the config's compilation_cache_dir knob like main.py
+    enable_compilation_cache(cfg.compilation_cache_dir)
     trainer = Trainer(cfg)
     batch = random_text_batch(cfg)
 
@@ -90,33 +99,39 @@ def main() -> None:
     # warmup: compile + let the device path reach steady state
     state, metrics = run_steps(3, state)
     float(metrics["loss"])
+    compile_and_warmup_s = time.perf_counter() - t_compile0
 
-    # best-of-N windows of 10 steps.  The window ends with a HOST PULL of the
-    # loss scalar, not block_until_ready: the experimental axon relay acks
+    # 5 windows of 10 steps.  Each window ends with a HOST PULL of the loss
+    # scalar, not block_until_ready: the experimental axon relay acks
     # readiness before execution completes (round-1 bench measured 6.5 ms/step
     # = 12x chip peak), but a device->host transfer of the final step's output
     # cannot complete until the whole dependency chain has — measured 193
     # ms/step, a physically sane 41% MFU on v5e.
-    n_steps = 10
-    best_dt = float("inf")
-    loss_after = None
-    # best-of-5: the relay's wall-clock jitter between windows is several
-    # percent; min() needs enough samples to reach the true step time.  The
+    #
+    # The relay's wall-clock jitter between windows is several percent, so
+    # the figure of record is the MEDIAN window (robust to one slow/fast
+    # outlier); the best window and the raw per-window list are reported
+    # alongside so the spread is visible (VERDICT r3 "what's weak" #2).  The
     # fixed-seed comparison loss stays pinned to the end of window 3 (step
     # 33 under the 3-warmup/10-step constants — the figure rounds 1-2
     # recorded) regardless of how many timing windows run.
+    n_steps = 10
+    window_dts = []
+    loss_after = None
     pin_step = step_i + 3 * n_steps
     for _ in range(5):
         t0 = time.perf_counter()
         state, metrics = run_steps(n_steps, state)
         window_loss = float(metrics["loss"])
-        best_dt = min(best_dt, time.perf_counter() - t0)
+        window_dts.append(time.perf_counter() - t0)
         if step_i == pin_step or loss_after is None and step_i >= pin_step:
             loss_after = window_loss
-    dt = best_dt
+    dt = sorted(window_dts)[len(window_dts) // 2]
+    best_dt = min(window_dts)
     tokens = cfg.train_batch_size * cfg.sequence_length * n_steps
     n_chips = max(1, len(jax.devices()))
     value = tokens / dt / n_chips
+    best_value = tokens / best_dt / n_chips
     ms_per_step = dt / n_steps * 1e3
 
     device_kind = jax.devices()[0].device_kind
@@ -125,8 +140,10 @@ def main() -> None:
     if peak and flops_per_step:
         mfu = flops_per_step * n_steps / dt / (peak * n_chips)
 
-    # round-over-round comparison keyed by device kind (the baseline file is
-    # machine-local state, .gitignored)
+    # round-over-round comparison keyed by device kind; bench_baseline.json
+    # is COMMITTED, so every round's vs_baseline shares one pinned
+    # denominator (21040.8 tok/s on v5e, the round-1 figure) instead of
+    # resetting per machine
     baselines = {}
     if os.path.exists(BASELINE_FILE):
         with open(BASELINE_FILE) as f:
@@ -139,14 +156,19 @@ def main() -> None:
 
     record = {
         "metric": "tokens_per_sec_per_chip",
+        # figure of record = median-of-5 windows; best + raw windows shown so
+        # the run-to-run spread is part of the record, not a narrative claim
         "value": round(value, 2),
         "unit": "tok/s/chip",
         "vs_baseline": round(value / baseline, 4),
+        "best": round(best_value, 2),
+        "windows_tok_s": [round(tokens / w / n_chips, 1) for w in window_dts],
         "ms_per_step": round(ms_per_step, 3),
         "flops_per_step": flops_per_step,
         "mfu": round(mfu, 4) if mfu is not None else None,
         "loss_after_n_steps": round(loss_after, 4),
         "n_steps_total": step_i,
+        "compile_and_warmup_s": round(compile_and_warmup_s, 1),
         "device": device_kind,
         "n_chips": n_chips,
     }

@@ -149,6 +149,10 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     use_checkpointing=False,
     max_checkpoints_keep=1,
     model_path="runs/default",
+    # persistent XLA compilation cache directory (None = env var or per-user
+    # default, "" = disabled; consumed at the CLI/bench entry points via
+    # utils.enable_compilation_cache)
+    compilation_cache_dir=None,
     # dtypes (storage/compute/optimizer policy; reference dataclass.py:82-86)
     storage_dtype="float32",
     slice_dtype="float32",

@@ -61,7 +61,7 @@ def _build_state(cfg, batch, mesh=None):
     if cfg.use_checkpointing:
         ckpt = Checkpointer(os.path.join(cfg.model_path, "ckpt"),
                             cfg.max_checkpoints_keep)
-        state, data_state = ckpt.restore(state)
+        state, data_state = ckpt.restore(state, cfg)
         color_print(f"restored step {int(state.step)} from checkpoints"
                     if int(state.step) else "fresh initialization")
     return trainer, state, ckpt, data_state
@@ -195,7 +195,7 @@ def _params_for_serving(cfg):
     if cfg.use_checkpointing:
         from .train import Checkpointer, Trainer
         state = Trainer(cfg).init(batch)
-        state, _ = Checkpointer(os.path.join(cfg.model_path, "ckpt")).restore(state)
+        state, _ = Checkpointer(os.path.join(cfg.model_path, "ckpt")).restore(state, cfg)
         params = state.params
     else:
         from .models import init_params
@@ -412,6 +412,8 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> None:
         else:
             raw["train_batch_size"] = 1
     cfg = Config(raw)
+    from .utils import enable_compilation_cache
+    enable_compilation_cache(cfg.compilation_cache_dir)
     if args.debug_grad:
         cfg.debug_gradients = True
     if args.workers is not None:  # reference src/main.py:60

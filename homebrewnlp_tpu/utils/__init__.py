@@ -10,6 +10,31 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def enable_compilation_cache(path: typing.Optional[str] = None):
+    """Point XLA's persistent compilation cache at ``path`` so warm restarts
+    skip the expensive compiles (~40 s for the d4096 sampler, ~25 s for the
+    flagship step on the relay — BASELINE.md).
+
+    Resolution order: explicit ``path`` argument (the ``compilation_cache_dir``
+    config knob) > ``HBNLP_COMPILATION_CACHE_DIR`` env var > a per-user
+    default.  An empty string at any level disables caching.  Returns the
+    directory in use, or None when disabled."""
+    import jax
+    if path is None:
+        path = os.environ.get("HBNLP_COMPILATION_CACHE_DIR",
+                              "~/.cache/homebrewnlp_tpu/xla")
+    if not path:
+        return None
+    path = os.path.expanduser(path)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every compile: the default 1 s floor would skip medium programs
+    # whose relay round-trip still dominates a warm restart
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path
+
+
 def load_config(path: str, **overrides):
     """Config from JSON with keyword overrides applied before derivation."""
     from ..config import Config
