@@ -448,7 +448,11 @@ def stack_pipeline_params(cfg: Config, params, axes=None):
     rules map to the pipeline mesh axis — params AND optimizer slots then
     live 1/P-sharded per device with no per-step gather (the residency the
     reference's model parallelism never had; our PP extension, SURVEY.md
-    §2.12).  Returns ``params`` or ``(params, axes)`` matching the input."""
+    §2.12).  Returns ``params`` or ``(params, axes)`` matching the input.
+
+    Values may be arrays OR pytrees of arrays (e.g. per-param optimizer slot
+    dicts, whose structure is identical across depths) — each leaf is stacked
+    stage-wise, which is what the flat->stacked checkpoint migration needs."""
     from ..config import PIPE_STAGE
     seq, g = _pipeline_seq(cfg)
     P = cfg.pipeline_parallel
@@ -469,7 +473,7 @@ def stack_pipeline_params(cfg: Config, params, axes=None):
                     del out[src]
                     if new_axes is not None:
                         del new_axes[src]
-            out[k] = jnp.stack(parts)
+            out[k] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *parts)
             if new_axes is not None:
                 new_axes[k] = (PIPE_STAGE,) + tuple(new_axes[k])
     return out if axes is None else (out, new_axes)

@@ -57,9 +57,14 @@ class Checkpointer:
     def latest_step(self) -> typing.Optional[int]:
         return self.manager.latest_step()
 
-    def restore(self, template: TrainState
+    def restore(self, template: TrainState, cfg=None
                 ) -> typing.Tuple[TrainState, typing.Optional[dict]]:
-        """Restore the latest checkpoint onto the template's shardings."""
+        """Restore the latest checkpoint onto the template's shardings.
+
+        With ``cfg`` given and ``pipeline_parallel > 1``, checkpoints written
+        before stage-stacked pipeline residency (flat per-depth layout) are
+        detected by key-set mismatch and migrated in place of a structure
+        error (a one-time host-memory round trip)."""
         step = self.latest_step()
         if step is None:
             return template, None
@@ -68,8 +73,16 @@ class Checkpointer:
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
             tree)
-        restored = self.manager.restore(
-            step, args=ocp.args.StandardRestore(abstract))
+        try:
+            restored = self.manager.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        except ValueError as e:
+            # structure mismatch: possibly a pre-stage-stacked pipeline
+            # checkpoint (flat per-depth layout) — migrate if so; any other
+            # ValueError is re-raised unchanged from the migration probe
+            if cfg is None or getattr(cfg, "pipeline_parallel", 1) <= 1:
+                raise
+            return self._restore_flat_pipeline(step, template, cfg, e)
         data_state = None
         data_path = os.path.join(self.path, f"data_state_{step}.json")
         if os.path.exists(data_path):
@@ -77,6 +90,40 @@ class Checkpointer:
                 data_state = json.load(f)
         return TrainState(restored["params"], restored["opt_state"],
                           restored["step"]), data_state
+
+    def _restore_flat_pipeline(self, step: int, template: TrainState, cfg,
+                               original: Exception
+                               ) -> typing.Tuple[TrainState,
+                                                 typing.Optional[dict]]:
+        """One-time migration: restore a flat per-depth pipeline checkpoint
+        as saved (host numpy — a one-off host-memory round trip), stack
+        params AND optimizer slots into the stage-stacked layout, and place
+        them onto the template's shardings.  If the checkpoint turns out to
+        already be stage-stacked, ``original`` (the structure error from the
+        normal restore) is the real problem and is re-raised unchanged."""
+        from ..models import pipeline_params_stacked, stack_pipeline_params
+        raw = self.manager.restore(step, args=ocp.args.StandardRestore(None))
+        if pipeline_params_stacked(cfg, raw["params"]):
+            raise original
+        print(f"NOTE: checkpoint at step {step} predates stage-stacked "
+              "pipeline residency; migrating flat per-depth layout in place")
+        params = stack_pipeline_params(cfg, raw["params"])
+        opt_state = stack_pipeline_params(cfg, raw["opt_state"])
+
+        def put(t, v):
+            return jax.device_put(jnp.asarray(v).astype(t.dtype), t.sharding)
+
+        params = jax.tree_util.tree_map(put, dict(template.params), params)
+        opt_state = jax.tree_util.tree_map(put, dict(template.opt_state),
+                                           opt_state)
+        state = TrainState(params, opt_state,
+                           put(template.step, raw["step"]))
+        data_state = None
+        data_path = os.path.join(self.path, f"data_state_{step}.json")
+        if os.path.exists(data_path):
+            with open(data_path) as f:
+                data_state = json.load(f)
+        return state, data_state
 
 
 def current_step(model_path: str) -> int:

@@ -648,3 +648,56 @@ def test_gpipe_op_matches_sequential(eight_devices):
     np.testing.assert_allclose(lp, ls, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_flat_checkpoint_migration(eight_devices, tmp_path):
+    """Checkpoints written before stage-stacked pipeline residency (flat
+    per-depth params + flat optimizer slots) restore into the stacked
+    template via the one-time migration in Checkpointer.restore."""
+    import zlib
+
+    from homebrewnlp_tpu.config import Config
+    from homebrewnlp_tpu.models import init_params, stack_pipeline_params
+    from homebrewnlp_tpu.optim import Optimizer
+    from homebrewnlp_tpu.train.state import TrainState
+
+    cfgp = Config(_pipe_base(pipeline_parallel=2))
+    batch = text_batch(cfgp)
+    params, axes = init_params(cfgp, batch)  # flat per-depth layout
+
+    # distinct constant per (param, slot) leaf so the migration's key mapping
+    # is actually verified, not just its shapes
+    opt_state = {
+        name: {slot: jnp.full(v.shape, zlib.crc32((name + slot).encode())
+                              % 1000 / 100.0, v.dtype)
+               for slot, v in slots.items()}
+        for name, slots in Optimizer(cfgp, axes).init(params).items()}
+    flat_state = TrainState(params, opt_state, jnp.asarray(7, jnp.int32))
+    ckpt = Checkpointer(str(tmp_path / "flat_ckpt"))
+    ckpt.save(flat_state, data_state={"pos": 2})
+    ckpt.wait()
+
+    trainer = Trainer(cfgp)
+    template = trainer.init(batch)
+    assert set(template.params) != set(params)  # layouts genuinely differ
+    restored, data_state = Checkpointer(str(tmp_path / "flat_ckpt")).restore(
+        template, cfgp)
+    assert data_state == {"pos": 2}
+    assert int(restored.step) == 7
+
+    want_params = stack_pipeline_params(cfgp, params)
+    want_opt = stack_pipeline_params(cfgp, opt_state)
+    for k in template.params:
+        np.testing.assert_array_equal(np.asarray(restored.params[k]),
+                                      np.asarray(want_params[k]), err_msg=k)
+        assert (restored.params[k].sharding.spec
+                == template.params[k].sharding.spec), k
+        for slot in template.opt_state[k]:
+            np.testing.assert_array_equal(
+                np.asarray(restored.opt_state[k][slot]),
+                np.asarray(want_opt[k][slot]), err_msg=f"{k}:{slot}")
+
+    # the migrated state must actually train
+    state2, metrics = trainer.step(restored, batch, jax.random.key(0))
+    assert int(state2.step) == 8
+    assert np.isfinite(float(metrics["loss"]))
