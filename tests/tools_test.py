@@ -98,6 +98,25 @@ def test_vtt_karaoke_word_timing():
     # HTML tags inside <c> are stripped ("captions")
 
 
+def test_vtt_karaoke_duplicate_lead_discrimination():
+    """A rolling restated lead across a CONTIGUOUS cue boundary collapses;
+    a genuine duplicate word after a silence gap is kept."""
+    from vtt_align import parse_timed_words
+    rolling = ("WEBVTT\n\n00:00:00.000 --> 00:00:02.100\n"
+               "hello<00:00:00.700><c> new</c>\n\n"
+               "00:00:02.100 --> 00:00:04.000\n"
+               "new\nnew<00:00:02.800><c> world</c>\n")
+    assert [w.word for w in parse_timed_words(rolling)] == [
+        "hello", "new", "world"]
+    gapped = ("WEBVTT\n\n00:00:00.000 --> 00:00:02.000\n"
+              "hello<00:00:00.700><c> yeah</c>\n\n"
+              "00:00:05.000 --> 00:00:07.000\n"
+              "yeah\nyeah<00:00:05.800><c> right</c>\n")
+    words = parse_timed_words(gapped)
+    assert [w.word for w in words] == ["hello", "yeah", "yeah", "right"]
+    assert words[2].time == 5.0  # the kept duplicate starts at its cue
+
+
 def test_vtt_cue_interpolation():
     from vtt_align import parse_timed_words
     content = ("WEBVTT\n\n00:00:01.000 --> 00:00:03.000\n"

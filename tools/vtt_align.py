@@ -53,10 +53,18 @@ def parse_timed_words(content: str) -> typing.List[TimedWord]:
 def _parse_karaoke(content: str) -> typing.List[TimedWord]:
     out: typing.List[TimedWord] = []
     cue_start: typing.Optional[float] = None
+    cue_end: typing.Optional[float] = None
+    prev_cue_end: typing.Optional[float] = None
+    emitted_from_prev_cue = False  # out[-1] came from the preceding cue
+    cur_emitted = False
     for raw in content.split("\n"):
         m = _CUE_RE.search(raw)
         if m:
+            prev_cue_end = cue_end
+            emitted_from_prev_cue = cur_emitted
+            cur_emitted = False
             cue_start = _seconds(*m.groups()[:4])
+            cue_end = _seconds(*m.groups()[4:])
             continue
         if "<c>" not in raw:
             # rolling-window repeat of the previous line (or header/blank)
@@ -64,20 +72,29 @@ def _parse_karaoke(content: str) -> typing.List[TimedWord]:
         # lead text before the first inline timestamp: the cue's first word
         # when fresh, or a rolling repeat of the last emitted word (YouTube's
         # tagged line restates the previous line's final word as its lead).
-        # Equality with the previous word is the discriminator; a GENUINE
-        # immediate duplicate spanning a cue boundary ("yeah | yeah right")
-        # therefore collapses to one occurrence — preferred over the rolling
-        # repeat duplicating a word at every cue boundary (the reference
-        # instead concatenates repeats into the neighboring word,
+        # The discriminator is equality with the previous word PLUS cue
+        # adjacency: the restate only happens when the previous cue emitted
+        # that word and this cue's window abuts it in time.  A genuine
+        # duplicate after a silence gap ("yeah <pause> yeah right") is
+        # therefore kept; only an immediate duplicate across a CONTIGUOUS
+        # boundary still collapses — preferred over the rolling repeat
+        # duplicating a word at every cue boundary (the reference instead
+        # concatenates repeats into the neighboring word,
         # video2tfrecord.py:218-241, which double-counts them)
         lead = _TAG_RE.sub("", _INLINE_TS_RE.split(raw, 1)[0]).strip()
-        if lead and not (out and out[-1].word == lead):
+        contiguous = (prev_cue_end is not None and cue_start is not None
+                      and abs(cue_start - prev_cue_end) <= 0.101)
+        rolling = (out and out[-1].word == lead
+                   and emitted_from_prev_cue and contiguous)
+        if lead and not rolling:
             out.append(TimedWord(cue_start if cue_start is not None else 0.0,
                                  lead))
+            cur_emitted = True
         for h, mi, s, frac, word in _KARAOKE_RE.findall(raw):
             word = _TAG_RE.sub("", word).strip()
             if word:
                 out.append(TimedWord(_seconds(h, mi, s, frac), word))
+                cur_emitted = True
     return out
 
 
