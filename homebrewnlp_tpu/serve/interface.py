@@ -53,6 +53,27 @@ def tokenizer_for(cfg: Config):
         return ByteTokenizer()
 
 
+def effective_truncation(cfg: Config, top_k, top_p) -> typing.Tuple[int, float]:
+    """The (k, p) bucket a request's truncation knobs actually compile to:
+    k rounds up to the next power of two (capped at vocab), p snaps to a
+    0.05 grid.  None keeps the config's exact value, un-bucketed.  Exposed
+    so the REST layer can echo the EFFECTIVE values back to callers (e.g.
+    requested top_k=3 samples top-4)."""
+    if top_k is None:
+        k = cfg.sampling_top_k
+    else:
+        k = max(0, int(top_k))
+        if k > 0:
+            k = min(1 << (k - 1).bit_length(), cfg.vocab_size)
+    if top_p is None:
+        p = cfg.sampling_top_p
+    else:
+        p = float(top_p)
+        p = (1.0 if p >= 1.0
+             else max(0.05, round(round(p / 0.05) * 0.05, 2)))
+    return k, p
+
+
 class CompletionEngine:
     """Jit-compiled prompt completion (the reference's query loop,
     interface.py:177-220, with the padding behavior of ``complete``:
@@ -90,25 +111,14 @@ class CompletionEngine:
 
     def _sampler_for(self, top_k, top_p):
         """Per-request truncation: the knobs are compile-time static, so
-        REQUESTED values are BUCKETED (k -> next power of two, p -> 0.05
-        grid) and one sampler is compiled and cached per bucket — a handful
-        of compilations serves every request mix.  An absent knob keeps the
+        REQUESTED values are BUCKETED (``effective_truncation``) and one
+        sampler is compiled and cached per bucket — a handful of
+        compilations serves every request mix.  An absent knob keeps the
         config's exact value, un-bucketed."""
         if top_k is None and top_p is None:
             return self._sampler
         cfg = self.cfg
-        if top_k is None:
-            k = cfg.sampling_top_k
-        else:
-            k = max(0, int(top_k))
-            if k > 0:
-                k = min(1 << (k - 1).bit_length(), cfg.vocab_size)
-        if top_p is None:
-            p = cfg.sampling_top_p
-        else:
-            p = float(top_p)
-            p = (1.0 if p >= 1.0
-                 else max(0.05, round(round(p / 0.05) * 0.05, 2)))
+        k, p = effective_truncation(cfg, top_k, top_p)
         if (k, p) == (cfg.sampling_top_k, cfg.sampling_top_p):
             return self._sampler
         # a dedicated lock: a cold-bucket compile must not stall the RNG

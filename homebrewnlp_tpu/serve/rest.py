@@ -44,30 +44,37 @@ class RestAPI:
         toks = body["prompt"]
         return {"tokens": _sanitize_tokens(toks, self.cfg.vocab_size)}
 
-    @staticmethod
-    def _truncation(body: dict) -> dict:
-        """Optional per-request top_k/top_p (bucketed compile per
-        CompletionEngine._sampler_for; absent keys keep the config's)."""
-        return {"top_k": (None if body.get("top_k") is None
-                          else int(body["top_k"])),
-                "top_p": (None if body.get("top_p") is None
-                          else float(body["top_p"]))}
+    def _truncation(self, body: dict) -> typing.Tuple[dict, dict]:
+        """Optional per-request top_k/top_p -> (sampler kwargs, echo dict).
+
+        Requested values are silently bucketed for the compile cache
+        (interface.effective_truncation), so completion responses echo the
+        EFFECTIVE values actually sampled with (e.g. top_k=3 -> top_k: 4)."""
+        from .interface import effective_truncation
+        kwargs = {"top_k": (None if body.get("top_k") is None
+                            else int(body["top_k"])),
+                  "top_p": (None if body.get("top_p") is None
+                            else float(body["top_p"]))}
+        k, p = effective_truncation(self.cfg, **kwargs)
+        return kwargs, {"top_k": k, "top_p": p}
 
     def token_completion(self, body: dict) -> dict:
         toks = _sanitize_tokens(body.get("prompt", body.get("tokens", [])),
                                 self.cfg.vocab_size)
+        kwargs, echo = self._truncation(body)
         out = self.wrapper.complete(
             toks, float(body.get("temperature", self.cfg.sampling_temperature)),
-            int(body.get("response_len", 64)), **self._truncation(body))
-        return {"completion": np.asarray(out).tolist()}
+            int(body.get("response_len", 64)), **kwargs)
+        return dict({"completion": np.asarray(out).tolist()}, **echo)
 
     def completion(self, body: dict) -> dict:
         ids = self.engine.tokenizer.encode(body["prompt"])
+        kwargs, echo = self._truncation(body)
         out = self.wrapper.complete(
             ids, float(body.get("temperature", self.cfg.sampling_temperature)),
-            int(body.get("response_len", 64)), **self._truncation(body))
-        return {"completion": self.engine.tokenizer.decode(
-            np.asarray(out)[len(ids):])}
+            int(body.get("response_len", 64)), **kwargs)
+        return dict({"completion": self.engine.tokenizer.decode(
+            np.asarray(out)[len(ids):])}, **echo)
 
     ENDPOINTS = ("encode", "decode", "check_tokens", "token_completion",
                  "completion")
