@@ -3,11 +3,13 @@ lacks (its while-loop sampler rebuilds the full forward per token,
 /root/reference/src/run/inference.py:75-124; SURVEY.md §7 item 7 names the
 cache as the intended improvement).
 
-Eligibility: every sequence-mixing layer must be a causal ``dot_product``
-attention (the K/V pair is the only cross-position state).  Mixer bias-map
-attention, cumsum/cummean, convolution and transpose_sequence_features carry
-different cross-position state and keep the rebuild-everything sampler
-(infer/sampler.py).
+Eligibility: every sequence-mixing layer must be an ``attention`` layer —
+causal ``dot_product`` (K/V cached) or the learned-map family
+(``biased_softmax`` / ``biased_attention_map`` / ``scale_attention_map``,
+the flagship mixer: V cached, map rows gathered per step —
+models/layers.py::_cached_attention).  cumsum/cummean, convolution and
+transpose_sequence_features carry different cross-position state and keep
+the rebuild-everything sampler (infer/sampler.py).
 
 The cached sampler PREFILLS the prompt with one full-length forward that
 writes every prompt position's K/V at once, then runs one model call per
@@ -55,14 +57,22 @@ def cache_eligible(cfg: Config) -> bool:
             if name in _SEQUENCE_MIXERS:
                 return False
             if name == "attention":
-                if "dot_product" not in parts:
+                # dot_product caches K/V; the learned-map family caches V and
+                # gathers map rows (flagship mixer).  input_as_value is
+                # positionwise — cacheable under either.  An attention with
+                # neither flag family raises in the layer itself.
+                if "dot_product" not in parts and not any(
+                        f in parts for f in _MAP_FLAGS):
                     return False
-                if any(f in parts for f in _MAP_FLAGS):
+                if any(f in parts for f in _MAP_FLAGS) and 0 not in tuple(
+                        cfg.masked_attention_dimensions):
+                    # an UNMASKED map attends to future positions; the cache
+                    # holds stale prefill values there while the rebuild
+                    # sampler recomputes them per step — silent divergence,
+                    # so unmasked map layers keep the rebuild path.  (The
+                    # pure dot-product softmax is causal unconditionally,
+                    # reference spatial.py:68, hence exempt.)
                     return False
-                if "input_as_value" in parts:
-                    # value = raw input row: positionwise, cacheable — but the
-                    # layer also needs dot_product (checked above)
-                    pass
     return True
 
 

@@ -434,11 +434,24 @@ def _cached_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
     """KV-cache decode (the fast path the reference lacks, SURVEY.md §7
     item 7): the layer sees ``R`` rows starting at absolute position
     ``ctx.decode.pos`` — R=1 for incremental decode, R=prompt length for the
-    prefill pass that writes the whole prompt's K/V in one forward.  The
-    rows' K/V are written into the layer's cache and the dot-product runs
-    against the cached prefix under a per-row causal mask.  Greedy outputs
-    match the rebuild-everything sampler because every logit depends only on
-    causally visible positions."""
+    prefill pass that writes the whole prompt's K/V in one forward.
+
+    Two families share this path:
+
+    * ``dot_product``: the rows' K/V are written into the layer's cache and
+      the dot-product runs against the cached prefix under a per-row causal
+      mask.
+    * learned maps (``biased_softmax`` / ``biased_attention_map`` /
+      ``scale_attention_map`` — the flagship mixer,
+      /root/reference/src/model/spatial.py:65-75, whose semantics are
+      ``out[s] = sum_{t<=s} map[h,s,t] * v[t]``): only V is cached; the
+      seq x seq map is built FULL-LENGTH (same scope walk and param shapes
+      as training, like ``positional_embed``) and rows ``[pos, pos+R)`` are
+      sliced out — O(seq * d) per decoded token instead of the rebuild
+      sampler's O(seq * full forward).
+
+    Greedy outputs match the rebuild-everything sampler because every
+    output depends only on causally visible positions."""
     ctx = args.ctx
     cfg = args.cfg
     dc = ctx.decode
@@ -447,31 +460,60 @@ def _cached_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
     order = (batch_axis, dim, HEADS, KEY)
     tmp = anonymize_name(dim)
     cdtype = cfg.calculation_dtype
+    has_dot = "dot_product" in args
 
     cache_id = f"attn{ctx.attention_idx}"
-    k_cur = key.transpose_to(order).x.astype(cdtype)   # [b, 1, h, dk]
-    v_cur = val.transpose_to(order).x.astype(cdtype)
+    v_cur = val.transpose_to(order).x.astype(cdtype)   # [b, R, h, dk]
+    n_rows = v_cur.shape[1]
     if cache_id in dc.caches:
-        k_cache, v_cache = dc.caches[cache_id]
+        cached = dc.caches[cache_id]
     else:  # template-building call: allocate zeroed full-length caches
-        shape = (k_cur.shape[0], dc.seq) + k_cur.shape[2:]
-        k_cache = jnp.zeros(shape, cdtype)
-        v_cache = jnp.zeros(shape, cdtype)
-    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_cur, dc.pos, 1)
+        shape = (v_cur.shape[0], dc.seq) + v_cur.shape[2:]
+        cached = tuple(jnp.zeros(shape, cdtype)
+                       for _ in range(2 if has_dot else 1))
+    if has_dot:
+        k_cache, v_cache = cached
+        k_cur = key.transpose_to(order).x.astype(cdtype)
+        k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_cur,
+                                                      dc.pos, 1)
+    else:
+        v_cache, = cached
     v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_cur, dc.pos, 1)
-    dc.caches[cache_id] = (k_cache, v_cache)
+    dc.caches[cache_id] = (k_cache, v_cache) if has_dot else (v_cache,)
 
-    kn = NT(k_cache, (batch_axis, tmp, HEADS, KEY))
-    logit = nd.einsum([qry.transpose_to(order), kn],
-                      (batch_axis, dim, HEADS, tmp))
-    # per-row causal mask: query row r (absolute position pos+r) sees cached
-    # positions <= pos+r only
-    q_abs = dc.pos + jnp.arange(k_cur.shape[1])
+    # per-row causal visibility: query row r (absolute position pos+r) sees
+    # cached positions <= pos+r only
+    q_abs = dc.pos + jnp.arange(n_rows)
     vis = (jnp.arange(dc.seq)[None, :] <= q_abs[:, None]).astype(cdtype)
-    logit = logit + NT((1 - vis) * jnp.asarray(-2e38, cdtype), (dim, tmp))
-    logit = logit - nd.stop_gradient(nd.reduce_max(logit, reduced=[tmp]))
-    logit = NT(jnp.exp(logit.x), logit.names)
-    logit = logit / nd.reduce_sum(logit, reduced=[tmp])
+
+    def map_rows(a: Args) -> NT:
+        """Rows [pos, pos+R) of the learned per-head seq x seq map, causally
+        zeroed when the axis is masked (dense-path ``bias * mask``)."""
+        bias = embed(a, [(HEADS, cfg.heads), (dim, dc.seq), (tmp, dc.seq)])
+        bx = bias.transpose_to((HEADS, dim, tmp)).x.astype(cdtype)
+        rows = NT(jax.lax.dynamic_slice_in_dim(bx, dc.pos, n_rows, 1),
+                  (HEADS, dim, tmp))
+        return rows * NT(vis, (dim, tmp)) if is_masked(a) else rows
+
+    logit: typing.Optional[NT] = None
+    if has_dot:
+        kn = NT(k_cache, (batch_axis, tmp, HEADS, KEY))
+        logit = nd.einsum([qry.transpose_to(order), kn],
+                          (batch_axis, dim, HEADS, tmp))
+    if "biased_softmax" in args:
+        b = map_rows(args)
+        logit = b if logit is None else logit + b
+    if logit is not None:
+        logit = logit + NT((1 - vis) * jnp.asarray(-2e38, cdtype), (dim, tmp))
+        logit = logit - nd.stop_gradient(nd.reduce_max(logit, reduced=[tmp]))
+        logit = NT(jnp.exp(logit.x), logit.names)
+        logit = logit / nd.reduce_sum(logit, reduced=[tmp])
+    if "biased_attention_map" in args:
+        b = map_rows(args)
+        logit = b if logit is None else logit + b
+    if "scale_attention_map" in args:
+        b = map_rows(args)
+        logit = b if logit is None else logit * b
     out = nd.einsum([logit, NT(v_cache, (batch_axis, tmp, HEADS, KEY))],
                     t.names)
     return out
@@ -506,8 +548,10 @@ def attention(args: Args) -> NT:
 
     dim = get_attention_dim(args).dim
     qry, key, val_src = _qkv(args, base, dim)
-    if (ctx.decode is not None and dim == SEQUENCE
-            and "dot_product" in args):
+    if ctx.decode is not None and dim == SEQUENCE and (
+            "dot_product" in args
+            or any(f in args for f in ("biased_softmax", "biased_attention_map",
+                                       "scale_attention_map"))):
         return _cached_attention(args, qry, key, val_src, dim)
     if _ring_eligible(args, dim):
         return _ring_attention(args, qry, key, val_src, dim)

@@ -107,10 +107,17 @@ def test_rest_api_endpoints(cfg_params):
     comp = post("token_completion", {"prompt": [1, 2], "temperature": 0.0,
                                      "response_len": 3})
     assert comp["completion"][:2] == [1, 2]
-    # per-request truncation rides through the wrapper to the engine
+    # per-request truncation rides through the wrapper to the engine, and the
+    # response echoes the EFFECTIVE (bucketed) knobs: top_k=3 compiles the
+    # top-4 bucket, top_p snaps to the 0.05 grid
     trunc = post("token_completion", {"prompt": [1, 2], "temperature": 5.0,
                                       "response_len": 3, "top_k": 1})
     assert trunc["completion"][:2] == [1, 2]
+    assert trunc["top_k"] == 1 and trunc["top_p"] == cfg.sampling_top_p
+    bucketed = post("token_completion", {"prompt": [1, 2], "temperature": 5.0,
+                                         "response_len": 3, "top_k": 3,
+                                         "top_p": 0.42})
+    assert bucketed["top_k"] == 4 and bucketed["top_p"] == 0.4
     server.shutdown()
 
 
@@ -233,11 +240,17 @@ def test_kv_cache_eligibility():
     assert cache_eligible(_kv_cfg())
     # decode-mode slicing of the initial position table is wired up
     assert cache_eligible(_kv_cfg(use_initial_position_embedding=True))
-    # mixer bias maps keep the rebuild path
-    assert not cache_eligible(mixer_config())
-    assert not cache_eligible(_kv_cfg(block_config=[
+    # mixer bias maps cache V + gather map rows (round 4; the flagship's
+    # own architecture finally gets the fast sampler)
+    assert cache_eligible(mixer_config())
+    assert cache_eligible(_kv_cfg(block_config=[
         {"layer": ["attention-biased_attention_map-absolute-input_as_value"]}]))
+    # non-attention sequence mixers keep the rebuild path
     assert not cache_eligible(_kv_cfg(block_config=[{"layer": ["cummean"]}]))
+    # UNMASKED map attention attends to future positions (stale in the
+    # cache): rebuild-only; the unconditionally-causal dot product is exempt
+    assert not cache_eligible(mixer_config(masked_attention_dimensions=[]))
+    assert cache_eligible(_kv_cfg(masked_attention_dimensions=[]))
 
 
 def test_kv_cache_initial_position_embedding_parity():
@@ -281,6 +294,49 @@ def test_kv_cache_greedy_matches_rebuild():
     b = np.asarray(cached(nt, np.int32(5), np.float32(0.0), jax.random.key(0),
                           np.int32(9)))
     np.testing.assert_array_equal(a, b)
+
+
+def test_kv_cache_mixer_greedy_matches_rebuild():
+    """The flagship mixer architecture (biased_attention_map + input_as_value
+    + shared, no dot product) decodes against the V-cache + map-row gather
+    path; greedy tokens must match the rebuild sampler (VERDICT r3 item 2)."""
+    from homebrewnlp_tpu.infer import cache_eligible, make_cached_text_sampler
+    cfg = mixer_config(memory_reduction_strategy="none")
+    assert cache_eligible(cfg)
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    toks = np.zeros((2, cfg.sequence_length, 1), np.int32)
+    toks[0, :5, 0] = [3, 14, 15, 9, 2]
+    toks[1, :5, 0] = [1, 1, 2, 3, 5]
+    nt = NT(jax.numpy.asarray(toks), TEXT_AXES)
+
+    rebuild = make_text_sampler(cfg, params)
+    cached = make_cached_text_sampler(cfg, params)
+    a = np.asarray(rebuild(nt, np.int32(5), np.float32(0.0), jax.random.key(0)))
+    b = np.asarray(cached(nt, np.int32(5), np.float32(0.0), jax.random.key(0)))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_kv_cache_map_flag_variants_match_rebuild():
+    """biased_softmax (map + softmax) and scale_attention_map (map scaling a
+    dot-product softmax) both decode cached with greedy parity."""
+    from homebrewnlp_tpu.infer import cache_eligible, make_cached_text_sampler
+    for block in (["norm-shift-scale",
+                   "attention-in:relu-biased_softmax-dot_product-embedded-absolute"],
+                  ["norm-shift-scale",
+                   "attention-biased_softmax-absolute-input_as_value"],
+                  ["norm-shift-scale",
+                   "attention-in:relu-scale_attention_map-dot_product-embedded-absolute"]):
+        cfg = _kv_cfg(block_config=[{"layer": block}])
+        assert cache_eligible(cfg)
+        params, _ = init_params(cfg, random_text_batch(cfg))
+        toks = np.zeros((1, cfg.sequence_length, 1), np.int32)
+        toks[0, :4, 0] = [3, 14, 15, 9]
+        nt = NT(jax.numpy.asarray(toks), TEXT_AXES)
+        a = np.asarray(make_text_sampler(cfg, params)(
+            nt, np.int32(4), np.float32(0.0), jax.random.key(0)))
+        b = np.asarray(make_cached_text_sampler(cfg, params)(
+            nt, np.int32(4), np.float32(0.0), jax.random.key(0)))
+        np.testing.assert_array_equal(a, b, err_msg=str(block))
 
 
 def test_truncated_sampling():
