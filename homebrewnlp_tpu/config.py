@@ -142,6 +142,17 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     multi_loss_strategy="linear",
     memory_reduction_strategy="revnet",
     momentumnet_alpha=0.99,
+    # precision squash (round through the given dtype) on the cotangent
+    # streams BETWEEN reversible blocks during backward ("" = exact).
+    # Measured round 4: under bf16 calculation_dtype the streams are
+    # ALREADY bf16 (bit-identical loss, byte-identical step with
+    # "bfloat16" set — docs/perf/README.md), so this only affects
+    # f32-calculation configs.
+    reversible_cotangent_dtype="",
+    # jax.checkpoint each reversible block's backward replay: recompute
+    # block internals instead of storing residuals — FLOPs for HBM bytes,
+    # a win on bandwidth-bound workloads (docs/perf/README.md round 4)
+    reversible_remat_blocks=False,
     debug_train_step=False,
     debug_gradients=False,
     current_step=0,
@@ -264,8 +275,7 @@ class Config:
         # GPipe pipeline parallelism (ops/pipeline.py): stages must cut the
         # depth loop evenly, compose with none/checkpoint rematerialization
         # only (reversible chains carry custom_vjp state across stages), and
-        # v1 excludes the sequence-parallel ring and cross-depth shared
-        # weights (their single tensor cannot be stage-stacked).
+        # excludes the sequence-parallel ring (nested shard_map regions).
         if self.pipeline_parallel < 1:
             raise ValueError("pipeline_parallel must be a positive integer")
         body_specs = [spec for blk in self.block_config
@@ -287,10 +297,10 @@ class Config:
                     "pipeline_parallel supports text (gpt) models only: the "
                     "multi-axis attention rotation depends on the global "
                     "depth index, which is dynamic inside a pipeline stage")
-            if any("shared" in s.split("-") for s in body_specs):
-                raise ValueError(
-                    "pipeline_parallel cannot stage-stack cross-depth "
-                    "'shared' weights")
+            # cross-depth 'shared' weights compose since round 4: the tensor
+            # is replicated per stage and its grad stage-summed
+            # (models.sync_shared_pipeline_grads), preserving exact sharing
+            # semantics — the flagship's shared mixer maps can pipeline
             if any(s.split("-")[0] == "routed_moe" for s in body_specs):
                 raise ValueError(
                     "pipeline_parallel cannot carry the routed_moe balance "

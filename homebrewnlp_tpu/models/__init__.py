@@ -112,8 +112,9 @@ def _block_param_keys(all_keys, root: str, i: int, c: int,
                       include_shared: bool = True) -> typing.List[str]:
     """Param keys of the (depth i, config c) block group.  ``include_shared``
     adds the cross-depth shared_{c} tensors (reference backend.py:43-94) —
-    the pipelined body excludes them because a single shared tensor cannot be
-    stage-stacked (config validation rejects the combination)."""
+    the stack/unstack transforms exclude them (they are replicated per stage
+    instead, see stack_pipeline_params), while the pipelined body's slot
+    dicts include them."""
     p1 = f"{root}/{_block_scope(i, c)}/"
     p2 = f"{root}/shared_{c}/"
     return sorted(k for k in all_keys
@@ -211,7 +212,12 @@ def _body(ctx: Ctx, src: NT) -> NT:
             # config validation rejects routed_moe here when
             # moe_balance_weight > 0 (config.py)
             fs = [make_f(k, i, c) for k, (i, c) in enumerate(seq)]
-            chain = make_reversible_chain(fs, mode=strategy, alpha=cfg.momentumnet_alpha)
+            cot = (jnp.dtype(cfg.reversible_cotangent_dtype)
+                   if cfg.reversible_cotangent_dtype else None)
+            chain = make_reversible_chain(fs, mode=strategy,
+                                          alpha=cfg.momentumnet_alpha,
+                                          cotangent_dtype=cot,
+                                          remat_blocks=cfg.reversible_remat_blocks)
             if strategy == "revnet":
                 y1, y2 = chain(subparams, src, src)
             else:
@@ -232,10 +238,11 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
     """GPipe pipeline-parallel body (ops/pipeline.py): the depth loop is cut
     into ``cfg.pipeline_parallel`` contiguous stages living on the pipeline
     mesh axis; microbatches stream through with activations hopping stages
-    via ppermute.  Config validation guarantees stage homogeneity (P divides
-    depth, no cross-depth shared weights) so one stage function — scoped with
-    stage 0's parameter names — serves every stage with its own stacked
-    weights.
+    via ppermute.  Config validation guarantees P divides depth, so one
+    stage function — scoped with stage 0's parameter names — serves every
+    stage with its own stacked weights; cross-depth 'shared' tensors ride
+    as stage-replicated leaves (stack_pipeline_params) kept bit-synced by
+    the stage-summed grad broadcast (sync_shared_pipeline_grads).
 
     Parameters arrive STAGE-STACKED (``stack_pipeline_params``): the flat
     dict holds one ``[P, ...]`` leaf per stage-0 group key, sharded over the
@@ -259,7 +266,10 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
     stacked = []
     for j in range(g):
         i0, c0 = seq[j]
-        keys = _block_param_keys(all_keys, root, i0, c0, include_shared=False)
+        # include_shared: the stage-replicated shared_{c} leaves ride into
+        # every group slot of their config (same stacked leaf; autodiff sums
+        # the per-use cotangents, sync_shared_pipeline_grads sums stages)
+        keys = _block_param_keys(all_keys, root, i0, c0, include_shared=True)
         stacked.append({k: ctx.params[k] for k in keys})
 
     names = src.names
@@ -476,6 +486,17 @@ def stack_pipeline_params(cfg: Config, params, axes=None):
             out[k] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *parts)
             if new_axes is not None:
                 new_axes[k] = (PIPE_STAGE,) + tuple(new_axes[k])
+    # cross-depth 'shared' tensors: REPLICATED per stage (identical slices
+    # under the stage axis).  Their grads are stage-summed and re-broadcast
+    # (sync_shared_pipeline_grads), so the per-stage optimizer updates stay
+    # bit-identical and the copies never diverge — exact cross-depth sharing
+    # semantics with stage residency.
+    for k in all_keys:
+        if k.startswith(f"{root}/shared_"):
+            out[k] = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (P,) + x.shape), out[k])
+            if new_axes is not None:
+                new_axes[k] = (PIPE_STAGE,) + tuple(new_axes[k])
     return out if axes is None else (out, new_axes)
 
 
@@ -501,7 +522,33 @@ def unstack_pipeline_params(cfg: Config, params, axes=None):
                 out[dst] = v[s]
                 if new_axes is not None:
                     new_axes[dst] = base
+    # shared tensors: replicated slices (kept bit-identical by the grad
+    # sync) — slice 0 recovers the single cross-depth tensor
+    for k in all_keys:
+        if k.startswith(f"{root}/shared_") and k in out:
+            out[k] = jax.tree_util.tree_map(lambda x: x[0], out[k])
+            if new_axes is not None:
+                new_axes[k] = tuple(new_axes[k])[1:]
     return out if axes is None else (out, new_axes)
+
+
+def sync_shared_pipeline_grads(cfg: Config, grads, axes):
+    """Sum each stage-replicated 'shared' tensor's gradient over the stage
+    axis and re-broadcast it.
+
+    Exact cross-depth sharing semantics: the sequential model's shared-weight
+    gradient is the sum over ALL depth uses; with per-stage copies each slice
+    only accumulates its own stage's uses, so the stage-sum restores the
+    total and the broadcast hands every stage the same gradient — identical
+    per-stage optimizer updates keep the replicas bit-synced."""
+    from ..config import PIPE_STAGE
+    root = f"{cfg.model_mode}/body/shared_"
+    out = dict(grads)
+    for k, g in grads.items():
+        if k.startswith(root) and tuple(axes.get(k, ()))[:1] == (PIPE_STAGE,):
+            out[k] = jnp.broadcast_to(jnp.sum(g, axis=0, keepdims=True),
+                                      g.shape)
+    return out
 
 
 def init_params(cfg: Config, batch: typing.Dict[str, NT], seed: int = 0

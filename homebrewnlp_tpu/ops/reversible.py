@@ -24,12 +24,27 @@ Pytree = typing.Any
 
 
 def make_reversible_chain(fs: typing.Sequence[typing.Callable],
-                          mode: str = "revnet", alpha: float = 0.99):
+                          mode: str = "revnet", alpha: float = 0.99,
+                          cotangent_dtype=None, remat_blocks: bool = False):
     """Build a reversible chain over residual-branch functions ``fs``.
 
     Each ``fs[i](params_i, x) -> y`` must be shape-preserving and
     deterministic (re-executed during backward).  Returns
     ``chain(params_tuple, x1, x2) -> (y1, y2)``.
+
+    ``cotangent_dtype`` (e.g. ``jnp.bfloat16``) inserts a precision squash
+    on the inter-block cotangent streams during backward: dy1/dy2 are
+    rounded through the reduced dtype between blocks (cast down and back
+    up, so each block's vjp still sees cotangents of its output dtype —
+    vjp rejects a dtype mismatch outright).  None keeps the exact default.
+
+    ``remat_blocks`` wraps each block in ``jax.checkpoint`` for the
+    backward's ``jax.vjp`` replay: the replay forward then stores no
+    internal residuals (norm stats, pre-activations, widened mids) and the
+    pullback recomputes them — more FLOPs for fewer HBM bytes, profitable
+    exactly when the step sits on the bandwidth roofline while the MXU is
+    idle (docs/perf/README.md round 4: the 32mixer_group workload).
+    Numerics are unchanged (same math, different schedule).
     """
     fs = tuple(fs)
 
@@ -40,7 +55,7 @@ def make_reversible_chain(fs: typing.Sequence[typing.Callable],
 
         def inv_and_grads(f, p, y1, y2, dy1, dy2):
             x2 = y1
-            fx, vjp = jax.vjp(f, p, x2)
+            fx, vjp = jax.vjp(jax.checkpoint(f) if remat_blocks else f, p, x2)
             x1 = tsub(lambda a, b: a - b, y2, fx)
             dp, dx2_f = vjp(dy2)
             dx1 = dy2
@@ -56,7 +71,7 @@ def make_reversible_chain(fs: typing.Sequence[typing.Callable],
         def inv_and_grads(f, p, y1, y2, dy1, dy2):
             # y1 = x + v', y2 = v' = a*v + (1-a)*f(p, x)
             x = tsub(lambda a, b: a - b, y1, y2)
-            fx, vjp = jax.vjp(f, p, x)
+            fx, vjp = jax.vjp(jax.checkpoint(f) if remat_blocks else f, p, x)
             v = tsub(lambda a, b: (a - (1 - alpha) * b) / alpha, y2, fx)
             d_sum = tsub(lambda a, b: a + b, dy1, dy2)
             dp, dx_f = vjp(tsub(lambda a: (1 - alpha) * a, d_sum))
@@ -86,6 +101,10 @@ def make_reversible_chain(fs: typing.Sequence[typing.Callable],
         for i in range(len(fs) - 1, -1, -1):
             y1, y2, dy1, dy2, dparams[i] = inv_and_grads(
                 fs[i], params[i], y1, y2, dy1, dy2)
+            if cotangent_dtype is not None and i > 0:
+                squash = lambda d: d.astype(cotangent_dtype).astype(d.dtype)
+                dy1 = tsub(squash, dy1)
+                dy2 = tsub(squash, dy2)
         return tuple(dparams), dy1, dy2
 
     chain.defvjp(chain_fwd, chain_bwd)

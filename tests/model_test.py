@@ -173,3 +173,46 @@ def test_pallas_causal_map_attention_parity():
     for x, y in zip(ga, ge):
         np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_reversible_cotangent_dtype_is_noop_under_bf16():
+    import numpy as np
+    """Round-4 measured finding pinned as a test: under bf16 calculation
+    dtype the inter-block cotangent streams are already bf16, so the
+    reversible_cotangent_dtype barrier must be a numeric NO-OP (bit-identical
+    grads).  If this ever fails, the backward started carrying f32 streams
+    and the barrier became a real lever again (docs/perf/README.md)."""
+    base = dict(memory_reduction_strategy="revnet",
+                calculation_dtype="bfloat16", storage_dtype="bfloat16",
+                slice_dtype="bfloat16")
+    cfg_a = mixer_config(**base)
+    cfg_b = mixer_config(**base, reversible_cotangent_dtype="bfloat16")
+    p, _, batch, loss_a = init_and_loss(cfg_a)
+    _, _, _, loss_b = init_and_loss(cfg_b)
+    ga = jax.jit(jax.grad(loss_a))(p, jax.random.key(0))
+    gb = jax.jit(jax.grad(loss_b))(p, jax.random.key(0))
+    for k in ga:
+        np.testing.assert_array_equal(np.asarray(ga[k]).view(np.uint16),
+                                      np.asarray(gb[k]).view(np.uint16),
+                                      err_msg=k)
+
+
+def test_reversible_cotangent_squash_f32_runs():
+    import numpy as np
+    """f32-calculation configs with the bf16 cotangent squash must train (the
+    squash rounds through bf16 and casts back, so block vjps still see f32
+    cotangents) and produce grads close to the exact ones."""
+    base = dict(memory_reduction_strategy="revnet",
+                calculation_dtype="float32", storage_dtype="float32",
+                slice_dtype="float32")
+    cfg_a = mixer_config(**base)
+    cfg_b = mixer_config(**base, reversible_cotangent_dtype="bfloat16")
+    p, _, batch, loss_a = init_and_loss(cfg_a)
+    _, _, _, loss_b = init_and_loss(cfg_b)
+    ga = jax.jit(jax.grad(loss_a))(p, jax.random.key(0))
+    gb = jax.jit(jax.grad(loss_b))(p, jax.random.key(0))
+    for k in ga:
+        a, b = np.asarray(ga[k], np.float32), np.asarray(gb[k], np.float32)
+        assert np.all(np.isfinite(b)), k
+        # bf16 rounding on the streams: close but not exact
+        np.testing.assert_allclose(a, b, rtol=0.1, atol=1e-3, err_msg=k)
