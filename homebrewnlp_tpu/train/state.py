@@ -184,10 +184,26 @@ class Trainer:
                 loss = (jnp.mean(losses) if cfg.macro_batch_loss_smoothing
                         else losses[-1])
                 metrics.update({k: jnp.mean(v) for k, v in per_micro.items()})
+            if cfg.pipeline_parallel > 1:
+                # stage-replicated 'shared' tensors: stage-sum + re-broadcast
+                # keeps the replicas bit-synced (models.stack_pipeline_params)
+                from ..models import sync_shared_pipeline_grads
+                grads = sync_shared_pipeline_grads(cfg, grads, self.axes)
             new_params, new_opt, lr = opt.update(
                 state.params, grads, state.opt_state, state.step)
-            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                                 for g in grads.values()))
+
+            def norm_sq(name, g):
+                """Stage-replicated shared tensors hold the SAME summed grad
+                in every slice after the sync — count it once, so grad_norm
+                matches the sequential model's."""
+                s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                from ..config import PIPE_STAGE
+                ax = self.axes.get(name, ())
+                if ("/shared_" in name and tuple(ax)[:1] == (PIPE_STAGE,)):
+                    s = s / g.shape[0]
+                return s
+
+            gnorm = jnp.sqrt(sum(norm_sq(k, g) for k, g in grads.items()))
             metrics.update({
                 "loss": loss,
                 "learning_rate": lr,
@@ -203,8 +219,7 @@ class Trainer:
                 edges = jnp.asarray(GRAD_HIST_EDGES)
                 for name, g in grads.items():
                     gf = g.astype(jnp.float32)
-                    metrics[f"grad_norm/{name}"] = jnp.sqrt(
-                        jnp.sum(jnp.square(gf)))
+                    metrics[f"grad_norm/{name}"] = jnp.sqrt(norm_sq(name, g))
                     mag = jnp.log2(jnp.abs(gf).reshape(-1) + 1e-38)
                     hist, _ = jnp.histogram(mag, bins=edges)
                     metrics[f"grad_hist/{name}"] = hist
