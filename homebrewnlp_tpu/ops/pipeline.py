@@ -88,3 +88,150 @@ def gpipe(stage_fn: typing.Callable, stacked_params, x: jnp.ndarray,
     outs = piped(stacked_params, x)      # [P, M, b/M, ...]
     final = outs[n_stages - 1]           # last stage's slice
     return final.reshape(x.shape)
+
+
+def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
+                  stacked_params, tail_params, x: jnp.ndarray,
+                  tail_args: typing.Sequence[jnp.ndarray],
+                  n_stages: int, n_micro: int, mesh: Mesh,
+                  axis: str = "pipeline"):
+    """One-forward-one-backward (1F1B) pipeline schedule computing the LOSS
+    AND ALL GRADIENTS in a single interleaved scan.
+
+    GPipe (above) runs all-forward-then-all-backward under autodiff, so the
+    forward scan's per-tick stage residuals — every microbatch's internals —
+    coexist until the backward consumes them: peak activation state grows
+    with M.  1F1B starts microbatch m's backward on the last stage in the
+    same tick its forward completes; a stage's forward stash therefore only
+    holds the microbatches currently in flight between its forward and
+    backward — a ring of ``2*P`` stage INPUTS, independent of M — and each
+    backward tick recomputes its block internals from the stashed input
+    (``jax.vjp`` replay), trading FLOPs for the M-proportional residual
+    memory.  The loss must ride inside the schedule (the cotangent that
+    seeds microbatch m's backward is d loss_m / d y_m), which is why this
+    op takes ``tail_fn`` instead of composing with an outer ``jax.grad``:
+
+      stage_fn(stage_params, stage_idx, x_micro) -> y_micro   (shape-kept)
+      tail_fn(tail_params, y_micro, *tail_args_micro) -> scalar mean loss
+
+    Schedule: scan step k runs forward tick ``f = k`` (exactly GPipe's) and
+    backward tick ``b = k - (P-1)``; stage s handles microbatch ``k - s``
+    forward and ``k - 2(P-1) + s`` backward, so the last stage's backward
+    consumes the forward output produced in the same step.  Total steps
+    ``M + 2P - 2``; each device does at most one forward and one backward
+    stage-call per step (steady-state 1F1B).
+
+    Returns ``(loss, dstacked, dtail, dx)``: the mean loss over all
+    microbatches, gradients in the stacked [P, ...] layout, gradients for
+    ``tail_params`` (f32), and the cotangent of ``x``.
+    """
+    assert x.shape[0] % n_micro == 0, (x.shape, n_micro)
+    P, M = n_stages, n_micro
+    S = 2 * P  # stash ring: ticks between fwd and bwd on stage s = 2(P-1-s)
+
+    def body(stacked, tailp, xs, targs):
+        params = jax.tree_util.tree_map(lambda p: p[0], stacked)
+        idx = jax.lax.axis_index(axis)
+
+        def to_var(a):
+            # idempotent pvary: values derived from the manual-sliced params
+            # are already varying over the pipe axis; pcast rejects a no-op
+            if axis in getattr(jax.typeof(a), "vma", ()):
+                return a
+            return jax.lax.pcast(a, (axis,), to="varying")
+        # pvary the tail params BEFORE any vjp: a replicated-typed primal
+        # feeding a varying output makes the vjp transpose insert a hidden
+        # psum over the pipe axis, summing every stage's (masked-out) tail
+        # grads into each device's dtail_m
+        tailp = jax.tree_util.tree_map(to_var, tailp)
+        micro = to_var(xs.reshape((M, xs.shape[0] // M) + xs.shape[1:]))
+        targs_m = tuple(
+            to_var(t.reshape((M, t.shape[0] // M) + t.shape[1:]))
+            for t in targs)
+        f32 = jnp.float32
+        zeros_f32 = lambda tree: jax.tree_util.tree_map(
+            lambda p: to_var(jnp.zeros(p.shape, f32)), tree)
+        carry0 = (
+            to_var(jnp.zeros_like(micro[0])),            # fwd hop buffer
+            to_var(jnp.zeros_like(micro[0])),            # bwd cotangent hop
+            to_var(jnp.zeros((S,) + micro.shape[1:], micro.dtype)),  # stash
+            zeros_f32(params),                           # stage grads
+            zeros_f32(tailp),                            # tail grads
+            to_var(jnp.zeros_like(micro)),               # dx per microbatch
+            to_var(jnp.zeros((), f32)),                  # loss accumulator
+        )
+        fperm = [(i, (i + 1) % P) for i in range(P)]
+        rperm = [(i, (i - 1) % P) for i in range(P)]
+        is_last = idx == P - 1
+
+        def tick(carry, k):
+            fbuf, bbuf, stash, dstage, dtail, dxs, loss = carry
+            # ---- forward half: GPipe tick k ----
+            m_f = k - idx
+            inject = (idx == 0) & (k < M)
+            feed = jnp.where(inject,
+                             jax.lax.dynamic_index_in_dim(
+                                 micro, jnp.clip(k, 0, M - 1), 0, False),
+                             fbuf)
+            fvalid = (m_f >= 0) & (m_f < M)
+            slot_f = jnp.mod(m_f, S)
+            stash = jnp.where(
+                fvalid,
+                jax.lax.dynamic_update_index_in_dim(stash, feed, slot_f, 0),
+                stash)
+            y = stage_fn(params, idx, feed)
+            # ---- backward half: tick k - (P-1) ----
+            m_b = k - 2 * (P - 1) + idx
+            bvalid = (m_b >= 0) & (m_b < M)
+            m_bc = jnp.clip(m_b, 0, M - 1)
+            x_in = jax.lax.dynamic_index_in_dim(
+                stash, jnp.mod(m_bc, S), 0, False)
+            tmicro = tuple(jax.lax.dynamic_index_in_dim(t, m_bc, 0, False)
+                           for t in targs_m)
+            # last stage: this step's forward output IS microbatch m_b's
+            # (schedule identity k-(P-1) = m_b there), so the tail vjp seeds
+            # the backward without ever storing last-stage outputs
+            loss_m, tail_vjp = jax.vjp(
+                lambda tp, yy: tail_fn(tp, yy, *tmicro), tailp, y)
+            dtail_m, dy_tail = tail_vjp(to_var(jnp.asarray(1.0 / M,
+                                                           loss_m.dtype)))
+            cot = jnp.where(is_last, dy_tail, bbuf)
+            _, svjp = jax.vjp(
+                lambda p, xx: stage_fn(p, idx, xx), params, x_in)
+            dp, dx = svjp(cot)
+            acc = lambda a, b, gate: jax.tree_util.tree_map(
+                lambda u, v: u + jnp.where(gate, v.astype(f32), 0), a, b)
+            dstage = acc(dstage, dp, bvalid)
+            dtail = acc(dtail, dtail_m, bvalid & is_last)
+            loss = loss + jnp.where(bvalid & is_last,
+                                    loss_m.astype(f32) / M, 0)
+            wmask = ((jnp.arange(M) == m_b) & bvalid & (idx == 0))
+            dxs = jnp.where(wmask.reshape((M,) + (1,) * dx.ndim),
+                            dx[None], dxs)
+            fbuf = jax.lax.ppermute(y, axis, fperm)
+            bbuf = jax.lax.ppermute(dx, axis, rperm)
+            return (fbuf, bbuf, stash, dstage, dtail, dxs, loss), None
+
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(M + 2 * P - 2))
+        _, _, _, dstage, dtail, dxs, loss = carry
+        lead = lambda tree: jax.tree_util.tree_map(lambda v: v[None], tree)
+        return loss[None], lead(dstage), lead(dtail), dxs[None]
+
+    leading = PartitionSpec(axis)
+    stage_specs = jax.tree_util.tree_map(lambda _: leading, stacked_params)
+    rep = PartitionSpec()
+    rep_tree = jax.tree_util.tree_map(lambda _: rep, tail_params)
+    piped = jax.shard_map(
+        body, mesh=mesh, axis_names=frozenset({axis}),
+        in_specs=(stage_specs, rep_tree, rep,
+                  tuple(rep for _ in tail_args)),
+        out_specs=(PartitionSpec(axis),
+                   jax.tree_util.tree_map(lambda _: leading, stacked_params),
+                   jax.tree_util.tree_map(lambda _: leading, tail_params),
+                   PartitionSpec(axis)))
+    loss_p, dstacked, dtail_p, dxs_p = piped(stacked_params, tail_params, x,
+                                             tuple(tail_args))
+    loss = loss_p[P - 1]
+    dtail = jax.tree_util.tree_map(lambda v: jnp.sum(v, axis=0), dtail_p)
+    dx = dxs_p[0].reshape(x.shape)
+    return loss, dstacked, dtail, dx
