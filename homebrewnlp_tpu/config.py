@@ -192,7 +192,12 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # parallelism (the reference's two knobs, plus TPU-native extensions)
     tpu_size=32,
     sequence_parallel=1,  # extension: size of the sequence-parallel mesh axis
-    pipeline_parallel=1,  # extension: GPipe stages over the pipeline axis
+    pipeline_parallel=1,  # extension: pipeline stages over the pipeline axis
+    # "gpipe": all-forward scan + autodiff backward (residuals grow with the
+    # microbatch count M).  "1f1b": interleaved schedule computing loss and
+    # grads in one scan with a 2P-deep input stash, M-independent activation
+    # memory (ops/pipeline.py::pipeline_1f1b)
+    pipeline_schedule="gpipe",
     # sampling / serving
     initial_autoregressive_position=128,
     use_autoregressive_sampling=False,
@@ -278,6 +283,12 @@ class Config:
         # excludes the sequence-parallel ring (nested shard_map regions).
         if self.pipeline_parallel < 1:
             raise ValueError("pipeline_parallel must be a positive integer")
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            # validated regardless of pipeline_parallel so a typo surfaces
+            # before the user scales up
+            raise ValueError(
+                f"unknown pipeline_schedule {self.pipeline_schedule!r}; "
+                "expected 'gpipe' or '1f1b'")
         body_specs = [spec for blk in self.block_config
                       for spec in (blk["layer"] if isinstance(blk, dict)
                                    else blk.layer)]
@@ -305,6 +316,25 @@ class Config:
                 raise ValueError(
                     "pipeline_parallel cannot carry the routed_moe balance "
                     "aux loss across the pipeline shard_map boundary")
+            if self.pipeline_schedule == "1f1b":
+                # the loss rides inside the 1F1B schedule (the last stage's
+                # tail seeds each microbatch's backward), which constrains
+                # what the tail can compute in v1
+                if self.multi_loss_strategy != "linear":
+                    raise ValueError(
+                        "pipeline_schedule='1f1b' supports the linear "
+                        "multi-loss strategy only")
+                if self.calc_accuracy:
+                    raise ValueError(
+                        "pipeline_schedule='1f1b' cannot report accuracy "
+                        "(the loss tail runs per microbatch inside the "
+                        "schedule); set calc_accuracy=false")
+                if (self.contrastive_across_samples
+                        or self.contrastive_across_token_embeddings):
+                    raise ValueError(
+                        "pipeline_schedule='1f1b' does not support "
+                        "contrastive losses (they need the stashed input "
+                        "embedding outside the schedule)")
         # routed_moe's load-balance aux loss cannot cross the reversible
         # custom_vjp boundary (models/__init__.py _body); 'none' collects it
         # directly and 'checkpoint' threads it through jax.checkpoint as a

@@ -251,14 +251,30 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
     from ..ops.pipeline import gpipe
     from ..parallel.mesh import PIPE_AXIS
     cfg = ctx.cfg
+    stage_fn, stacked, n_stages = _pipeline_machinery(
+        cfg, ctx.params, src.names, ctx.rng, ctx.train, ctx.seed,
+        seq, attn_starts, mode_scope=ctx._scope[0])
+    n_micro = _pipeline_n_micro(src.x.shape[0], n_stages)
+    y = gpipe(stage_fn, stacked, src.x, n_stages, n_micro, ctx.mesh,
+              PIPE_AXIS)
+    ctx.attention_idx = acc
+    return NT(y, names=src.names)
+
+
+def _pipeline_machinery(cfg: Config, params, names, rng, train, seed,
+                        seq, attn_starts, mode_scope):
+    """(stage_fn, stacked slot list, n_stages) shared by the GPipe forward
+    body and the 1F1B loss-and-grad path.  ``stage_fn(slot_params, idx, x)``
+    runs one stage's block groups on one microbatch; ``stacked`` is the
+    per-group list of stage-stacked param dicts (shared leaves replicated,
+    see stack_pipeline_params)."""
     n_stages = cfg.pipeline_parallel
     n_groups = len(seq)
     assert n_groups % n_stages == 0
     g = n_groups // n_stages
-    mode_scope = ctx._scope[0]
     root = f"{mode_scope}/body"
-    all_keys = list(ctx.params.keys())
-    if not pipeline_params_stacked(cfg, ctx.params):
+    all_keys = list(params.keys())
+    if not pipeline_params_stacked(cfg, params):
         raise ValueError(
             "pipelined body expects stage-stacked parameters "
             "(models.stack_pipeline_params) but found per-depth keys for "
@@ -270,10 +286,7 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
         # every group slot of their config (same stacked leaf; autodiff sums
         # the per-use cotangents, sync_shared_pipeline_grads sums stages)
         keys = _block_param_keys(all_keys, root, i0, c0, include_shared=True)
-        stacked.append({k: ctx.params[k] for k in keys})
-
-    names = src.names
-    rng = ctx.rng
+        stacked.append({k: params[k] for k in keys})
 
     def make_block_f(j: int):
         i0, c0 = seq[j]
@@ -284,7 +297,7 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
             if rng is not None:
                 key = jax.random.fold_in(
                     jax.random.fold_in(rng, 2000 + j), stage_idx)
-            bctx = Ctx(cfg, params=subparams, train=ctx.train, seed=ctx.seed,
+            bctx = Ctx(cfg, params=subparams, train=train, seed=seed,
                        rng=key, mesh=None)
             bctx._scope = [mode_scope, "body"]
             bctx.attention_idx = attn_starts[j]
@@ -303,21 +316,128 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
             out = run(slot_params[j], out, stage_idx)
         return out.x
 
-    batch = src.x.shape[0]
-    # ideal M >= P microbatches keeps every stage busy; fall back to the
-    # largest batch divisor below P (with partial bubble) rather than
-    # silently serializing the whole pipe
+    return stage_fn, stacked, n_stages
+
+
+def _pipeline_n_micro(batch: int, n_stages: int,
+                      schedule: str = "gpipe") -> int:
+    """Ideal M >= P microbatches keeps every stage busy; fall back to the
+    largest batch divisor below P (with partial bubble) rather than silently
+    serializing the whole pipe.
+
+    GPipe picks the SMALLEST such M (its autodiff residuals hold every
+    microbatch's internals, so M only shrinks the bubble at no memory gain
+    for a fixed batch).  1F1B picks the LARGEST M keeping >= 8 rows per
+    microbatch: its stash holds 2P stage inputs TOTAL (so memory shrinks as
+    2P/M of the batch) and the bubble fraction 2(P-1)/(M+2P-2) falls with
+    M; the row floor keeps per-tick matmuls tile-friendly."""
     divisors = [d for d in range(1, batch + 1) if batch % d == 0]
     at_least_p = [d for d in divisors if d >= n_stages]
+    if schedule == "1f1b":
+        big = [d for d in at_least_p if batch // d >= 8]
+        if big:
+            return max(big)
     n_micro = min(at_least_p) if at_least_p else max(divisors)
     if n_micro < n_stages:
         print(f"WARNING: batch {batch} yields only {n_micro} pipeline "
               f"microbatches for {n_stages} stages — pipe utilization "
               f"{n_micro}/{n_stages}")
-    y = gpipe(stage_fn, stacked, src.x, n_stages, n_micro, ctx.mesh,
-              PIPE_AXIS)
-    ctx.attention_idx = acc
-    return NT(y, names)
+    return n_micro
+
+
+def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh):
+    """1F1B training path (``pipeline_schedule='1f1b'``): loss AND grads
+    from one interleaved pipeline schedule (ops/pipeline.py::pipeline_1f1b).
+
+    The model is cut at the body pipeline: the input layer (+ optional body
+    position embedding) runs upstream under ordinary autodiff, the body's
+    stage stack runs inside the schedule, and the output/loss tail runs ON
+    THE LAST STAGE per microbatch — its vjp seeds each microbatch's
+    backward, which is what makes the M-independent activation memory of
+    1F1B possible at all (an outer ``jax.grad`` over a forward-only
+    pipeline cannot interleave).  Scope walks replicate ``build()`` exactly
+    (same parameter names); config validation restricts the tail to the
+    plain language loss (no accuracy/contrastive) in v1.
+
+    Returns ``(grads, ModelOutput)`` like ``Trainer._grads``."""
+    from ..ops.pipeline import pipeline_1f1b
+    from ..parallel.mesh import PIPE_AXIS
+
+    seq, g = _pipeline_seq(cfg)
+    attn_starts = []
+    acc = 0
+    for i, c in seq:
+        attn_starts.append(acc)
+        acc += _attn_layers(cfg.block_config[c])
+    root = f"{cfg.model_mode}/body"
+    all_keys = list(params.keys())
+    stage_keys = set()
+    for j in range(g):
+        i0, c0 = seq[j]
+        stage_keys.update(_block_param_keys(all_keys, root, i0, c0,
+                                            include_shared=True))
+    other = {k: v for k, v in params.items() if k not in stage_keys}
+    spatial_ctx = batch["token_y"].names[-2]
+
+    def upstream(other_params):
+        ctx = Ctx(cfg, params=other_params, train=True, rng=rng, mesh=mesh)
+        with ctx.scope(cfg.model_mode):
+            src, _ = ctx.scoped("input", _input, ctx, batch, spatial_ctx)
+            with ctx.scope("body"):
+                if cfg.use_initial_position_embedding:
+                    base_args = Args(ctx, src, [""])
+                    for dim in [n for n in src.names
+                                if n not in cfg.feature_dims][1:]:
+                        fdims = [(n, cfg.dims[n]) for n in cfg.feature_dims]
+                        src = src + positional_embed(
+                            base_args(list(cfg.position_embedding)), dim,
+                            src.dim_size(dim), fdims)
+        return src
+
+    src_nt, up_vjp = jax.vjp(upstream, other)
+    names = src_nt.names
+
+    stage_fn, stacked, n_stages = _pipeline_machinery(
+        cfg, params, names, rng, True, 0, seq, attn_starts,
+        mode_scope=cfg.model_mode)
+    n_micro = _pipeline_n_micro(src_nt.x.shape[0], n_stages, "1f1b")
+
+    batch_keys = sorted(batch.keys())
+    batch_names = {k: batch[k].names for k in batch_keys}
+    tail_arrays = tuple(batch[k].x for k in batch_keys)
+
+    def tail_fn(other_params, y, *tail_micro):
+        micro_batch = {k: NT(a, batch_names[k])
+                       for k, a in zip(batch_keys, tail_micro)}
+        ctx = Ctx(cfg, params=other_params, train=True,
+                  rng=None if rng is None else jax.random.fold_in(rng, 3001))
+        with ctx.scope(cfg.model_mode):
+            frame_out, token_out = ctx.scoped(
+                "output", _output, ctx, NT(y, names), spatial_ctx)
+            loss_list, _, _, _ = ctx.scoped(
+                "loss", _loss, ctx, frame_out, token_out, micro_batch, None)
+        total = loss_list[0]
+        for l in loss_list[1:]:
+            total = total + l
+        return total
+
+    loss, dstacked, dtail, dsrc = pipeline_1f1b(
+        stage_fn, tail_fn, stacked, other, src_nt.x, tail_arrays,
+        n_stages, n_micro, mesh, PIPE_AXIS)
+    (dother_up,) = up_vjp(NT(dsrc.astype(src_nt.dtype), names))
+
+    grads = {}
+    for slot in dstacked:
+        for k, v in slot.items():
+            # shared leaves appear in every group slot of their config;
+            # their per-slot contributions sum (matching autodiff)
+            grads[k] = v if k not in grads else grads[k] + v
+    for k in other:
+        # both dicts always carry every key (vjp and the schedule's grad
+        # carry produce full pytrees with zero leaves for unused params)
+        grads[k] = dother_up[k].astype(jnp.float32) + dtail[k]
+    out = ModelOutput(loss, (loss,), None, None, None, None, None)
+    return grads, out
 
 
 # -- output -----------------------------------------------------------------

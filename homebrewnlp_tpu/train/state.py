@@ -103,6 +103,12 @@ class Trainer:
 
     def _grads(self, params, batch, rng):
         cfg = self.cfg
+        if cfg.pipeline_parallel > 1 and cfg.pipeline_schedule == "1f1b":
+            # loss and grads come from ONE interleaved pipeline schedule —
+            # no outer jax.grad (models.pipelined_loss_and_grads)
+            from ..models import pipelined_loss_and_grads
+            return pipelined_loss_and_grads(cfg, params, batch, rng,
+                                            self.mesh)
         if cfg.multi_loss_strategy == "linear":
             def total(p):
                 o = self._losses(p, batch, rng)

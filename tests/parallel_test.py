@@ -831,3 +831,71 @@ def test_pipeline_1f1b_op_parity(eight_devices):
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(dxB), np.asarray(gx),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_1f1b_trains_with_parity(eight_devices):
+    """pipeline_schedule='1f1b': the interleaved loss-and-grad schedule must
+    match the gpipe-under-autodiff path — same loss, same grads, same params
+    after an optimizer step — including a config with cross-depth shared
+    weights and grad accumulation."""
+    from homebrewnlp_tpu.config import Config
+    base = _pipe_base(depth=4, train_batch_size=16)
+    cfg_g = Config(dict(base, pipeline_parallel=4, pipeline_schedule="gpipe"))
+    cfg_f = Config(dict(base, pipeline_parallel=4, pipeline_schedule="1f1b"))
+    batch = text_batch(cfg_g)
+
+    tg, tf = Trainer(cfg_g), Trainer(cfg_f)
+    sg = tg.init(batch)
+    sf = tf.init(batch)
+    for k in sg.params:
+        np.testing.assert_array_equal(np.asarray(sg.params[k]),
+                                      np.asarray(sf.params[k]), err_msg=k)
+    gg, og = tg._grads(sg.params, batch, jax.random.key(0))
+    gf, of = tf._grads(sf.params, batch, jax.random.key(0))
+    np.testing.assert_allclose(float(of.loss), float(og.loss), rtol=1e-5)
+    assert set(gg) == set(gf)
+    for k in gg:
+        np.testing.assert_allclose(np.asarray(gf[k], np.float32),
+                                   np.asarray(gg[k], np.float32),
+                                   rtol=2e-4, atol=2e-6, err_msg=k)
+    for i in range(2):
+        sg, mg = tg.step(sg, batch, jax.random.key(i))
+        sf, mf = tf.step(sf, batch, jax.random.key(i))
+    np.testing.assert_allclose(float(mf["loss"]), float(mg["loss"]),
+                               rtol=1e-4)
+    for k in sg.params:
+        np.testing.assert_allclose(np.asarray(sg.params[k], np.float32),
+                                   np.asarray(sf.params[k], np.float32),
+                                   rtol=2e-4, atol=2e-6, err_msg=k)
+
+    # shared weights + 1f1b compose (the flagship mixer DSL)
+    from .backend import mixer_config
+    mcfg = dict(mixer_config(depth=4, calc_accuracy=False).dict())
+    cfg_ms = Config(dict(mcfg, memory_reduction_strategy="none",
+                         pipeline_parallel=2, pipeline_schedule="1f1b"))
+    cfg_mg = Config(dict(mcfg, memory_reduction_strategy="none",
+                         pipeline_parallel=2, pipeline_schedule="gpipe"))
+    mbatch = text_batch(cfg_ms)
+    tms, tmg = Trainer(cfg_ms), Trainer(cfg_mg)
+    sms = tms.init(mbatch)
+    smg = tmg.init(mbatch)
+    gms, oms = tms._grads(sms.params, mbatch, jax.random.key(1))
+    gmg, omg = tmg._grads(smg.params, mbatch, jax.random.key(1))
+    np.testing.assert_allclose(float(oms.loss), float(omg.loss), rtol=1e-5)
+    for k in gmg:
+        np.testing.assert_allclose(np.asarray(gms[k], np.float32),
+                                   np.asarray(gmg[k], np.float32),
+                                   rtol=5e-4, atol=5e-6, err_msg=k)
+
+
+def test_pipeline_1f1b_config_validation():
+    from homebrewnlp_tpu.config import Config
+    base = _pipe_base(depth=4)
+    with pytest.raises(ValueError, match="pipeline_schedule"):
+        Config(dict(base, pipeline_parallel=2, pipeline_schedule="zigzag"))
+    with pytest.raises(ValueError, match="accuracy"):
+        Config(dict(base, pipeline_parallel=2, pipeline_schedule="1f1b",
+                    calc_accuracy=True))
+    with pytest.raises(ValueError, match="multi-loss"):
+        Config(dict(base, pipeline_parallel=2, pipeline_schedule="1f1b",
+                    multi_loss_strategy="pcgrad"))
