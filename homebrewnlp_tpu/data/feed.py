@@ -9,6 +9,7 @@ the same host-locality the reference's placement logic hand-computed.
 """
 from __future__ import annotations
 
+import functools
 import typing
 
 import jax
@@ -46,22 +47,61 @@ def axes_for(name: str, arr: np.ndarray, cfg: Config) -> typing.Tuple[str, ...]:
     return names[:arr.ndim]
 
 
-def local_row_slice(index: typing.Tuple[slice, ...], local_rows: int,
-                    global_rows: int) -> slice:
-    """Translate a device's GLOBAL batch-row request into LOCAL row offsets.
+@functools.lru_cache(maxsize=8)
+def _local_data_coords(mesh: Mesh) -> typing.Tuple[int, ...]:
+    """Data-axis coordinates covered by this process's devices (cached per
+    mesh — the O(n_devices) grid scan must not run every training step).
 
-    Each process holds ``local_rows`` consecutive global rows (process p owns
-    [p*local_rows, (p+1)*local_rows)); a device request must stay inside its
-    process's span — the data-axis sharding guarantees it when the per-process
-    batch divides evenly over that process's devices."""
+    With the data axis outermost in the device order this is the classic
+    disjoint rank slicing; when a REPLICATING axis (e.g. pipeline) spans
+    processes, several processes cover the SAME coordinate and must load
+    the same batch rows."""
+    from ..parallel.mesh import DATA_AXIS
+    ax = list(mesh.axis_names).index(DATA_AXIS)
+    pid = jax.process_index()
+    coords = sorted({idx[ax] for idx in np.ndindex(*mesh.devices.shape)
+                     if mesh.devices[idx].process_index == pid})
+    if coords != list(range(coords[0], coords[0] + len(coords))):
+        raise ValueError(
+            f"process covers non-contiguous data coords {coords}; the host "
+            "batch cannot be one contiguous row range")
+    return tuple(coords)
+
+
+def data_slice_for_process(mesh: Mesh) -> typing.Tuple[int, int]:
+    """(slice_index, slice_count) for the per-host dataset reader.
+
+    Equal to (process_index, process_count) for data-major topologies;
+    processes sharing data-axis coordinates (pipe axis spanning hosts) get
+    the SAME slice index so their readers deliver identical rows — the
+    host-locality answer the reference hand-computes in
+    dataloader_placement.py:69-92."""
+    from ..parallel.mesh import DATA_AXIS
+    coords = _local_data_coords(mesh)
+    d = int(mesh.shape[DATA_AXIS])
+    k = len(coords)
+    if coords[0] % k or d % k:
+        # a coord block straddling a slice boundary would floor-divide to a
+        # WRONG slice index and serve wrong rows inside the span guard
+        raise ValueError(
+            f"process data coords {coords} do not align with a uniform "
+            f"slicing of the {d}-way data axis; choose a topology whose "
+            "devices-per-process divides the data axis")
+    return coords[0] // k, d // k
+
+
+def local_row_slice(index: typing.Tuple[slice, ...], local_rows: int,
+                    global_rows: int, start_row: int = 0) -> slice:
+    """Translate a device's GLOBAL batch-row request into LOCAL row offsets
+    relative to this process's span [start_row, start_row + local_rows)."""
     start = index[0].start or 0
     stop = index[0].stop if index[0].stop is not None else global_rows
-    local_start = start % local_rows
-    if local_start + (stop - start) > local_rows:
+    local_start = start - start_row
+    if local_start < 0 or local_start + (stop - start) > local_rows:
         raise ValueError(
-            f"device requests rows [{start},{stop}) crossing a process "
-            f"boundary (local batch {local_rows}) — the data-axis sharding "
-            "must align with per-process batches")
+            f"device requests rows [{start},{stop}) outside this process's "
+            f"span [{start_row},{start_row + local_rows}) — the data-axis "
+            "sharding must align with per-process batches")
     return slice(local_start, local_start + (stop - start))
 
 
@@ -69,17 +109,23 @@ def to_global(batch: typing.Dict[str, np.ndarray], cfg: Config, mesh: Mesh
               ) -> typing.Dict[str, NT]:
     """Assemble the per-host numpy batch into global NT arrays on the mesh.
 
-    The batch passed in is this host's shard (local batch rows); global shape
-    is inferred as local * process count."""
+    The batch passed in is this host's data slice (see
+    ``data_slice_for_process``); the global batch is ``local * slice_count``
+    — processes sharing a data coordinate pass identical rows."""
+    from ..parallel.mesh import DATA_AXIS
     out: typing.Dict[str, NT] = {}
-    n_procs = jax.process_count()
+    _, slice_count = data_slice_for_process(mesh)
+    coords = _local_data_coords(mesh)
+    data_axis_size = int(mesh.shape.get(DATA_AXIS, 1))
     for name, arr in batch.items():
         names = axes_for(name, arr, cfg)
         sharding = NamedSharding(mesh, spec_for(names, mesh))
-        global_shape = (arr.shape[0] * n_procs,) + arr.shape[1:]
+        global_shape = (arr.shape[0] * slice_count,) + arr.shape[1:]
+        rows_per_coord = global_shape[0] // max(1, data_axis_size)
+        start_row = coords[0] * rows_per_coord
 
-        def cb(index, arr=arr, global_rows=global_shape[0]):
-            rows = local_row_slice(index, arr.shape[0], global_rows)
+        def cb(index, arr=arr, global_rows=global_shape[0], start=start_row):
+            rows = local_row_slice(index, arr.shape[0], global_rows, start)
             return arr[(rows,) + tuple(index[1:])]
 
         x = jax.make_array_from_callback(global_shape, sharding, cb)

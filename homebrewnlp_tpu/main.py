@@ -83,8 +83,13 @@ def train(cfg, args) -> None:
     from .train import MetricWriter, color_print
 
     have_data = _have_dataset_files(cfg)
-    slice_index = jax.process_index()
-    slice_count = max(1, jax.process_count())
+    from .parallel import make_mesh
+    mesh = make_mesh(cfg)
+    # processes sharing a data-axis coordinate (pipe axis spanning hosts)
+    # read the SAME dataset slice (data/feed.py::data_slice_for_process);
+    # data-major topologies reduce to (process_index, process_count)
+    from .data.feed import data_slice_for_process
+    slice_index, slice_count = data_slice_for_process(mesh)
     # macro-batching inflates the per-step host batch by M (reference
     # dataloader_placement.py:40-44)
     local_batch = cfg.train_batch_size * cfg.macro_batching // slice_count
@@ -99,9 +104,6 @@ def train(cfg, args) -> None:
     else:
         color_print("no dataset files found; using synthetic data")
         first_np = synthetic_text_batch(cfg, 0)
-
-    from .parallel import make_mesh
-    mesh = make_mesh(cfg)
     trainer, state, ckpt, data_state = _build_state(
         cfg, to_global(first_np, cfg, mesh), mesh)
     if int(state.step) == 0 and cfg.current_step > 0:

@@ -47,8 +47,31 @@ class Checkpointer:
                 "step": state.step}
         self.manager.save(step, args=ocp.args.StandardSave(tree))
         if data_state is not None:
-            with open(os.path.join(self.path, f"data_state_{step}.json"), "w") as f:
+            with open(self._data_state_path(step), "w") as f:
                 json.dump(data_state, f)
+
+    def _data_state_path(self, step: int) -> str:
+        """Data-pipeline cursor sidecar.  Multi-process runs keep ONE cursor
+        file PER PROCESS (each host's reader consumed a different slice of
+        the stream — reference dataloader_placement.py:101-136 writes its
+        DataLog per dataset host the same way); single-process keeps the
+        plain name."""
+        suffix = (f"_p{jax.process_index()}"
+                  if jax.process_count() > 1 else "")
+        return os.path.join(self.path, f"data_state_{step}{suffix}.json")
+
+    def _load_data_state(self, step: int) -> typing.Optional[dict]:
+        # fall back to the other naming so cursors survive a process-count
+        # change (or a checkpoint written before per-process sidecars):
+        # multi-process probes its own _p{r} file then the legacy plain
+        # name; single-process probes the plain name then rank 0's
+        legacy = os.path.join(self.path, f"data_state_{step}.json")
+        rank0 = os.path.join(self.path, f"data_state_{step}_p0.json")
+        for path in (self._data_state_path(step), legacy, rank0):
+            if os.path.exists(path):
+                with open(path) as f:
+                    return json.load(f)
+        return None
 
     def wait(self) -> None:
         self.manager.wait_until_finished()
@@ -83,13 +106,9 @@ class Checkpointer:
             if cfg is None or getattr(cfg, "pipeline_parallel", 1) <= 1:
                 raise
             return self._restore_flat_pipeline(step, template, cfg, e)
-        data_state = None
-        data_path = os.path.join(self.path, f"data_state_{step}.json")
-        if os.path.exists(data_path):
-            with open(data_path) as f:
-                data_state = json.load(f)
-        return TrainState(restored["params"], restored["opt_state"],
-                          restored["step"]), data_state
+        return (TrainState(restored["params"], restored["opt_state"],
+                           restored["step"]),
+                self._load_data_state(step))
 
     def _restore_flat_pipeline(self, step: int, template: TrainState, cfg,
                                original: Exception
@@ -118,12 +137,7 @@ class Checkpointer:
                                            opt_state)
         state = TrainState(params, opt_state,
                            put(template.step, raw["step"]))
-        data_state = None
-        data_path = os.path.join(self.path, f"data_state_{step}.json")
-        if os.path.exists(data_path):
-            with open(data_path) as f:
-                data_state = json.load(f)
-        return state, data_state
+        return state, self._load_data_state(step)
 
 
 def current_step(model_path: str) -> int:

@@ -354,11 +354,13 @@ def test_local_row_slice_two_process_layout():
     host_batches = [data[p * local:(p + 1) * local] for p in range(n_proc)]
 
     # 8 devices, data axis 8: each device requests one global row; devices
-    # 0-3 live on process 0, 4-7 on process 1
+    # 0-3 live on process 0, 4-7 on process 1.  The caller passes each
+    # process's span start (data/feed.py::to_global derives it from the
+    # process's data-axis coordinates)
     for dev in range(8):
         index = (slice(dev, dev + 1), slice(None))
         proc = dev // 4
-        rows = local_row_slice(index, local, global_rows)
+        rows = local_row_slice(index, local, global_rows, proc * local)
         np.testing.assert_array_equal(host_batches[proc][rows],
                                       data[dev:dev + 1])
 
@@ -366,18 +368,18 @@ def test_local_row_slice_two_process_layout():
     for dev in range(4):
         index = (slice(dev * 2, dev * 2 + 2), slice(None))
         proc = dev // 2
-        rows = local_row_slice(index, local, global_rows)
+        rows = local_row_slice(index, local, global_rows, proc * local)
         np.testing.assert_array_equal(host_batches[proc][rows],
                                       data[dev * 2:dev * 2 + 2])
 
-    # a request crossing the process boundary is rejected, not silently wrong
+    # a request outside the process's span is rejected, not silently wrong
     with pytest.raises(ValueError):
-        local_row_slice((slice(2, 6), slice(None)), local, global_rows)
+        local_row_slice((slice(2, 6), slice(None)), local, global_rows, 4)
 
     # replicated batch (no data sharding): every device asks for everything —
-    # only valid single-process; the cross-boundary guard fires for 2 procs
+    # only valid single-process; the span guard fires for 2 procs
     with pytest.raises(ValueError):
-        local_row_slice((slice(0, 8), slice(None)), local, global_rows)
+        local_row_slice((slice(0, 8), slice(None)), local, global_rows, 0)
     assert local_row_slice((slice(0, 8), slice(None)), 8, 8) == slice(0, 8)
 
 

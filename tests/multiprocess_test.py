@@ -57,3 +57,50 @@ def test_two_process_distributed_train_step():
         line0 = [l for l in out0.splitlines() if f" {case} " in l][0]
         line1 = [l for l in out1.splitlines() if f" {case} " in l][0]
         assert line0.split("rank0: ")[1] == line1.split("rank1: ")[1]
+
+
+def test_four_process_pipeline_and_checkpoint(tmp_path):
+    """4 OS processes x 2 devices: the pipe axis spans process boundaries
+    (GPipe and 1F1B activation hops + gradient transposes over gloo), and
+    orbax save/restore works under jax.distributed with per-process data
+    cursors (VERDICT r3 item 7)."""
+    port = _free_port()
+    worker = os.path.join(HERE, "multiprocess_worker.py")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    ckpt_dir = str(tmp_path / "mp_ckpt")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(r), str(port), "4", ckpt_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        for r in range(4)]
+    outs = [""] * 4
+    try:
+        try:
+            outs[0], _ = procs[0].communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for p in procs[1:]:
+                p.kill()
+            tails = "\n---\n".join(
+                (p.communicate()[0] or "")[-1200:] for p in procs[1:])
+            procs[0].kill()
+            raise AssertionError(f"rank0 timed out; peers:\n{tails}")
+        if procs[0].returncode != 0:
+            for p in procs[1:]:
+                p.kill()
+            raise AssertionError(outs[0][-3000:])
+        for r in (1, 2, 3):
+            outs[r], _ = procs[r].communicate(timeout=120)
+            assert procs[r].returncode == 0, outs[r][-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r in range(4):
+        assert "MULTIPROC_OK" in outs[r], outs[r][-2000:]
+        assert f"ckpt restored step=5 cursor={1000 + r}" in outs[r]
+    # every rank observed the SAME global loss sequence for each case
+    for case in ("dp_pp", "dp_pp_1f1b", "dp_tp_ckpt"):
+        lines = [[l for l in outs[r].splitlines() if f" {case} " in l][0]
+                 for r in range(4)]
+        vals = {l.split(": ", 1)[1].split("losses=")[1] for l in lines}
+        assert len(vals) == 1, (case, lines)
