@@ -324,11 +324,6 @@ class Config:
                     raise ValueError(
                         "pipeline_schedule='1f1b' supports the linear "
                         "multi-loss strategy only")
-                if self.calc_accuracy:
-                    raise ValueError(
-                        "pipeline_schedule='1f1b' cannot report accuracy "
-                        "(the loss tail runs per microbatch inside the "
-                        "schedule); set calc_accuracy=false")
                 if (self.contrastive_across_samples
                         or self.contrastive_across_token_embeddings):
                     raise ValueError(

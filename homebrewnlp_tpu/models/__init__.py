@@ -414,14 +414,20 @@ def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh):
         with ctx.scope(cfg.model_mode):
             frame_out, token_out = ctx.scoped(
                 "output", _output, ctx, NT(y, names), spatial_ctx)
-            loss_list, _, _, _ = ctx.scoped(
+            loss_list, token_loss, acc, _ = ctx.scoped(
                 "loss", _loss, ctx, frame_out, token_out, micro_batch, None)
         total = loss_list[0]
         for l in loss_list[1:]:
             total = total + l
-        return total
+        # per-microbatch metrics ride the schedule's aux stream (averaged
+        # over microbatches by the op, like the loss)
+        aux = {"token_loss": token_loss.x if hasattr(token_loss, "x")
+               else token_loss}
+        if acc is not None:
+            aux["accuracy"] = acc.x if hasattr(acc, "x") else acc
+        return total, aux
 
-    loss, dstacked, dtail, dsrc = pipeline_1f1b(
+    loss, aux, dstacked, dtail, dsrc = pipeline_1f1b(
         stage_fn, tail_fn, stacked, other, src_nt.x, tail_arrays,
         n_stages, n_micro, mesh, PIPE_AXIS)
     (dother_up,) = up_vjp(NT(dsrc.astype(src_nt.dtype), names))
@@ -436,7 +442,8 @@ def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh):
         # both dicts always carry every key (vjp and the schedule's grad
         # carry produce full pytrees with zero leaves for unused params)
         grads[k] = dother_up[k].astype(jnp.float32) + dtail[k]
-    out = ModelOutput(loss, (loss,), None, None, None, None, None)
+    out = ModelOutput(loss, (loss,), None, aux.get("accuracy"),
+                      aux.get("token_loss"), None, None)
     return grads, out
 
 

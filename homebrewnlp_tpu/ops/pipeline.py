@@ -112,7 +112,10 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
     op takes ``tail_fn`` instead of composing with an outer ``jax.grad``:
 
       stage_fn(stage_params, stage_idx, x_micro) -> y_micro   (shape-kept)
-      tail_fn(tail_params, y_micro, *tail_args_micro) -> scalar mean loss
+      tail_fn(tail_params, y_micro, *tail_args_micro)
+          -> (scalar mean loss, aux)   # aux: pytree of scalar metrics
+                                       # (e.g. accuracy), averaged over
+                                       # microbatches like the loss
 
     Schedule: scan step k runs forward tick ``f = k`` (exactly GPipe's) and
     backward tick ``b = k - (P-1)``; stage s handles microbatch ``k - s``
@@ -121,9 +124,10 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
     ``M + 2P - 2``; each device does at most one forward and one backward
     stage-call per step (steady-state 1F1B).
 
-    Returns ``(loss, dstacked, dtail, dx)``: the mean loss over all
-    microbatches, gradients in the stacked [P, ...] layout, gradients for
-    ``tail_params`` (f32), and the cotangent of ``x``.
+    Returns ``(loss, aux, dstacked, dtail, dx)``: the mean loss and aux
+    metrics over all microbatches, gradients in the stacked [P, ...]
+    layout, gradients for ``tail_params`` (f32), and the cotangent of
+    ``x``.
     """
     assert x.shape[0] % n_micro == 0, (x.shape, n_micro)
     P, M = n_stages, n_micro
@@ -150,7 +154,7 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
             for t in targs)
         f32 = jnp.float32
         zeros_f32 = lambda tree: jax.tree_util.tree_map(
-            lambda p: to_var(jnp.zeros(p.shape, f32)), tree)
+            lambda p: to_var(jnp.zeros(jnp.shape(p), f32)), tree)
         carry0 = (
             to_var(jnp.zeros_like(micro[0])),            # fwd hop buffer
             to_var(jnp.zeros_like(micro[0])),            # bwd cotangent hop
@@ -159,13 +163,14 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
             zeros_f32(tailp),                            # tail grads
             to_var(jnp.zeros_like(micro)),               # dx per microbatch
             to_var(jnp.zeros((), f32)),                  # loss accumulator
+            zeros_f32(aux_proto),                        # aux metric means
         )
         fperm = [(i, (i + 1) % P) for i in range(P)]
         rperm = [(i, (i - 1) % P) for i in range(P)]
         is_last = idx == P - 1
 
         def tick(carry, k):
-            fbuf, bbuf, stash, dstage, dtail, dxs, loss = carry
+            fbuf, bbuf, stash, dstage, dtail, dxs, loss, aux = carry
             # ---- forward half: GPipe tick k ----
             m_f = k - idx
             inject = (idx == 0) & (k < M)
@@ -191,8 +196,9 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
             # last stage: this step's forward output IS microbatch m_b's
             # (schedule identity k-(P-1) = m_b there), so the tail vjp seeds
             # the backward without ever storing last-stage outputs
-            loss_m, tail_vjp = jax.vjp(
-                lambda tp, yy: tail_fn(tp, yy, *tmicro), tailp, y)
+            loss_m, tail_vjp, aux_m = jax.vjp(
+                lambda tp, yy: tail_fn(tp, yy, *tmicro), tailp, y,
+                has_aux=True)
             dtail_m, dy_tail = tail_vjp(to_var(jnp.asarray(1.0 / M,
                                                            loss_m.dtype)))
             cot = jnp.where(is_last, dy_tail, bbuf)
@@ -205,18 +211,27 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
             dtail = acc(dtail, dtail_m, bvalid & is_last)
             loss = loss + jnp.where(bvalid & is_last,
                                     loss_m.astype(f32) / M, 0)
+            aux = acc(aux, jax.tree_util.tree_map(
+                lambda a: a / M, aux_m), bvalid & is_last)
             wmask = ((jnp.arange(M) == m_b) & bvalid & (idx == 0))
             dxs = jnp.where(wmask.reshape((M,) + (1,) * dx.ndim),
                             dx[None], dxs)
             fbuf = jax.lax.ppermute(y, axis, fperm)
             bbuf = jax.lax.ppermute(dx, axis, rperm)
-            return (fbuf, bbuf, stash, dstage, dtail, dxs, loss), None
+            return (fbuf, bbuf, stash, dstage, dtail, dxs, loss, aux), None
 
         carry, _ = jax.lax.scan(tick, carry0, jnp.arange(M + 2 * P - 2))
-        _, _, _, dstage, dtail, dxs, loss = carry
+        _, _, _, dstage, dtail, dxs, loss, aux = carry
         lead = lambda tree: jax.tree_util.tree_map(lambda v: v[None], tree)
-        return loss[None], lead(dstage), lead(dtail), dxs[None]
+        return loss[None], lead(aux), lead(dstage), lead(dtail), dxs[None]
 
+    # the aux carry/out_spec must mirror the tail's (unknown-here) metric
+    # pytree: discover it ONCE via abstract eval on microbatch shapes
+    aux_proto = jax.eval_shape(
+        lambda tp, x0, *t: tail_fn(
+            tp, x0[:x.shape[0] // M],
+            *(ti[:x.shape[0] // M] for ti in t))[1],
+        tail_params, x, *tail_args)
     leading = PartitionSpec(axis)
     stage_specs = jax.tree_util.tree_map(lambda _: leading, stacked_params)
     rep = PartitionSpec()
@@ -226,12 +241,14 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
         in_specs=(stage_specs, rep_tree, rep,
                   tuple(rep for _ in tail_args)),
         out_specs=(PartitionSpec(axis),
+                   jax.tree_util.tree_map(lambda _: leading, aux_proto),
                    jax.tree_util.tree_map(lambda _: leading, stacked_params),
                    jax.tree_util.tree_map(lambda _: leading, tail_params),
                    PartitionSpec(axis)))
-    loss_p, dstacked, dtail_p, dxs_p = piped(stacked_params, tail_params, x,
-                                             tuple(tail_args))
+    loss_p, aux_p, dstacked, dtail_p, dxs_p = piped(
+        stacked_params, tail_params, x, tuple(tail_args))
     loss = loss_p[P - 1]
+    aux = jax.tree_util.tree_map(lambda v: v[P - 1], aux_p)
     dtail = jax.tree_util.tree_map(lambda v: jnp.sum(v, axis=0), dtail_p)
     dx = dxs_p[0].reshape(x.shape)
-    return loss, dstacked, dtail, dx
+    return loss, aux, dstacked, dtail, dx

@@ -804,24 +804,32 @@ def test_pipeline_1f1b_op_parity(eight_devices):
         return jax.nn.relu(xm @ w)
 
     def tail_fn(wt, y, t):
-        return jnp.mean((y * wt - t) ** 2)
+        loss = jnp.mean((y * wt - t) ** 2)
+        return loss, {"mae": jnp.mean(jnp.abs(y * wt - t))}
 
     def run(ws, wt, x, tgt):
         with mesh:
             return pipeline_1f1b(stage_fn, tail_fn, ws, wt, x, (tgt,),
                                  P, M, mesh)
 
-    loss, dws, dwt, dx = jax.jit(run)(ws, wt, x, tgt)
+    loss, aux, dws, dwt, dx = jax.jit(run)(ws, wt, x, tgt)
 
-    def seq_loss(ws, wt, x, tgt):
+    def seq_out(ws, x):
         y = x
         for i in range(P):
             y = jax.nn.relu(y @ ws[i])
-        return tail_fn(wt, y, tgt)
+        return y
+
+    def seq_loss(ws, wt, x, tgt):
+        return tail_fn(wt, seq_out(ws, x), tgt)[0]
 
     gw, gt, gx = jax.grad(seq_loss, argnums=(0, 1, 2))(ws, wt, x, tgt)
     np.testing.assert_allclose(float(loss), float(seq_loss(ws, wt, x, tgt)),
                                rtol=1e-5)
+    # aux metrics averaged over microbatches == full-batch value (equal
+    # micro sizes, mean metric)
+    full_mae = float(jnp.mean(jnp.abs(seq_out(ws, x) * wt - tgt)))
+    np.testing.assert_allclose(float(aux["mae"]), full_mae, rtol=1e-5)
     np.testing.assert_allclose(np.asarray(dws), np.asarray(gw),
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(dwt), np.asarray(gt),
@@ -838,7 +846,7 @@ def test_pipeline_1f1b_op_parity(eight_devices):
             return pipeline_1f1b(stage_fn, tail_fn, ws, wt, x, (tgt,),
                                  P, B, mesh)
 
-    lossB, dwsB, dwtB, dxB = jax.jit(run_mb)(ws, wt, x, tgt)
+    lossB, _, dwsB, dwtB, dxB = jax.jit(run_mb)(ws, wt, x, tgt)
     np.testing.assert_allclose(float(lossB), float(loss), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(dwsB), np.asarray(gw),
                                rtol=1e-4, atol=1e-5)
@@ -883,9 +891,10 @@ def test_pipeline_1f1b_trains_with_parity(eight_devices):
                                    np.asarray(sf.params[k], np.float32),
                                    rtol=2e-4, atol=2e-6, err_msg=k)
 
-    # shared weights + 1f1b compose (the flagship mixer DSL)
+    # shared weights + 1f1b compose (the flagship mixer DSL), and the
+    # accuracy/token_loss metrics ride the schedule's aux stream
     from .backend import mixer_config
-    mcfg = dict(mixer_config(depth=4, calc_accuracy=False).dict())
+    mcfg = dict(mixer_config(depth=4, calc_accuracy=True).dict())
     cfg_ms = Config(dict(mcfg, memory_reduction_strategy="none",
                          pipeline_parallel=2, pipeline_schedule="1f1b"))
     cfg_mg = Config(dict(mcfg, memory_reduction_strategy="none",
@@ -897,6 +906,10 @@ def test_pipeline_1f1b_trains_with_parity(eight_devices):
     gms, oms = tms._grads(sms.params, mbatch, jax.random.key(1))
     gmg, omg = tmg._grads(smg.params, mbatch, jax.random.key(1))
     np.testing.assert_allclose(float(oms.loss), float(omg.loss), rtol=1e-5)
+    np.testing.assert_allclose(float(oms.accuracy), float(omg.accuracy),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(oms.token_loss), float(omg.token_loss),
+                               rtol=1e-5)
     for k in gmg:
         np.testing.assert_allclose(np.asarray(gms[k], np.float32),
                                    np.asarray(gmg[k], np.float32),
@@ -908,9 +921,9 @@ def test_pipeline_1f1b_config_validation():
     base = _pipe_base(depth=4)
     with pytest.raises(ValueError, match="pipeline_schedule"):
         Config(dict(base, pipeline_parallel=2, pipeline_schedule="zigzag"))
-    with pytest.raises(ValueError, match="accuracy"):
-        Config(dict(base, pipeline_parallel=2, pipeline_schedule="1f1b",
-                    calc_accuracy=True))
+    # accuracy rides the schedule's aux stream since round 4 — accepted
+    Config(dict(base, pipeline_parallel=2, pipeline_schedule="1f1b",
+                calc_accuracy=True))
     with pytest.raises(ValueError, match="multi-loss"):
         Config(dict(base, pipeline_parallel=2, pipeline_schedule="1f1b",
                     multi_loss_strategy="pcgrad"))
