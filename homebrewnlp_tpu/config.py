@@ -312,10 +312,15 @@ class Config:
             # is replicated per stage and its grad stage-summed
             # (models.sync_shared_pipeline_grads), preserving exact sharing
             # semantics — the flagship's shared mixer maps can pipeline
-            if any(s.split("-")[0] == "routed_moe" for s in body_specs):
+            if (any(s.split("-")[0] == "routed_moe" for s in body_specs)
+                    and self.pipeline_schedule != "1f1b"
+                    and self.moe_balance_weight > 0):
                 raise ValueError(
-                    "pipeline_parallel cannot carry the routed_moe balance "
-                    "aux loss across the pipeline shard_map boundary")
+                    "pipeline_parallel under the gpipe schedule cannot carry "
+                    "the routed_moe balance aux loss across the pipeline "
+                    "shard_map boundary; use pipeline_schedule='1f1b' (the "
+                    "aux loss rides the schedule's stage stream) or set "
+                    "moe_balance_weight=0")
             if self.pipeline_schedule == "1f1b":
                 # the loss rides inside the 1F1B schedule (the last stage's
                 # tail seeds each microbatch's backward), which constrains

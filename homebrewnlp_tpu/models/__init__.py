@@ -251,23 +251,40 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
     from ..ops.pipeline import gpipe
     from ..parallel.mesh import PIPE_AXIS
     cfg = ctx.cfg
+    # aux-carrying layers (routed-MoE balance): thread the aux-loss stream
+    # through the forward so eval/build() reports the same total loss the
+    # 1F1B training path optimizes
+    needs_aux = cfg.moe_balance_weight > 0 and any(
+        spec.split("-")[0] == "routed_moe"
+        for blk in cfg.block_config
+        for spec in (blk["layer"] if isinstance(blk, dict) else blk.layer))
     stage_fn, stacked, n_stages = _pipeline_machinery(
         cfg, ctx.params, src.names, ctx.rng, ctx.train, ctx.seed,
-        seq, attn_starts, mode_scope=ctx._scope[0])
+        seq, attn_starts, mode_scope=ctx._scope[0], with_aux=needs_aux)
     n_micro = _pipeline_n_micro(src.x.shape[0], n_stages)
-    y = gpipe(stage_fn, stacked, src.x, n_stages, n_micro, ctx.mesh,
-              PIPE_AXIS)
+    if needs_aux:
+        y, aux_total = gpipe(stage_fn, stacked, src.x, n_stages, n_micro,
+                             ctx.mesh, PIPE_AXIS, with_aux=True)
+        ctx.aux_losses.append(aux_total)
+    else:
+        y = gpipe(stage_fn, stacked, src.x, n_stages, n_micro, ctx.mesh,
+                  PIPE_AXIS)
     ctx.attention_idx = acc
     return NT(y, names=src.names)
 
 
 def _pipeline_machinery(cfg: Config, params, names, rng, train, seed,
-                        seq, attn_starts, mode_scope):
+                        seq, attn_starts, mode_scope, with_aux=False):
     """(stage_fn, stacked slot list, n_stages) shared by the GPipe forward
     body and the 1F1B loss-and-grad path.  ``stage_fn(slot_params, idx, x)``
     runs one stage's block groups on one microbatch; ``stacked`` is the
     per-group list of stage-stacked param dicts (shared leaves replicated,
-    see stack_pipeline_params)."""
+    see stack_pipeline_params).
+
+    ``with_aux`` (the 1F1B contract): stage_fn returns ``(y, aux_loss)``
+    where aux_loss is the f32 sum of the stage's layer-collected auxiliary
+    loss terms (routed-MoE balance) — threaded through jax.checkpoint as a
+    real output, exactly like the sequential body does."""
     n_stages = cfg.pipeline_parallel
     n_groups = len(seq)
     assert n_groups % n_stages == 0
@@ -302,7 +319,13 @@ def _pipeline_machinery(cfg: Config, params, names, rng, train, seed,
             bctx._scope = [mode_scope, "body"]
             bctx.attention_idx = attn_starts[j]
             with bctx.scope(_block_scope(i0, c0)):
-                return block_part_fn(bctx, conf, x_nt)
+                out = block_part_fn(bctx, conf, x_nt)
+            if not with_aux:
+                return out
+            aux = jnp.float32(0.0)
+            for a in bctx.aux_losses:
+                aux = aux + a.astype(jnp.float32)
+            return out, aux
 
         return f
 
@@ -311,10 +334,15 @@ def _pipeline_machinery(cfg: Config, params, names, rng, train, seed,
 
     def stage_fn(slot_params, stage_idx, x):
         out = NT(x, names)
+        aux_total = jnp.float32(0.0)
         for j, f in enumerate(block_fs):
             run = jax.checkpoint(f, static_argnums=()) if remat else f
-            out = run(slot_params[j], out, stage_idx)
-        return out.x
+            if with_aux:
+                out, aux = run(slot_params[j], out, stage_idx)
+                aux_total = aux_total + aux
+            else:
+                out = run(slot_params[j], out, stage_idx)
+        return (out.x, aux_total) if with_aux else out.x
 
     return stage_fn, stacked, n_stages
 
@@ -399,7 +427,7 @@ def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh):
 
     stage_fn, stacked, n_stages = _pipeline_machinery(
         cfg, params, names, rng, True, 0, seq, attn_starts,
-        mode_scope=cfg.model_mode)
+        mode_scope=cfg.model_mode, with_aux=True)
     n_micro = _pipeline_n_micro(src_nt.x.shape[0], n_stages, "1f1b")
 
     batch_keys = sorted(batch.keys())

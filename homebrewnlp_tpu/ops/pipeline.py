@@ -39,14 +39,19 @@ from jax.sharding import Mesh, PartitionSpec
 
 def gpipe(stage_fn: typing.Callable, stacked_params, x: jnp.ndarray,
           n_stages: int, n_micro: int, mesh: Mesh,
-          axis: str = "pipeline") -> jnp.ndarray:
+          axis: str = "pipeline", with_aux: bool = False):
     """Apply ``n_stages`` sequential stages to ``x`` with GPipe overlap.
 
     ``stage_fn(stage_params, stage_index, x_micro) -> y_micro`` runs ONE
     stage on one microbatch (stage_params = the pytree with the leading
     stage axis already stripped).  ``x`` is [B, ...]; B must divide by
     ``n_micro``.  Returns [B, ...] after all stages.
-    """
+
+    ``with_aux``: stage_fn returns ``(y_micro, aux_loss_scalar)`` instead;
+    valid ticks' aux terms are averaged over microbatches, summed over
+    stages, and returned as ``(y, aux_total)`` — so the forward/eval path
+    of an aux-carrying model (routed-MoE balance) reports the same total
+    loss as the 1F1B training path."""
     assert x.shape[0] % n_micro == 0, (x.shape, n_micro)
 
     def body(params, xs):
@@ -57,37 +62,49 @@ def gpipe(stage_fn: typing.Callable, stacked_params, x: jnp.ndarray,
             (axis,), to="varying")
         buf = jnp.zeros_like(micro[0])
         outs = jnp.zeros_like(micro)
+        aux_acc = jax.lax.pcast(jnp.zeros((), jnp.float32), (axis,),
+                                to="varying")
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def tick(carry, t):
-            buf, outs = carry
+            buf, outs, aux_acc = carry
             # boolean where-selects, not arithmetic masking: warm-up/drain
             # ticks compute on zero or stale rotated activations, and a
             # non-finite garbage y would poison real lanes via NaN*0=NaN
             inject = (idx == 0) & (t < n_micro)
             feed = jnp.where(inject, micro[jnp.minimum(t, n_micro - 1)], buf)
-            y = stage_fn(params, idx, feed)
+            if with_aux:
+                y, aux = stage_fn(params, idx, feed)
+                m_f = t - idx
+                fvalid = (m_f >= 0) & (m_f < n_micro)
+                aux_acc = aux_acc + jnp.where(
+                    fvalid, aux.astype(jnp.float32) / n_micro, 0)
+            else:
+                y = stage_fn(params, idx, feed)
             emit_t = t - (n_stages - 1)
             mask = ((jnp.arange(n_micro) == emit_t)
                     & (idx == n_stages - 1))
             mask = mask.reshape((n_micro,) + (1,) * y.ndim)
             outs = jnp.where(mask, y[None], outs)
             buf = jax.lax.ppermute(y, axis, perm)
-            return (buf, outs), None
+            return (buf, outs, aux_acc), None
 
-        (_, outs), _ = jax.lax.scan(tick, (buf, outs),
-                                    jnp.arange(n_micro + n_stages - 1))
-        return outs[None]  # [1(stage), M, b/M, ...] — pipe stays sharded
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            tick, (buf, outs, aux_acc), jnp.arange(n_micro + n_stages - 1))
+        # [1(stage), ...] — pipe stays sharded
+        return outs[None], aux_acc[None]
 
     leading = PartitionSpec(axis)
     param_specs = jax.tree_util.tree_map(lambda _: leading, stacked_params)
     piped = jax.shard_map(
         body, mesh=mesh, axis_names=frozenset({axis}),
         in_specs=(param_specs, PartitionSpec()),
-        out_specs=PartitionSpec(axis))
-    outs = piped(stacked_params, x)      # [P, M, b/M, ...]
-    final = outs[n_stages - 1]           # last stage's slice
-    return final.reshape(x.shape)
+        out_specs=(PartitionSpec(axis), PartitionSpec(axis)))
+    outs, aux_p = piped(stacked_params, x)   # [P, M, b/M, ...], [P]
+    final = outs[n_stages - 1].reshape(x.shape)
+    if with_aux:
+        return final, jnp.sum(aux_p)
+    return final
 
 
 def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
@@ -111,7 +128,15 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
     seeds microbatch m's backward is d loss_m / d y_m), which is why this
     op takes ``tail_fn`` instead of composing with an outer ``jax.grad``:
 
-      stage_fn(stage_params, stage_idx, x_micro) -> y_micro   (shape-kept)
+      stage_fn(stage_params, stage_idx, x_micro)
+          -> (y_micro, stage_aux_loss)   # y shape-kept; stage_aux_loss: a
+                                         # scalar LOSS term arising inside
+                                         # the stage (e.g. the routed-MoE
+                                         # balance loss), averaged over
+                                         # microbatches and summed over
+                                         # stages into the total — its
+                                         # cotangent seeds the stage vjp
+                                         # alongside the activation's
       tail_fn(tail_params, y_micro, *tail_args_micro)
           -> (scalar mean loss, aux)   # aux: pytree of scalar metrics
                                        # (e.g. accuracy), averaged over
@@ -124,10 +149,10 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
     ``M + 2P - 2``; each device does at most one forward and one backward
     stage-call per step (steady-state 1F1B).
 
-    Returns ``(loss, aux, dstacked, dtail, dx)``: the mean loss and aux
-    metrics over all microbatches, gradients in the stacked [P, ...]
-    layout, gradients for ``tail_params`` (f32), and the cotangent of
-    ``x``.
+    Returns ``(loss, aux, dstacked, dtail, dx)``: the mean loss (tail
+    loss + stage aux-loss terms) and aux metrics over all microbatches,
+    gradients in the stacked [P, ...] layout, gradients for
+    ``tail_params`` (f32), and the cotangent of ``x``.
     """
     assert x.shape[0] % n_micro == 0, (x.shape, n_micro)
     P, M = n_stages, n_micro
@@ -162,7 +187,8 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
             zeros_f32(params),                           # stage grads
             zeros_f32(tailp),                            # tail grads
             to_var(jnp.zeros_like(micro)),               # dx per microbatch
-            to_var(jnp.zeros((), f32)),                  # loss accumulator
+            to_var(jnp.zeros((), f32)),                  # tail loss acc
+            to_var(jnp.zeros((), f32)),                  # stage aux-loss acc
             zeros_f32(aux_proto),                        # aux metric means
         )
         fperm = [(i, (i + 1) % P) for i in range(P)]
@@ -170,7 +196,8 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
         is_last = idx == P - 1
 
         def tick(carry, k):
-            fbuf, bbuf, stash, dstage, dtail, dxs, loss, aux = carry
+            (fbuf, bbuf, stash, dstage, dtail, dxs, loss, stage_aux,
+             aux) = carry
             # ---- forward half: GPipe tick k ----
             m_f = k - idx
             inject = (idx == 0) & (k < M)
@@ -184,7 +211,7 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
                 fvalid,
                 jax.lax.dynamic_update_index_in_dim(stash, feed, slot_f, 0),
                 stash)
-            y = stage_fn(params, idx, feed)
+            y, _ = stage_fn(params, idx, feed)
             # ---- backward half: tick k - (P-1) ----
             m_b = k - 2 * (P - 1) + idx
             bvalid = (m_b >= 0) & (m_b < M)
@@ -202,9 +229,22 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
             dtail_m, dy_tail = tail_vjp(to_var(jnp.asarray(1.0 / M,
                                                            loss_m.dtype)))
             cot = jnp.where(is_last, dy_tail, bbuf)
-            _, svjp = jax.vjp(
-                lambda p, xx: stage_fn(p, idx, xx), params, x_in)
-            dp, dx = svjp(cot)
+            def stage_varying_aux(p, xx):
+                # a stage whose aux term is a CONSTANT (no aux layers)
+                # returns an unvarying scalar; pvary it so the vjp accepts
+                # the varying seed (no-op when aux depends on the varying
+                # inputs/params, and a constant carries no grads anyway)
+                yy, aux_out = stage_fn(p, idx, xx)
+                return yy, to_var(aux_out)
+
+            (_, aux_loss_m), svjp = jax.vjp(stage_varying_aux, params, x_in)
+            # the stage aux loss enters the total with weight 1/M; its
+            # cotangent seeds the replay vjp alongside the activation's
+            aux_seed = to_var(jnp.where(bvalid, 1.0 / M, 0.0).astype(
+                aux_loss_m.dtype))
+            dp, dx = svjp((cot, aux_seed))
+            stage_aux = stage_aux + jnp.where(
+                bvalid, aux_loss_m.astype(f32) / M, 0)
             acc = lambda a, b, gate: jax.tree_util.tree_map(
                 lambda u, v: u + jnp.where(gate, v.astype(f32), 0), a, b)
             dstage = acc(dstage, dp, bvalid)
@@ -218,12 +258,14 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
                             dx[None], dxs)
             fbuf = jax.lax.ppermute(y, axis, fperm)
             bbuf = jax.lax.ppermute(dx, axis, rperm)
-            return (fbuf, bbuf, stash, dstage, dtail, dxs, loss, aux), None
+            return (fbuf, bbuf, stash, dstage, dtail, dxs, loss, stage_aux,
+                    aux), None
 
         carry, _ = jax.lax.scan(tick, carry0, jnp.arange(M + 2 * P - 2))
-        _, _, _, dstage, dtail, dxs, loss, aux = carry
+        _, _, _, dstage, dtail, dxs, loss, stage_aux, aux = carry
         lead = lambda tree: jax.tree_util.tree_map(lambda v: v[None], tree)
-        return loss[None], lead(aux), lead(dstage), lead(dtail), dxs[None]
+        return (loss[None], stage_aux[None], lead(aux), lead(dstage),
+                lead(dtail), dxs[None])
 
     # the aux carry/out_spec must mirror the tail's (unknown-here) metric
     # pytree: discover it ONCE via abstract eval on microbatch shapes
@@ -241,13 +283,15 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
         in_specs=(stage_specs, rep_tree, rep,
                   tuple(rep for _ in tail_args)),
         out_specs=(PartitionSpec(axis),
+                   PartitionSpec(axis),
                    jax.tree_util.tree_map(lambda _: leading, aux_proto),
                    jax.tree_util.tree_map(lambda _: leading, stacked_params),
                    jax.tree_util.tree_map(lambda _: leading, tail_params),
                    PartitionSpec(axis)))
-    loss_p, aux_p, dstacked, dtail_p, dxs_p = piped(
+    loss_p, stage_aux_p, aux_p, dstacked, dtail_p, dxs_p = piped(
         stacked_params, tail_params, x, tuple(tail_args))
-    loss = loss_p[P - 1]
+    # total = the last stage's tail loss + every stage's aux-loss terms
+    loss = loss_p[P - 1] + jnp.sum(stage_aux_p)
     aux = jax.tree_util.tree_map(lambda v: v[P - 1], aux_p)
     dtail = jax.tree_util.tree_map(lambda v: jnp.sum(v, axis=0), dtail_p)
     dx = dxs_p[0].reshape(x.shape)
