@@ -1006,3 +1006,42 @@ def test_pipeline_1f1b_routed_moe(eight_devices):
     # and it trains end to end
     state2, m2 = trainer.step(state, batch, jax.random.key(1))
     assert np.isfinite(float(m2["loss"]))
+
+
+def test_cli_train_1f1b_checkpoint_resume(eight_devices, tmp_path):
+    """Whole-CLI integration under the 1F1B schedule: train with routed-MoE
+    + accuracy metrics + checkpointing, then a second invocation restores
+    the step and continues — the paths unit tests cover individually, run
+    through main.py as a user would."""
+    import json
+
+    from homebrewnlp_tpu.main import main as cli_main
+
+    cfg = dict(
+        model_mode="gpt", use_video=False, sequence_length=16, heads=2,
+        features_per_head=32, vocab_size=64, depth=4, train_batch_size=16,
+        memory_reduction_strategy="none", optimizer="adam-learning_rate",
+        learning_rate=1e-2, weight_decay=0.0, experts=4,
+        intermediate_feed_forward_multiplier_multiplier=0.5,
+        pipeline_parallel=2, pipeline_schedule="1f1b", calc_accuracy=True,
+        tpu_size=8, use_checkpointing=True, steps_per_checkpoint=4,
+        model_path=str(tmp_path / "run"),
+        block_config=[
+            {"layer": ["norm-shift-scale", "feed_forward-in:relu"]},
+            {"layer": ["norm-shift-scale", "routed_moe-topk2-capacity2"]}])
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(cfg))
+
+    cli_main(["--model", str(cfg_path), "--run_mode", "train",
+              "--steps", "6"])
+    metrics_file = tmp_path / "run" / "metrics.jsonl"
+    rows = [json.loads(l) for l in metrics_file.read_text().splitlines()]
+    assert rows[-1]["step"] == 5
+    assert "accuracy" in rows[-1] and "token_loss" in rows[-1]
+
+    cli_main(["--model", str(cfg_path), "--run_mode", "train",
+              "--steps", "9"])
+    rows = [json.loads(l) for l in metrics_file.read_text().splitlines()]
+    # restore picked up the step-4+ checkpoint and continued to 9
+    assert rows[-1]["step"] == 8
+    assert all(np.isfinite(r["loss"]) for r in rows)
