@@ -8,6 +8,7 @@ deferred to sharding constraints applied by the caller (parallel/apply.py).
 """
 from __future__ import annotations
 
+import logging
 import typing
 
 import jax
@@ -261,7 +262,12 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
     stage_fn, stacked, n_stages = _pipeline_machinery(
         cfg, ctx.params, src.names, ctx.rng, ctx.train, ctx.seed,
         seq, attn_starts, mode_scope=ctx._scope[0], with_aux=needs_aux)
-    n_micro = _pipeline_n_micro(src.x.shape[0], n_stages)
+    # match the training schedule's micro partition: for 1F1B configs the
+    # balance loss and capacity-dropped tokens of routed-MoE layers depend on
+    # M, so eval/build() must pick the same M the 1F1B training path picks
+    # (largest divisor with >= 8 rows) rather than gpipe's smallest
+    n_micro = _pipeline_n_micro(src.x.shape[0], n_stages,
+                                cfg.pipeline_schedule)
     if needs_aux:
         y, aux_total = gpipe(stage_fn, stacked, src.x, n_stages, n_micro,
                              ctx.mesh, PIPE_AXIS, with_aux=True)
@@ -367,13 +373,15 @@ def _pipeline_n_micro(batch: int, n_stages: int,
             return max(big)
     n_micro = min(at_least_p) if at_least_p else max(divisors)
     if n_micro < n_stages:
-        print(f"WARNING: batch {batch} yields only {n_micro} pipeline "
-              f"microbatches for {n_stages} stages — pipe utilization "
-              f"{n_micro}/{n_stages}")
+        logging.getLogger(__name__).warning(
+            "batch %d yields only %d pipeline microbatches for %d stages "
+            "— pipe utilization %d/%d", batch, n_micro, n_stages, n_micro,
+            n_stages)
     return n_micro
 
 
-def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh):
+def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh,
+                             seed: int = 0):
     """1F1B training path (``pipeline_schedule='1f1b'``): loss AND grads
     from one interleaved pipeline schedule (ops/pipeline.py::pipeline_1f1b).
 
@@ -408,7 +416,8 @@ def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh):
     spatial_ctx = batch["token_y"].names[-2]
 
     def upstream(other_params):
-        ctx = Ctx(cfg, params=other_params, train=True, rng=rng, mesh=mesh)
+        ctx = Ctx(cfg, params=other_params, train=True, rng=rng, mesh=mesh,
+                  seed=seed)
         with ctx.scope(cfg.model_mode):
             src, _ = ctx.scoped("input", _input, ctx, batch, spatial_ctx)
             with ctx.scope("body"):
@@ -425,8 +434,10 @@ def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh):
     src_nt, up_vjp = jax.vjp(upstream, other)
     names = src_nt.names
 
+    # thread the caller's Ctx seed (the same value build()/_losses uses, so
+    # any seed-dependent apply-time behavior matches the eval walk)
     stage_fn, stacked, n_stages = _pipeline_machinery(
-        cfg, params, names, rng, True, 0, seq, attn_starts,
+        cfg, params, names, rng, True, seed, seq, attn_starts,
         mode_scope=cfg.model_mode, with_aux=True)
     n_micro = _pipeline_n_micro(src_nt.x.shape[0], n_stages, "1f1b")
 
@@ -437,7 +448,7 @@ def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh):
     def tail_fn(other_params, y, *tail_micro):
         micro_batch = {k: NT(a, batch_names[k])
                        for k, a in zip(batch_keys, tail_micro)}
-        ctx = Ctx(cfg, params=other_params, train=True,
+        ctx = Ctx(cfg, params=other_params, train=True, seed=seed,
                   rng=None if rng is None else jax.random.fold_in(rng, 3001))
         with ctx.scope(cfg.model_mode):
             frame_out, token_out = ctx.scoped(

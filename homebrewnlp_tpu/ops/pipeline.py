@@ -153,6 +153,20 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
     loss + stage aux-loss terms) and aux metrics over all microbatches,
     gradients in the stacked [P, ...] layout, gradients for
     ``tail_params`` (f32), and the cotangent of ``x``.
+
+    Known overhead: the tail (output projection over the vocab + loss +
+    its vjp) runs on EVERY stage every tick and only the last stage's
+    result survives the where-select, so ~(P-1)/P of the tail compute — a
+    d_model x vocab matmul + backward per tick — is discarded.  This is
+    forced by SPMD: all devices in the shard_map region trace one program
+    with uniform shapes, a ``lax.cond`` on the (per-device) stage index
+    lowers to select-both-branches on TPU, and a smaller dummy tail input
+    on non-last stages would break shape uniformity.  For the byte-level
+    configs shipped here (vocab 256) the tail is <1% of a tick; on a
+    large-vocab model prefer more pipeline microbatches (amortizes every
+    per-tick overhead) or a factorized vocab projection
+    (``vocab_weight_factorization``) which shrinks the discarded matmul
+    to d_model x factor.
     """
     assert x.shape[0] % n_micro == 0, (x.shape, n_micro)
     P, M = n_stages, n_micro

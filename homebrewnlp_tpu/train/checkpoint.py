@@ -12,6 +12,7 @@ directly; see data/resume.py which also keeps the replay option).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import typing
 
@@ -67,10 +68,25 @@ class Checkpointer:
         # name; single-process probes the plain name then rank 0's
         legacy = os.path.join(self.path, f"data_state_{step}.json")
         rank0 = os.path.join(self.path, f"data_state_{step}_p0.json")
-        for path in (self._data_state_path(step), legacy, rank0):
+        own = self._data_state_path(step)
+        for path in (own, legacy, rank0):
             if os.path.exists(path):
+                if path != own:
+                    # loud like the params-migration NOTE: after a
+                    # process-count change this rank resumes from another
+                    # rank's (or the legacy single-process) stream position,
+                    # so rows may repeat or skip relative to its own history
+                    logging.getLogger(__name__).warning(
+                        "rank %d data cursor %s missing; falling back to %s "
+                        "— this rank's data-stream position comes from a "
+                        "different process layout", jax.process_index(),
+                        os.path.basename(own), os.path.basename(path))
                 with open(path) as f:
                     return json.load(f)
+        logging.getLogger(__name__).warning(
+            "no data cursor found for step %d (rank %d) — the input "
+            "pipeline restarts from its initial position", step,
+            jax.process_index())
         return None
 
     def wait(self) -> None:

@@ -107,8 +107,11 @@ class Trainer:
             # loss and grads come from ONE interleaved pipeline schedule —
             # no outer jax.grad (models.pipelined_loss_and_grads)
             from ..models import pipelined_loss_and_grads
+            # seed=0 is the same default Ctx seed _losses builds with, so
+            # the 1F1B walk and the eval walk see identical apply-time
+            # seed-dependent behavior
             return pipelined_loss_and_grads(cfg, params, batch, rng,
-                                            self.mesh)
+                                            self.mesh, seed=0)
         if cfg.multi_loss_strategy == "linear":
             def total(p):
                 o = self._losses(p, batch, rng)

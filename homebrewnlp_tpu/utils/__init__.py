@@ -28,9 +28,13 @@ def enable_compilation_cache(path: typing.Optional[str] = None):
     path = os.path.expanduser(path)
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    # cache every compile: the default 1 s floor would skip medium programs
-    # whose relay round-trip still dominates a warm restart
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # a small nonzero floor (vs the default 1 s, which would skip medium
+    # programs whose relay round-trip still dominates a warm restart): keeps
+    # trivial sub-100ms compiles from accumulating unboundedly in the
+    # default-on per-user directory, which has no eviction — growth is
+    # bounded by the set of distinct non-trivial programs, and the
+    # directory is safe to rm -rf at any time
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return path
 
