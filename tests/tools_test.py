@@ -288,3 +288,302 @@ def test_text2tfrecord_jsonl_zst(tmp_path):
     texts = [decode_example(p)["text"][0].decode() for p in payloads]
     assert texts == docs
     assert total == sum(len(d) for d in docs)
+
+
+# -- download front end (tools/fetch.py): the reference's proxied fleet ------
+# (reference scripts/video2tfrecord.py:57-129,373-760) with every network
+# call mocked — no egress needed to execute the logic.
+
+def _chunked(data: bytes, n: int = 7):
+    return [data[i:i + n] for i in range(0, len(data), n)]
+
+
+def test_rate_limiter_spacing():
+    from tools.fetch import RateLimiter
+    t = [0.0]
+    slept = []
+
+    def clock():
+        return t[0]
+
+    def sleep(s):
+        slept.append(s)
+        t[0] += s
+
+    rl = RateLimiter(1.0, clock=clock, sleep=sleep)
+    rl.wait()            # first call never sleeps
+    t[0] += 0.25
+    rl.wait()            # 0.75s early
+    rl.wait()            # immediately again: full interval
+    assert slept == [0.75, 1.0]
+
+
+def test_proxy_rotator_paginates_filters_and_rotates():
+    import random
+
+    from tools.fetch import ProxyRotator
+    pages = {
+        "/api/proxy/list/?page=1": {
+            "next": "/api/proxy/list/?page=2",
+            "results": [{"valid": False, "username": "x", "password": "x",
+                         "proxy_address": "bad", "ports": {"http": 1}}]},
+        "/api/proxy/list/?page=2": {
+            "next": None,
+            "results": [{"valid": True, "username": "u", "password": "p",
+                         "proxy_address": "1.2.3.4", "ports": {"http": 80}}]},
+    }
+    calls = []
+
+    def fetch_json(url, headers):
+        assert headers == {"Authorization": "Token KEY"}
+        path = url[len("https://proxy.webshare.io"):]
+        calls.append(path)
+        return pages[path]
+
+    rot = ProxyRotator(fetch_json, "KEY", rng=random.Random(0))
+    assert rot.proxies == {"http": "http://u:p@1.2.3.4:80",
+                           "https": "http://u:p@1.2.3.4:80"}
+    assert calls == ["/api/proxy/list/?page=1", "/api/proxy/list/?page=2"]
+    rot.rotate()
+    assert len(calls) == 4  # rotate() re-fetches the pool
+
+    # no API key => no-proxy stub (reference webshare_io_key=None)
+    assert ProxyRotator(fetch_json, None).proxies is None
+
+
+def test_downloader_retries_rotates_and_cleans_partial(tmp_path):
+    import random
+
+    from tools.fetch import Downloader, ProxyRotator
+    page = {"next": None, "results": [
+        {"valid": True, "username": "u", "password": "p",
+         "proxy_address": "1.2.3.4", "ports": {"http": 80}}]}
+    rotations = []
+
+    def fetch_json(url, headers):
+        rotations.append(url)
+        return page
+
+    rot = ProxyRotator(fetch_json, "KEY", rng=random.Random(0))
+    attempts = []
+
+    def flaky(url, proxies):
+        attempts.append(proxies)
+        if len(attempts) < 3:
+            yield b"partial"
+            raise IOError("mid-stream drop")
+        yield from _chunked(b"final payload")
+
+    d = Downloader(flaky, rot, max_try=3)
+    out = tmp_path / "a.bin"
+    assert d.download("http://v", str(out), use_proxy=True)
+    assert out.read_bytes() == b"final payload"
+    assert len(attempts) == 3
+    # proxied failures rotate the proxy before the next try (reference :84-87)
+    assert len(rotations) == 3  # 1 init + 2 failure rotations
+
+    def always_fail(url, proxies):
+        yield b"junk"
+        raise IOError("down")
+
+    d2 = Downloader(always_fail, rot, max_try=2)
+    out2 = tmp_path / "b.bin"
+    assert not d2.download("http://v", str(out2), use_proxy=False)
+    assert not out2.exists()  # partial file removed (reference :90-92)
+
+
+def test_select_video_format_resolution_and_webm_demotion():
+    from tools.fetch import select_video_format
+    formats = [
+        {"format_note": "tiny", "width": 9999, "height": 9999,
+         "ext": "mp4", "url": "audio"},          # audio-only: skipped
+        {"width": 256, "height": 144, "ext": "mp4", "url": "too-small"},
+        {"width": 1920, "height": 1080, "ext": "mp4", "url": "too-big"},
+        {"width": 640, "height": 360, "ext": "webm", "url": "w-webm"},
+        {"width": 640, "height": 360, "ext": "mp4", "url": "w-mp4"},
+        {"width": 640, "height": None, "ext": "mp4", "url": "no-h"},
+    ]
+    out = select_video_format(formats, (320, 176))
+    # smallest resolution strictly above target wins; mp4 before webm
+    assert [f["url"] for f in out] == ["w-mp4", "w-webm"]
+
+
+def test_select_caption_track_en_vtt():
+    from tools.fetch import select_caption_track
+    info = {"automatic_captions": {"en": [
+        {"ext": "srv1", "url": "no"},
+        {"ext": "vtt", "url": "http://caps/en.vtt"},
+        {"ext": "vtt", "url": "later"},
+    ], "de": [{"ext": "vtt", "url": "wrong-lang"}]}}
+    assert select_caption_track(info) == "http://caps/en.vtt"
+    assert select_caption_track({}) is None
+
+
+def test_fetch_video_mocked_transport(tmp_path):
+    from tools.fetch import Downloader, fetch_video
+    info = {
+        "formats": [
+            {"width": 640, "height": 360, "ext": "webm", "url": "u-webm"},
+            {"width": 640, "height": 360, "ext": "mp4", "url": "u-mp4"},
+        ],
+        "automatic_captions": {"en": [{"ext": "vtt", "url": "u-vtt"}]},
+    }
+    served = {"u-mp4": b"mp4 bytes", "u-webm": b"webm bytes",
+              "u-vtt": b"WEBVTT\n"}
+    proxy_log = []
+
+    def transport(url, proxies):
+        proxy_log.append((url, proxies))
+        yield from _chunked(served[url])
+
+    d = Downloader(transport, None)
+    video, vtt = fetch_video(
+        "abc123", str(tmp_path), lambda url: info, d,
+        target_resolution=(320, 176), want_subtitles=True)
+    assert video == str(tmp_path / "abc123.mp4")
+    assert vtt == str(tmp_path / "abc123.vtt")
+    assert open(video, "rb").read() == b"mp4 bytes"
+    # mp4 preferred over webm; vtt fetched after the video
+    assert [u for u, _ in proxy_log] == ["u-mp4", "u-vtt"]
+
+    # failed info extraction never raises (reference :525-527)
+    def boom(url):
+        raise RuntimeError("scrape blocked")
+
+    assert fetch_video("zzz", str(tmp_path), boom, d, (320, 176)) == (None,
+                                                                      None)
+
+
+def test_fetch_video_falls_through_invalid_candidates(tmp_path):
+    from tools.fetch import Downloader, fetch_video
+    info = {"formats": [
+        {"width": 640, "height": 360, "ext": "mp4", "url": "u-corrupt"},
+        {"width": 640, "height": 360, "ext": "webm", "url": "u-good"},
+    ]}
+    served = {"u-corrupt": b"garbage", "u-good": b"webm bytes"}
+    converted = []
+
+    def transport(url, proxies):
+        yield served[url]
+
+    def convert(src, dst):
+        converted.append((src, dst))
+        os.rename(src, dst)
+
+    d = Downloader(transport, None)
+    video, _ = fetch_video(
+        "vid", str(tmp_path), lambda url: info, d, (320, 176),
+        convert=convert, validate=lambda p: b"webm" in open(p, "rb").read())
+    # corrupt mp4 rejected by the validator and removed; webm converted
+    assert video == str(tmp_path / "vid.mp4")
+    assert converted and not os.path.exists(str(tmp_path / "vid.webm"))
+    assert not os.path.exists(str(tmp_path / "vid.garbage"))
+
+
+def test_plan_worker_shards_balances_and_filters():
+    from tools.fetch import plan_worker_shards
+    ids = [[f"v{i}"] for i in range(10)]
+    durations = [100.0, 2000.0, 300.0, 400.0, 1500.0, 50.0, 600.0, 700.0,
+                 800.0, 900.0]
+    shards, loads = plan_worker_shards(ids, durations, 3, min_duration=256.0)
+    kept = sorted(v for s in shards for c in s for v in c)
+    # chunks at or below min_duration dropped (v0=100, v5=50)
+    assert kept == sorted(f"v{i}" for i in range(10) if i not in (0, 5))
+    assert max(loads) - min(loads) <= max(durations)
+
+
+def test_stream_pile_documents_mocked_http(tmp_path):
+    import json as jsonlib
+    zstandard = pytest.importorskip("zstandard")
+    from tools.fetch import pile_worker_shards, stream_pile_documents
+    shard_docs = {
+        0: [{"text": "doc zero"}, {"text": ["part a", "part b"]}],
+        2: [{"text": "doc two"}],
+    }
+    blobs = {}
+    for shard, docs in shard_docs.items():
+        raw = "\n".join(jsonlib.dumps(d) for d in docs)
+        blobs[f"http://pile/{shard:02d}.jsonl.zst"] = (
+            zstandard.ZstdCompressor().compress(raw.encode()))
+    requested = []
+
+    def transport(url, proxies):
+        requested.append(url)
+        yield from _chunked(blobs[url], 11)
+
+    shards = pile_worker_shards(0, 2, 4)   # worker 0 of 2 over 4 splits
+    assert shards == [0, 2]
+    docs = list(stream_pile_documents(
+        shards, transport, url_template="http://pile/{shard:02d}.jsonl.zst",
+        separator=4))
+    assert docs == ["doc zero", "part a\x04part b", "doc two"]
+    assert requested == ["http://pile/00.jsonl.zst",
+                         "http://pile/02.jsonl.zst"]
+
+
+def test_download_and_encode_fleet_mocked(tmp_path):
+    """Full fleet worker against mocked transports: manifest -> shards ->
+    fetch (synthetic avi served as 'download') -> one tfrecord per chunk."""
+    cv2 = pytest.importorskip("cv2")
+    import json as jsonlib
+
+    from tools.fetch import Downloader, load_manifest, plan_worker_shards
+    from tools.video2tfrecord import download_and_encode
+
+    vid_path = str(tmp_path / "served.avi")
+    w = cv2.VideoWriter(vid_path, cv2.VideoWriter_fourcc(*"MJPG"), 10,
+                        (64, 32))
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        w.write(rng.integers(0, 255, (32, 64, 3), np.uint8))
+    w.release()
+    video_bytes = open(vid_path, "rb").read()
+    vtt = ("WEBVTT\n\n00:00:00.000 --> 00:00:01.000\nhello there\n\n"
+           "00:00:01.000 --> 00:00:02.000\nfleet worker\n")
+
+    manifest = tmp_path / "manifest.json"
+    manifest.write_text(jsonlib.dumps(
+        {"id": ["vidA", "vidB", "missing"],
+         "duration": [300.0, 400.0, 500.0]}))
+    ids, durations = load_manifest([str(manifest)])
+    shards, _ = plan_worker_shards(ids, durations, 1, min_duration=256.0)
+
+    def info_extractor(url):
+        vid = url.rsplit("=", 1)[1]
+        if vid == "missing":
+            raise RuntimeError("unavailable")
+        return {"formats": [{"width": 640, "height": 360, "ext": "avi",
+                             "url": f"http://v/{vid}.avi"}],
+                "automatic_captions": {"en": [
+                    {"ext": "vtt", "url": f"http://v/{vid}.vtt"}]}}
+
+    def transport(url, proxies):
+        yield video_bytes if url.endswith(".avi") else vtt.encode()
+
+    def convert(src, dst):  # "ffmpeg": the avi is already cv2-readable
+        os.rename(src, dst)
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(jsonlib.dumps(dict(
+        model_mode="jannet", use_language=True, frame_height=32,
+        frame_width=64, patch_size=16, sequence_length=4, experts=1,
+        features_per_head=16, heads=2, depth=1,
+        language_token_per_frame=8)))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    buffer_dir = tmp_path / "buffer"
+
+    outs = download_and_encode(
+        [[c for c in chunk] for chunk in shards[0]], 0, str(out_dir),
+        str(buffer_dir), str(cfg_path), 10.0, info_extractor,
+        Downloader(transport, None), convert=convert,
+        validate=lambda p: True, want_subtitles=True,
+        skip_if_no_subtitles=True, keep_buffer=False)
+    assert len(outs) == 2  # vidA + vidB chunks; "missing" skipped
+    from homebrewnlp_tpu.data.tfrecord import decode_example, read_records
+    recs = list(read_records(outs[0], verify=True))
+    assert recs
+    ex = decode_example(recs[0])
+    assert "frame" in ex and "tokens" in ex and ex["concat"][0] == 1
+    # download buffer cleaned (keep_buffer=False)
+    assert not list(buffer_dir.glob("*"))

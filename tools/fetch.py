@@ -1,0 +1,413 @@
+"""Download front end for the ingest tools — executable, transport-injected.
+
+The reference scrapes YouTube through rotating webshare.io proxies with a
+rate-limited worker fleet (/root/reference/scripts/video2tfrecord.py:57-129
+``Downloader``/``update_proxy``, :483-560 format selection + download loop,
+:760-922 fleet orchestration) and streams Pile ``.jsonl.zst`` shards over
+HTTP (/root/reference/scripts/text2tfrecord.py:35-54).  This image has no
+egress, so every network touch point here is an INJECTED callable: the
+logic — proxy-list pagination and rotation, bounded retry with partial-file
+cleanup, resolution-targeted format selection with webm demotion, English
+auto-caption vtt track selection, worker sharding by duration, shard-strided
+Pile streaming — runs and is unit-tested against mocked transports
+(tests/tools_test.py), and a deployment with egress passes the real
+``requests``/``youtube_dl`` callables (see ``requests_transport`` /
+``youtube_info_extractor`` at the bottom).
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import typing
+
+
+# -- rate limiting -----------------------------------------------------------
+
+class RateLimiter:
+    """Minimum-interval limiter (the reference rate-limits scraping with a
+    shared multiprocessing lock + start_delay staggering,
+    video2tfrecord.py:482-486,919; a min-interval token is the
+    single-process equivalent).  ``clock``/``sleep`` injectable for tests."""
+
+    def __init__(self, min_interval: float,
+                 clock: typing.Callable[[], float] = None,
+                 sleep: typing.Callable[[float], None] = None):
+        import time
+        self.min_interval = min_interval
+        self._clock = clock or time.monotonic
+        self._sleep = sleep or time.sleep
+        self._last: typing.Optional[float] = None
+
+    def wait(self) -> None:
+        now = self._clock()
+        if self._last is not None:
+            remaining = self.min_interval - (now - self._last)
+            if remaining > 0:
+                self._sleep(remaining)
+                now = self._clock()
+        self._last = now
+
+
+# -- proxy rotation ----------------------------------------------------------
+
+class ProxyRotator:
+    """webshare.io-style proxy pool (reference video2tfrecord.py:95-129):
+    page through ``/api/proxy/list/`` following ``next`` links, keep the
+    ``valid`` entries, shuffle, and expose one proxy mapping at a time;
+    ``rotate()`` re-fetches (the reference calls ``update_proxy`` after
+    every proxied failure).
+
+    ``fetch_json(url, headers) -> dict`` is the injected transport; without
+    an ``api_key`` the rotator is a no-proxy stub (reference behavior when
+    ``webshare_io_key`` is None)."""
+
+    LIST_URL = "https://proxy.webshare.io"
+
+    def __init__(self, fetch_json: typing.Callable[[str, dict], dict],
+                 api_key: typing.Optional[str] = None, rng=None):
+        import random
+        self._fetch = fetch_json
+        self._key = api_key
+        self._rng = rng or random.Random()
+        self.proxies: typing.Optional[typing.Dict[str, str]] = None
+        self.rotate()
+
+    def rotate(self) -> typing.Optional[typing.Dict[str, str]]:
+        if self._key is None:
+            self.proxies = None
+            return None
+        pool: typing.List[dict] = []
+        nxt: typing.Optional[str] = "/api/proxy/list/?page=1"
+        while nxt is not None:
+            page = self._fetch(self.LIST_URL + nxt,
+                               {"Authorization": f"Token {self._key}"})
+            nxt = None
+            if page:
+                nxt = page.get("next")
+                pool += [p for p in page.get("results", ()) if p.get("valid")]
+        self._rng.shuffle(pool)
+        if not pool:
+            self.proxies = None
+            return None
+        p = pool[0]
+        url = (f"http://{p['username']}:{p['password']}"
+               f"@{p['proxy_address']}:{p['ports']['http']}")
+        self.proxies = {"http": url, "https": url}
+        return self.proxies
+
+
+# -- bounded-retry download --------------------------------------------------
+
+class Downloader:
+    """Stream a URL to a file with bounded retries (reference
+    video2tfrecord.py:62-93): on a proxied failure rotate the proxy before
+    the next try; after ``max_try`` failures delete the partial file and
+    return False.
+
+    ``transport(url, proxies) -> iterable of byte chunks`` is the injected
+    network call (``requests.get(stream=True)`` in a real deployment)."""
+
+    def __init__(self, transport: typing.Callable[
+                     [str, typing.Optional[dict]], typing.Iterable[bytes]],
+                 rotator: typing.Optional[ProxyRotator] = None,
+                 max_try: int = 3,
+                 rate_limiter: typing.Optional[RateLimiter] = None):
+        self.transport = transport
+        self.rotator = rotator
+        self.max_try = max_try
+        self.rate_limiter = rate_limiter
+
+    def download(self, url: str, filename: str, use_proxy: bool) -> bool:
+        proxies = self.rotator.proxies if (use_proxy and self.rotator) else None
+        for _ in range(self.max_try):
+            if self.rate_limiter is not None:
+                self.rate_limiter.wait()
+            try:
+                with open(filename, "wb") as f:
+                    for chunk in self.transport(url, proxies):
+                        f.write(chunk)
+                return True
+            except Exception:  # noqa: BLE001 - network errors vary by transport
+                if use_proxy and self.rotator is not None:
+                    proxies = self.rotator.rotate()
+        if os.path.exists(filename):
+            os.remove(filename)
+        return False
+
+
+# -- format / caption selection ----------------------------------------------
+
+def select_video_format(formats: typing.Sequence[dict],
+                        target_resolution: typing.Tuple[int, int]
+                        ) -> typing.List[dict]:
+    """Pick the SMALLEST resolution strictly above the target, returning all
+    candidate urls at that resolution with ``.webm`` demoted to the end
+    (mp4 avoids the ffmpeg convert) — reference video2tfrecord.py:483-505
+    (selection) and :536-540 (webm-last swap).  Entries must carry
+    width/height/ext/url; 'tiny' (audio-only) format notes are skipped."""
+    best: typing.Tuple[int, int] = (1 << 30, 1 << 30)
+    out: typing.List[dict] = []
+    for f in formats:
+        if f.get("format_note") == "tiny":
+            continue
+        w, h = f.get("width"), f.get("height")
+        if w is None or h is None or "url" not in f or "ext" not in f:
+            continue
+        if w > target_resolution[0] and h > target_resolution[1]:
+            if (w, h) < best:
+                best = (w, h)
+                out = []
+            if (w, h) == best:
+                out.append({"width": w, "height": h,
+                            "ext": f["ext"], "url": f["url"]})
+    return ([f for f in out if f["ext"] != "webm"]
+            + [f for f in out if f["ext"] == "webm"])
+
+
+def select_caption_track(info: dict, lang: str = "en", ext: str = "vtt"
+                         ) -> typing.Optional[str]:
+    """First auto-caption track URL for ``lang`` with the requested ext
+    (reference video2tfrecord.py:507-519)."""
+    for track in info.get("automatic_captions", {}).get(lang, ()):
+        if track.get("ext") == ext and "url" in track:
+            return track["url"]
+    return None
+
+
+# -- one video: info -> select -> download -> validate -----------------------
+
+def fetch_video(video_id: str, buffer_dir: str,
+                info_extractor: typing.Callable[[str], dict],
+                downloader: Downloader,
+                target_resolution: typing.Tuple[int, int],
+                want_subtitles: bool = False,
+                convert: typing.Optional[
+                    typing.Callable[[str, str], None]] = None,
+                validate: typing.Optional[
+                    typing.Callable[[str], bool]] = None,
+                youtube_base: str = "https://www.youtube.com/watch?v=",
+                ) -> typing.Tuple[typing.Optional[str],
+                                  typing.Optional[str]]:
+    """Fetch one video (+ optional vtt): extract info, select formats, walk
+    the candidate list downloading until one validates (reference worker
+    loop video2tfrecord.py:475-590).  Non-mp4 downloads go through
+    ``convert(src, dst_mp4)`` (ffmpeg in the reference, :556-565); failed
+    candidates are removed and the next tried.  Returns
+    ``(video_path | None, vtt_path | None)``."""
+    try:
+        info = info_extractor(youtube_base + video_id)
+    except Exception:  # noqa: BLE001 - scrape errors must not kill the worker
+        return None, None
+    candidates = select_video_format(info.get("formats", ()),
+                                     target_resolution)
+    video_path = None
+    for cand in candidates:
+        path = os.path.join(buffer_dir, f"{video_id}.{cand['ext']}")
+        if not downloader.download(cand["url"], path, use_proxy=False):
+            continue
+        if cand["ext"] != "mp4" and convert is not None:
+            mp4 = os.path.join(buffer_dir, f"{video_id}.mp4")
+            convert(path, mp4)
+            if os.path.exists(path):
+                os.remove(path)
+            path = mp4
+        if validate is not None and not validate(path):
+            if os.path.exists(path):
+                os.remove(path)
+            continue
+        video_path = path
+        break
+    vtt_path = None
+    if want_subtitles and video_path is not None:
+        url = select_caption_track(info)
+        if url is not None:
+            cand_vtt = os.path.join(buffer_dir, f"{video_id}.vtt")
+            # the reference downloads caption tracks THROUGH the proxy
+            # (video2tfrecord.py:608-611) — the vtt endpoint is the
+            # rate-limited one
+            if downloader.download(url, cand_vtt, use_proxy=True):
+                vtt_path = cand_vtt
+    return video_path, vtt_path
+
+
+# -- fleet sharding ----------------------------------------------------------
+
+def plan_worker_shards(ids: typing.Sequence[typing.Sequence[str]],
+                       durations: typing.Sequence[float], num_workers: int,
+                       min_duration: float = 256.0
+                       ) -> typing.Tuple[typing.List[typing.List[
+                           typing.Sequence[str]]], typing.List[float]]:
+    """Duration-balanced worker shards (reference ``split_equal``
+    video2tfrecord.py:170-186): drop chunks at or below ``min_duration``
+    seconds (<=0 disables), then greedy longest-first into the lightest
+    worker.  Returns (per-worker chunk lists, per-worker total seconds)."""
+    order = sorted(range(len(ids)), key=lambda i: -durations[i])
+    shards: typing.List[typing.List[typing.Sequence[str]]] = [
+        [] for _ in range(num_workers)]
+    loads = [0.0] * num_workers
+    for i in order:
+        if min_duration > 0 and durations[i] <= min_duration:
+            continue
+        tgt = loads.index(min(loads))
+        shards[tgt].append(ids[i])
+        loads[tgt] += durations[i]
+    return shards, loads
+
+
+def load_manifest(paths: typing.Sequence[str]
+                  ) -> typing.Tuple[typing.List[typing.List[str]],
+                                    typing.List[float]]:
+    """Reference manifest format (video2tfrecord.py:846-860): JSON files
+    with ``id`` / ``duration`` lists; scalar ids become single-video chunks,
+    list-of-list ids sum their durations."""
+    ids: typing.List = []
+    durations: typing.List = []
+    for p in paths:
+        with open(p) as f:
+            m = json.load(f)
+        ids += list(m["id"])
+        durations += list(m["duration"])
+    if ids and not isinstance(ids[0], list):
+        return [[i] for i in ids], [float(d) for d in durations]
+    return ([list(c) for c in ids],
+            [float(sum(d)) if isinstance(d, (list, tuple)) else float(d)
+             for d in durations])
+
+
+# -- Pile shard streaming ----------------------------------------------------
+
+PILE_URL_TEMPLATE = "http://eaidata.bmk.sh/data/pile/train/{shard:02d}.jsonl.zst"
+PILE_SPLITS = 30
+
+
+def pile_worker_shards(pid: int, procs: int, splits: int = PILE_SPLITS
+                       ) -> typing.List[int]:
+    """Shard-strided split of the Pile over workers (reference
+    text2tfrecord.py:44: ``range(pid, splits, procs)``)."""
+    return list(range(pid, splits, procs))
+
+
+def stream_pile_documents(shards: typing.Sequence[int],
+                          transport: typing.Callable[
+                              [str, typing.Optional[dict]],
+                              typing.Iterable[bytes]],
+                          url_template: str = PILE_URL_TEMPLATE,
+                          separator: int = 4
+                          ) -> typing.Iterator[str]:
+    """Stream documents out of Pile ``.jsonl.zst`` shards fetched over HTTP
+    (reference text2tfrecord.py:35-54): zstd-decompress the byte stream
+    incrementally, parse jsonlines, yield each document's text (dict
+    entries yield ``item['text']``; list entries join on
+    ``chr(separator)``).  ``transport(url, None) -> iterable of byte
+    chunks`` is the same injected shape ``Downloader`` uses, so one real
+    requests-backed callable serves both front ends."""
+    import zstandard
+
+    for shard in shards:
+        url = url_template.format(shard=shard)
+        chunks = transport(url, None)
+        raw = _IterStream(iter(chunks))
+        reader = io.BufferedReader(
+            zstandard.ZstdDecompressor().stream_reader(raw))
+        for line in io.TextIOWrapper(reader, encoding="utf-8",
+                                     errors="replace"):
+            line = line.strip()
+            if not line:
+                continue
+            item = json.loads(line)
+            if isinstance(item, dict):
+                item = item["text"]
+            if isinstance(item, list):
+                item = chr(separator).join(item)
+            yield item
+
+
+class _IterStream(io.RawIOBase):
+    """File-like view over an iterator of byte chunks (keeps the zstd
+    decompressor streaming instead of buffering the whole shard the way the
+    reference's ``r.raw.read()`` does — text2tfrecord.py:45-46)."""
+
+    def __init__(self, chunks: typing.Iterator[bytes]):
+        self._chunks = chunks
+        self._buf = b""
+
+    def readable(self) -> bool:
+        return True
+
+    def readinto(self, b) -> int:
+        while not self._buf:
+            try:
+                self._buf = next(self._chunks)
+            except StopIteration:
+                return 0
+        n = min(len(b), len(self._buf))
+        b[:n] = self._buf[:n]
+        self._buf = self._buf[n:]
+        return n
+
+
+# -- real transports (egress deployments only) -------------------------------
+
+def requests_transport(chunk_size: int = 1 << 20):
+    """``transport(url, proxies)`` backed by requests (reference
+    video2tfrecord.py:70-77).  Import deferred: this module stays testable
+    in zero-egress images."""
+    import requests
+
+    def transport(url: str, proxies: typing.Optional[dict]
+                  ) -> typing.Iterable[bytes]:
+        with requests.get(url, stream=True, proxies=proxies,
+                          timeout=600) as r:
+            r.raise_for_status()
+            yield from r.iter_content(chunk_size)
+
+    return transport
+
+
+def requests_json_fetcher():
+    """``fetch_json(url, headers)`` for ProxyRotator (reference
+    video2tfrecord.py:99-104)."""
+    import requests
+
+    def fetch(url: str, headers: dict) -> dict:
+        return requests.get(url, headers=headers, timeout=60).json()
+
+    return fetch
+
+
+def youtube_info_extractor():
+    """``info_extractor(url)`` backed by youtube_dl (reference
+    video2tfrecord.py:440-444,487-490).  The caller serializes info
+    extraction across workers (the reference holds a multiprocessing lock)."""
+    import youtube_dl
+    getter = youtube_dl.YoutubeDL({"writeautomaticsub": True,
+                                   "ignore-errors": True,
+                                   "socket-timeout": 600})
+    getter.add_default_info_extractors()
+
+    def extract(url: str) -> dict:
+        return getter.extract_info(url, download=False)
+
+    return extract
+
+
+def ffmpeg_convert(src: str, dst: str) -> None:
+    """Container remux to mp4 (reference video2tfrecord.py:556-565)."""
+    import subprocess
+    subprocess.run(["ffmpeg", "-i", src, "-c", "copy", dst, "-y"],
+                   stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+                   check=False)
+
+
+def cv2_validate(path: str) -> bool:
+    """A download only counts if cv2 can read a frame (reference
+    video2tfrecord.py:569-585)."""
+    try:
+        import cv2
+        cap = cv2.VideoCapture(path)
+        ok, _ = cap.read()
+        cap.release()
+        return bool(ok)
+    except Exception:  # noqa: BLE001
+        return False

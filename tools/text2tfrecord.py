@@ -66,8 +66,20 @@ def iter_jsonl_zst(path: str) -> typing.Iterator[str]:
             yield json.loads(line).get("text", "")
 
 
+def iter_pile_http(shards: typing.Sequence[int], url_template: str
+                   ) -> typing.Iterator[str]:
+    """Stream Pile documents over HTTP (reference text2tfrecord.py:35-54)
+    through tools/fetch.py's injectable reader with the real requests
+    transport."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import fetch
+    return fetch.stream_pile_documents(shards, fetch.requests_transport(),
+                                       url_template=url_template)
+
+
 def _work(job) -> str:
-    shard_idx, paths, out_dir, tokenizer_path, jsonl_zst = job
+    (shard_idx, paths, out_dir, tokenizer_path, jsonl_zst,
+     url_template) = (job + ("",))[:6]
     merges = None
     suffix = "bytes"
     if tokenizer_path:
@@ -88,19 +100,29 @@ def _work(job) -> str:
     total = 0
     try:
         with RecordWriter(tmp) as w:
-            for p in paths:
-                if jsonl_zst:
-                    # one TFRecord record per document (documents never
-                    # cross records — the pipeline's windowing assumption)
-                    for doc in iter_jsonl_zst(p):
-                        payload, n = encode_payload(clean_text(doc.encode()),
-                                                    merges)
-                        w.write(payload)
-                        total += n
-                else:
-                    payload, n = encode_file(p, merges)
+            if jsonl_zst == "pile":
+                # paths are Pile shard numbers, streamed over HTTP
+                for doc in iter_pile_http([int(p) for p in paths],
+                                          url_template):
+                    payload, n = encode_payload(clean_text(doc.encode()),
+                                                merges)
                     w.write(payload)
                     total += n
+            else:
+                for p in paths:
+                    if jsonl_zst:
+                        # one TFRecord record per document (documents never
+                        # cross records — the pipeline's windowing
+                        # assumption)
+                        for doc in iter_jsonl_zst(p):
+                            payload, n = encode_payload(
+                                clean_text(doc.encode()), merges)
+                            w.write(payload)
+                            total += n
+                    else:
+                        payload, n = encode_file(p, merges)
+                        w.write(payload)
+                        total += n
         name = f"shard{suffix}{shard_idx:05d}_{total}.tfrecord"
         if remote:
             # upload with bounded-retry backoff (the reference's GCS loop,
@@ -118,7 +140,7 @@ def _work(job) -> str:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--input", nargs="+", required=True)
+    ap.add_argument("--input", nargs="*", default=None)
     ap.add_argument("--output-dir", required=True)
     ap.add_argument("--tokenizer", default="",
                     help="tokenizer.json from tools/train_tokenizer.py "
@@ -131,16 +153,36 @@ def main() -> None:
     ap.add_argument("--post-cmd", default="",
                     help="shell command run per finished shard, {} = path "
                          "(e.g. 'gsutil cp {} gs://bucket/')")
+    ap.add_argument("--pile-stream", type=int, default=0, metavar="SPLITS",
+                    help="stream this many Pile .jsonl.zst shards over HTTP "
+                         "instead of reading --input (reference "
+                         "text2tfrecord.py:35-54; needs egress)")
+    ap.add_argument("--pile-url-template", default="",
+                    help="override the shard URL template "
+                         "({shard:02d} placeholder)")
     args = ap.parse_args()
     from homebrewnlp_tpu.data import fs
     if not fs.is_remote(args.output_dir):
         os.makedirs(args.output_dir, exist_ok=True)
 
     jobs = []
-    per = 1 if args.jsonl_zst else args.files_per_shard
-    for i in range(0, len(args.input), per):
-        jobs.append((len(jobs), args.input[i:i + per],
-                     args.output_dir, args.tokenizer, args.jsonl_zst))
+    if args.pile_stream:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import fetch
+        template = args.pile_url_template or fetch.PILE_URL_TEMPLATE
+        # shard-strided worker split, one job per worker (reference :44)
+        for pid in range(min(args.procs, args.pile_stream)):
+            shards = fetch.pile_worker_shards(
+                pid, min(args.procs, args.pile_stream), args.pile_stream)
+            jobs.append((pid, shards, args.output_dir, args.tokenizer,
+                         "pile", template))
+    else:
+        if not args.input:
+            ap.error("--input is required without --pile-stream")
+        per = 1 if args.jsonl_zst else args.files_per_shard
+        for i in range(0, len(args.input), per):
+            jobs.append((len(jobs), args.input[i:i + per],
+                         args.output_dir, args.tokenizer, args.jsonl_zst))
     with multiprocessing.Pool(min(args.procs, len(jobs))) as pool:
         for out in pool.imap_unordered(_work, jobs):
             print(out, flush=True)

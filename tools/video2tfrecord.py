@@ -10,16 +10,22 @@ frame geometry, per-word subtitle timing with token alignment per frame
 ``concat``/``skip_frame`` flags between videos, multiprocess workers balanced
 by duration (the reference's ``split_equal``, :168-183).
 
-The proxied YouTube downloader (reference :57-129) is deliberately NOT run
-or ported as executable code — this image has no egress.  Template for a
-deployment that has it: enumerate video ids, fetch with a rate-limited
-worker pool through rotating proxies, download the ``.vtt`` auto-caption
-track alongside each video, then feed the (video, vtt) pairs to this tool.
+The proxied YouTube download fleet (reference :57-129 downloader/proxies,
+:373-760 worker loop, :760-922 orchestration) lives in tools/fetch.py with
+every network call behind an injected transport: ``download_and_encode``
+below is the executable per-worker path (fetch videos + vtt tracks for each
+chunk, then encode the chunk to one shard), unit-tested against mocked
+transports (tests/tools_test.py) since this image has no egress; a
+deployment with egress gets the real callables via ``--manifest`` mode.
 
-Usage:
+Usage (local files):
   python tools/video2tfrecord.py --model configs/video.json \
       --input a.mp4 b.mp4 [--subs a.vtt b.vtt] --output-dir datasets/video \
       [--fps 1] [--procs 4]
+Usage (download fleet, needs egress + youtube_dl):
+  python tools/video2tfrecord.py --model configs/video.json \
+      --manifest manifest.json --output-dir datasets/video \
+      --buffer-dir /dev/shm/dl [--workers 4] [--webshare-key KEY]
 """
 from __future__ import annotations
 
@@ -88,7 +94,9 @@ def _encode_video(job) -> str:
     payloads = []
     for vid_idx, path in enumerate(video_paths):
         timed, token_lists = [], []
-        if sub_paths:
+        # per-video None entries: a fleet worker whose vtt fetch failed
+        # (skip_if_no_subtitles=False keeps the video, reference :690-693)
+        if sub_paths and sub_paths[vid_idx] is not None:
             with open(sub_paths[vid_idx], encoding="utf-8",
                       errors="replace") as f:
                 timed = parse_timed_words(f.read())
@@ -116,9 +124,85 @@ def _encode_video(job) -> str:
     return out
 
 
+def download_and_encode(chunks: typing.Sequence[typing.Sequence[str]],
+                        worker_idx: int, out_dir: str, buffer_dir: str,
+                        cfg_path: str, fps: float,
+                        info_extractor, downloader,
+                        convert=None, validate=None,
+                        want_subtitles: bool = True,
+                        skip_if_no_subtitles: bool = True,
+                        keep_buffer: bool = False) -> typing.List[str]:
+    """One fleet worker (reference worker loop :373-760): for each chunk of
+    video ids, fetch every video (+ vtt auto-caption track) through the
+    injected ``info_extractor``/``downloader`` (tools/fetch.py), encode the
+    chunk's successful fetches into one TFRecord shard, then drop the
+    download buffer unless ``keep_buffer``.  Videos whose fetch fails are
+    skipped (never crash the worker); with ``skip_if_no_subtitles`` a video
+    without a vtt is skipped too (reference :690-693)."""
+    import fetch
+
+    os.makedirs(buffer_dir, exist_ok=True)
+    resolution = _cfg_resolution(cfg_path)
+    outs: typing.List[str] = []
+    for chunk_idx, chunk in enumerate(chunks):
+        vids: typing.List[str] = []
+        subs: typing.List[typing.Optional[str]] = []
+        fetched: typing.List[str] = []
+        for video_id in chunk:
+            v, s = fetch.fetch_video(
+                video_id, buffer_dir, info_extractor, downloader,
+                target_resolution=resolution,
+                want_subtitles=want_subtitles, convert=convert,
+                validate=validate)
+            if v is None:
+                continue
+            fetched.append(v)
+            if s is not None:
+                fetched.append(s)
+            if want_subtitles and s is None and skip_if_no_subtitles:
+                continue
+            vids.append(v)
+            subs.append(s)
+        if vids:
+            out = _encode_video((worker_idx * 10000 + chunk_idx, vids,
+                                 subs if want_subtitles else None,
+                                 out_dir, cfg_path, fps))
+            outs.append(out)
+            print(out, flush=True)
+        if not keep_buffer:
+            for p in fetched:
+                if os.path.exists(p):
+                    os.remove(p)
+    return outs
+
+
+def _cfg_resolution(cfg_path: str) -> typing.Tuple[int, int]:
+    if not cfg_path:
+        return (320, 176)
+    cfg = Config.from_json(cfg_path)
+    return (cfg.frame_width, cfg.frame_height)
+
+
+def _fleet_worker(job) -> typing.List[str]:
+    (chunks, worker_idx, out_dir, buffer_dir, cfg_path, fps, webshare_key,
+     want_subtitles, skip_if_no_subtitles, keep_buffer, rate_interval) = job
+    import fetch
+
+    rotator = fetch.ProxyRotator(fetch.requests_json_fetcher(), webshare_key)
+    downloader = fetch.Downloader(
+        fetch.requests_transport(), rotator,
+        rate_limiter=fetch.RateLimiter(rate_interval))
+    return download_and_encode(
+        chunks, worker_idx, out_dir, buffer_dir, cfg_path, fps,
+        fetch.youtube_info_extractor(), downloader,
+        convert=fetch.ffmpeg_convert, validate=fetch.cv2_validate,
+        want_subtitles=want_subtitles,
+        skip_if_no_subtitles=skip_if_no_subtitles, keep_buffer=keep_buffer)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--input", nargs="+", required=True, help="video files")
+    ap.add_argument("--input", nargs="*", default=None, help="video files")
     ap.add_argument("--subs", nargs="*", default=None,
                     help="subtitle files (parallel to --input)")
     ap.add_argument("--model", default="", help="config JSON for frame "
@@ -126,9 +210,51 @@ def main() -> None:
     ap.add_argument("--output-dir", required=True)
     ap.add_argument("--fps", type=float, default=1.0)
     ap.add_argument("--procs", type=int, default=os.cpu_count())
+    ap.add_argument("--manifest", nargs="*", default=None,
+                    help="download-fleet mode: JSON manifests with "
+                         "id/duration lists (reference manifest format)")
+    ap.add_argument("--buffer-dir", default="",
+                    help="download buffer (RAM disk recommended)")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="fleet workers (--manifest mode)")
+    ap.add_argument("--webshare-key", default=None,
+                    help="webshare.io API key for proxy rotation")
+    ap.add_argument("--min-duration", type=float, default=256.0,
+                    help="skip chunks at or below this many seconds")
+    ap.add_argument("--no-subtitles", action="store_true")
+    ap.add_argument("--keep-without-subtitles", action="store_true")
+    ap.add_argument("--keep-buffer", action="store_true")
+    ap.add_argument("--rate-interval", type=float, default=1.0,
+                    help="min seconds between fleet download requests")
     args = ap.parse_args()
     os.makedirs(args.output_dir, exist_ok=True)
 
+    if args.manifest:
+        import fetch
+        ids, durations = fetch.load_manifest(args.manifest)
+        shards, loads = fetch.plan_worker_shards(
+            ids, durations, args.workers, args.min_duration)
+        for w, (shard, load) in enumerate(zip(shards, loads)):
+            print(f"worker {w}: {len(shard)} chunks, "
+                  f"{sum(len(c) for c in shard)} videos, {load:.0f}s")
+        jobs = [(shard, w, args.output_dir,
+                 args.buffer_dir or os.path.join(args.output_dir, "buffer"),
+                 args.model, args.fps, args.webshare_key,
+                 not args.no_subtitles, not args.keep_without_subtitles,
+                 args.keep_buffer, args.rate_interval)
+                for w, shard in enumerate(shards) if shard]
+        if not jobs:
+            print("no chunks above --min-duration "
+                  f"{args.min_duration}s; nothing to download")
+            return
+        with multiprocessing.Pool(len(jobs)) as pool:
+            for outs in pool.imap_unordered(_fleet_worker, jobs):
+                for out in outs:
+                    print(out, flush=True)
+        return
+
+    if not args.input:
+        ap.error("--input is required without --manifest")
     import cv2
     durations = []
     for p in args.input:
