@@ -153,6 +153,11 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # block internals instead of storing residuals — FLOPs for HBM bytes,
     # a win on bandwidth-bound workloads (docs/perf/README.md round 4)
     reversible_remat_blocks=False,
+    # fuse the [norm, map-attention, norm, gelu, map-attention] mixer block
+    # into one pallas fwd kernel + one full-vjp bwd kernel (the HBM-bytes
+    # lever for the bandwidth-bound mixer workloads, ops/pallas_mixer.py).
+    # Single-device only: the GSPMD/sharded paths keep the unfused chain.
+    fused_mixer_block=False,
     debug_train_step=False,
     debug_gradients=False,
     current_step=0,

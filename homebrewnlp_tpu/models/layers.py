@@ -635,3 +635,85 @@ def convolution(args: Args) -> NT:
         dimension_numbers=("NWC", "WIO", "NWC"))
     y = y.reshape(lead + xt.x.shape[len(other):])
     return NT(y, tuple(other + [dim] + feat_names)).transpose_to(t.names)
+
+
+# -- fused mixer block (pallas bytes lever) ---------------------------------
+
+MIXER_FUSED_PATTERN = (
+    "norm-shift-scale-features-group",
+    "attention-biased_attention_map-absolute-input_as_value-shared",
+    "norm-shift-scale-features-group",
+    "activation-gelu",
+    "attention-biased_attention_map-absolute-input_as_value-shared",
+)
+
+
+def fused_mixer_eligible(ctx, conf, x: NT) -> bool:
+    """The fused kernel (ops/pallas_mixer.py) replaces exactly the mixer
+    configs' block-2 chain, on an unsharded device, in apply mode, on the
+    plain rank-4 text layout with the sequence axis causally masked."""
+    cfg = ctx.cfg
+    layer = conf.layer if isinstance(conf.layer, (list, tuple)) else None
+    return (cfg.fused_mixer_block
+            and layer is not None and tuple(layer) == MIXER_FUSED_PATTERN
+            and ctx.params is not None and ctx.decode is None
+            and (ctx.mesh is None or ctx.mesh.size == 1)
+            and x.names[1:] == (SEQUENCE, HEADS, KEY)
+            and 0 in cfg.masked_attention_dimensions
+            and x.dim_size(SEQUENCE) % 128 == 0
+            and x.dim_size(KEY) % 128 == 0
+            and jax.default_backend() in ("tpu", "axon", "cpu"))
+
+
+def fused_mixer_block_part(conf, ctx, x: NT) -> NT:
+    """Apply the 5-layer mixer block through the fused pallas kernel.
+
+    The scope walk REPLAYS ``registry._get_block_part`` exactly — same
+    ``ctx.scoped`` calls in the same order, same parameter constructors the
+    unfused layers invoke — so parameter names, shapes, init and the
+    attention-rotation counter are bit-identical to the unfused chain and
+    checkpoints interchange freely between the two paths."""
+    from ..ops.pallas_mixer import fused_mixer_block
+
+    cfg = ctx.cfg
+    collected: typing.List[NT] = []
+
+    def norm_params(args: Args) -> typing.Tuple[NT, NT]:
+        fs = linear_shapes(args)[0]
+        scale = normal_var(args, fs, mean=1.0, name="scale")
+        shift = normal_var(args, fs, mean=0.0, name="shift")
+        return scale, shift
+
+    def attn_params(args: Args) -> NT:
+        ctx.attention_idx += 1
+        dim = get_attention_dim(args).dim
+        tmp = anonymize_name(dim)
+        size = args.tensor.dim_size(dim)
+        return embed(args, [(HEADS, cfg.heads), (dim, size), (tmp, size)])
+
+    specs = list(conf.layer)
+    for idx, layer_spec in enumerate(specs, 1):
+        name, *extras = layer_spec.split("-")
+        args = Args(ctx, x, extras, idx == len(specs))
+        if name == "norm":
+            collected.append(ctx.scoped("norm_", norm_params, args))
+        elif name == "attention":
+            collected.append(ctx.scoped("attention_", attn_params, args))
+        else:  # activation: consumes its scope slot, holds no parameters
+            with ctx.scope("activation_"):
+                pass
+
+    (scale1, shift1), bias1, (scale2, shift2), bias2 = collected
+    order = (x.names[0], SEQUENCE, HEADS, KEY)
+    tmp_names = [n for n in bias1.names if n != HEADS]
+    out_x = fused_mixer_block(
+        x.transpose_to(order).x,
+        bias1.transpose_to((HEADS,) + tuple(tmp_names)).x,
+        bias2.transpose_to((HEADS,) + tuple(tmp_names)).x,
+        scale1.transpose_to((HEADS, KEY)).x,
+        shift1.transpose_to((HEADS, KEY)).x,
+        scale2.transpose_to((HEADS, KEY)).x,
+        shift2.transpose_to((HEADS, KEY)).x,
+        jax.default_backend() not in ("tpu", "axon"),  # interpret on CPU
+    )
+    return NT(out_x, order).transpose_to(x.names)

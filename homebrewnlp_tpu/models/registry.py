@@ -16,14 +16,21 @@ from . import layers
 
 
 def _get_block_part(block_part_config: BlockConfig, ctx: Ctx, block_input: NT) -> NT:
-    out = block_input
-    for idx, layer in enumerate(block_part_config.layer, 1):
-        name, *extras = layer.split("-")
-        if name not in LAYER_FUNCTIONS:
-            raise ValueError(f"unknown layer {name!r} in spec {layer!r}; "
-                             f"known layers: {sorted(LAYER_FUNCTIONS)}")
-        args = Args(ctx, out, extras, idx == len(block_part_config.layer))
-        out = ctx.scoped(name + "_", LAYER_FUNCTIONS[name], args)
+    if layers.fused_mixer_eligible(ctx, block_part_config, block_input):
+        # the mixer block-2 chain as ONE pallas fwd kernel + one full-vjp
+        # bwd kernel (ops/pallas_mixer.py) — same parameters, same scope
+        # walk, a fraction of the HBM traffic
+        out = layers.fused_mixer_block_part(block_part_config, ctx,
+                                            block_input)
+    else:
+        out = block_input
+        for idx, layer in enumerate(block_part_config.layer, 1):
+            name, *extras = layer.split("-")
+            if name not in LAYER_FUNCTIONS:
+                raise ValueError(f"unknown layer {name!r} in spec {layer!r}; "
+                                 f"known layers: {sorted(LAYER_FUNCTIONS)}")
+            args = Args(ctx, out, extras, idx == len(block_part_config.layer))
+            out = ctx.scoped(name + "_", LAYER_FUNCTIONS[name], args)
     if block_part_config.skip and block_part_config.memory_reduction_strategy in ("none", "checkpoint"):
         out = out + block_input
     return out

@@ -1,0 +1,316 @@
+"""Fused mixer-block pallas kernel: the bytes lever for the map-attention
+blocks (VERDICT r4 item 4).
+
+The mixer configs' second block (configs/32mixer_group.json /
+32big_mixer.json, reference semantics spatial.py:65-75 + frontend chain)
+is the 5-layer chain
+
+    n1  = norm_{scale1,shift1}(x)          # per-head, over features
+    a1  = (bias1 . causal) @ n1            # learned [H,S,S] map, masked
+    n2  = norm_{scale2,shift2}(a1)
+    g   = gelu(n2)
+    out = (bias2 . causal) @ g
+
+on a ``[B, S, H, K]`` activation.  Under XLA every arrow above is a
+separate HLO with a full ``[B,S,H,K]`` HBM round-trip (measured: the
+32mixer_group step is bandwidth-bound at 266.7 GB with the MXU 4x idle —
+docs/perf/README.md roofline), and the backward doubles it with recompute
+reads plus f32 grad temporaries.  Per (batch, head) slice, however, the
+whole chain is a pair of tiny ``[S,S] @ [S,K]`` matmuls with elementwise
+glue — it fits VMEM whole.  This kernel runs the chain (forward) and its
+entire vjp (backward) per ``(head, batch-row)`` grid cell: the forward
+reads x and writes out ONCE; the backward reads x and d(out) once, writes
+dx once, recomputes the internals in VMEM (remat-in-kernel — the same
+FLOPs XLA's remat executes, for a fraction of the bytes), and accumulates
+the parameter gradients (dbias1, dbias2, dscale/dshift) in f32 across the
+batch grid axis.
+
+Layout notes (pallas TPU tiling): activations are viewed as
+``[B, S, H*K]`` so the per-head block is a ``[S, K]`` lane-aligned column
+slice (the same trick ops/pallas_attn.py uses); the tiny ``[H, K]``
+scale/shift vectors ride whole into VMEM and are row-indexed by the grid's
+head coordinate.
+
+Numerics match the unfused chain's dtype walk: norms compute in f32 from
+the stored dtype (models/layers.py::norm), map matmuls take
+calculation-dtype operands with f32 MXU accumulation and cast back
+(nd.einsum policy), gelu runs in the calculation dtype.  Bit-parity with
+XLA is NOT expected in bf16 (the fusion changes rounding order, like any
+remat/fusion change — guarded the same way, by the real-corpus trajectory
+check); f32 parity is pinned in tests/model_test.py.
+
+The kernel is single-device (used under jit on an unsharded mesh; the
+GSPMD/sharded paths keep the unfused chain).
+"""
+from __future__ import annotations
+
+import functools
+import typing
+
+import jax
+import jax.numpy as jnp
+
+
+def _norm_fwd(x32: jnp.ndarray, scale: jnp.ndarray, shift: jnp.ndarray
+              ) -> jnp.ndarray:
+    """models/layers.py::norm on one [S, K] slice, f32 in/out: one-pass
+    moments, clamped var, affine fold."""
+    m1 = jnp.mean(x32, axis=1, keepdims=True)
+    m2 = jnp.mean(x32 * x32, axis=1, keepdims=True)
+    var = jnp.maximum(m2 - m1 * m1, 0.0)
+    mul = jax.lax.rsqrt(var + 1e-5) * scale[None, :]
+    return x32 * mul + (shift[None, :] - m1 * mul)
+
+
+def _norm_bwd(x32: jnp.ndarray, scale: jnp.ndarray,
+              dy: jnp.ndarray) -> typing.Tuple[jnp.ndarray, jnp.ndarray,
+                                               jnp.ndarray]:
+    """vjp of _norm_fwd wrt (x, scale, shift); all f32 [S, K] / [K]."""
+    m1 = jnp.mean(x32, axis=1, keepdims=True)
+    m2 = jnp.mean(x32 * x32, axis=1, keepdims=True)
+    var = jnp.maximum(m2 - m1 * m1, 0.0)
+    r = jax.lax.rsqrt(var + 1e-5)
+    xhat = (x32 - m1) * r
+    u = dy * scale[None, :]
+    dx = r * (u - jnp.mean(u, axis=1, keepdims=True)
+              - xhat * jnp.mean(u * xhat, axis=1, keepdims=True))
+    dscale = jnp.sum(dy * xhat, axis=0)
+    dshift = jnp.sum(dy, axis=0)
+    return dx, dscale, dshift
+
+
+def _causal(seq: int, dtype) -> jnp.ndarray:
+    row = jax.lax.broadcasted_iota(jnp.int32, (seq, seq), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (seq, seq), 1)
+    return (row >= col).astype(dtype)
+
+
+def _chain_fwd_tiles(x, b1m, b2m, s1, sh1, s2, sh2, cdtype):
+    """Forward chain on one [S, K] slice; returns (out, intermediates).
+    Dtype walk mirrors the unfused layers: f32 norms, cdtype matmul
+    operands with f32 accumulation, cdtype gelu."""
+    n1 = _norm_fwd(x.astype(jnp.float32), s1, sh1).astype(cdtype)
+    a1 = jnp.dot(b1m, n1, preferred_element_type=jnp.float32).astype(cdtype)
+    n2 = _norm_fwd(a1.astype(jnp.float32), s2, sh2).astype(cdtype)
+    g = jax.nn.gelu(n2)
+    out = jnp.dot(b2m, g, preferred_element_type=jnp.float32).astype(cdtype)
+    return out, (n1, a1, n2, g)
+
+
+def _fwd_kernel(x_ref, b1_ref, b2_ref, s1_ref, sh1_ref, s2_ref, sh2_ref,
+                out_ref, *, seq: int):
+    from jax.experimental import pallas as pl
+
+    cdtype = x_ref.dtype
+    h = pl.program_id(0)
+    mask = _causal(seq, cdtype)
+    x = x_ref[0]
+    b1m = b1_ref[0] * mask
+    b2m = b2_ref[0] * mask
+    out, _ = _chain_fwd_tiles(
+        x, b1m, b2m,
+        s1_ref[h].astype(jnp.float32), sh1_ref[h].astype(jnp.float32),
+        s2_ref[h].astype(jnp.float32), sh2_ref[h].astype(jnp.float32),
+        cdtype)
+    out_ref[0] = out
+
+
+def _bwd_kernel(x_ref, b1_ref, b2_ref, s1_ref, sh1_ref, s2_ref, sh2_ref,
+                dout_ref, dx_ref, db1_ref, db2_ref, ds1_ref, dsh1_ref,
+                ds2_ref, dsh2_ref, *, seq: int):
+    from jax.experimental import pallas as pl
+
+    cdtype = x_ref.dtype
+    f32 = jnp.float32
+    h = pl.program_id(0)
+    b = pl.program_id(1)  # batch is the fastest grid axis: accumulate here
+
+    mask = _causal(seq, cdtype)
+    x = x_ref[0]
+    b1m = b1_ref[0] * mask
+    b2m = b2_ref[0] * mask
+    s1 = s1_ref[h].astype(f32)
+    sh1 = sh1_ref[h].astype(f32)
+    s2 = s2_ref[h].astype(f32)
+    sh2 = sh2_ref[h].astype(f32)
+
+    # recompute the forward internals in VMEM (remat-in-kernel)
+    _, (n1, a1, n2, g) = _chain_fwd_tiles(x, b1m, b2m, s1, sh1, s2, sh2,
+                                          cdtype)
+
+    dout = dout_ref[0]
+    # out = b2m @ g
+    dg = jnp.dot(b2m.T, dout, preferred_element_type=f32)
+    db2 = (jnp.dot(dout, g.T, preferred_element_type=f32)
+           * mask.astype(f32))
+    # g = gelu(n2) in cdtype (vjp evaluated in f32 of the cdtype-rounded n2,
+    # matching the unfused chain's value to rounding)
+    _, gelu_vjp = jax.vjp(lambda t: jax.nn.gelu(t.astype(f32)), n2)
+    (dn2,) = gelu_vjp(dg)
+    # n2 = norm(a1)
+    da1, ds2, dsh2 = _norm_bwd(a1.astype(f32), s2, dn2)
+    da1c = da1.astype(cdtype)
+    # a1 = b1m @ n1
+    dn1 = jnp.dot(b1m.T, da1c, preferred_element_type=f32)
+    db1 = (jnp.dot(da1c, n1.T, preferred_element_type=f32)
+           * mask.astype(f32))
+    # n1 = norm(x)
+    dx, ds1, dsh1 = _norm_bwd(x.astype(f32), s1, dn1)
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+
+    # parameter grads accumulate across the batch grid axis in f32; the
+    # per-head [S,S] map blocks re-init whenever their window moves to a
+    # new head (b == 0), the whole-[H,K] vector blocks init once at the
+    # very first grid step
+    @pl.when(b == 0)
+    def _init_maps():
+        db1_ref[0] = db1
+        db2_ref[0] = db2
+
+    @pl.when(b != 0)
+    def _acc_maps():
+        db1_ref[0] += db1
+        db2_ref[0] += db2
+
+    @pl.when((b == 0) & (h == 0))
+    def _init_vecs():
+        ds1_ref[...] = jnp.zeros_like(ds1_ref)
+        dsh1_ref[...] = jnp.zeros_like(dsh1_ref)
+        ds2_ref[...] = jnp.zeros_like(ds2_ref)
+        dsh2_ref[...] = jnp.zeros_like(dsh2_ref)
+
+    ds1_ref[h] += ds1
+    dsh1_ref[h] += dsh1
+    ds2_ref[h] += ds2
+    dsh2_ref[h] += dsh2
+
+
+def _specs(seq: int, key: int, n_h: int):
+    from jax.experimental import pallas as pl
+    # activations viewed as [B, S, H*K]: per-head block = [S, K] column
+    # slice (lane-aligned); maps blocked per head; [H,K] vectors whole
+    x_spec = pl.BlockSpec((1, seq, key), lambda h, b: (b, 0, h))
+    map_spec = pl.BlockSpec((1, seq, seq), lambda h, b: (h, 0, 0))
+    vec_spec = pl.BlockSpec((n_h, key), lambda h, b: (0, 0))
+    return x_spec, map_spec, vec_spec
+
+
+def _flat(x):
+    n_b, seq, n_h, key = x.shape
+    return x.reshape(n_b, seq, n_h * key)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fwd_pallas(x, bias1, bias2, scale1, shift1, scale2, shift2,
+                interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_b, seq, n_h, key = x.shape
+    x_spec, map_spec, vec_spec = _specs(seq, key, n_h)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, seq=seq),
+        grid=(n_h, n_b),
+        in_specs=[x_spec, map_spec, map_spec, vec_spec, vec_spec, vec_spec,
+                  vec_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct((n_b, seq, n_h * key), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(_flat(x), bias1, bias2, scale1, shift1, scale2, shift2)
+    return out.reshape(x.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _bwd_pallas(x, bias1, bias2, scale1, shift1, scale2, shift2, dout,
+                interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_b, seq, n_h, key = x.shape
+    x_spec, map_spec, vec_spec = _specs(seq, key, n_h)
+    f32 = jnp.float32
+    outs = (jax.ShapeDtypeStruct((n_b, seq, n_h * key), x.dtype),  # dx
+            jax.ShapeDtypeStruct(bias1.shape, f32),                # dbias1
+            jax.ShapeDtypeStruct(bias2.shape, f32),                # dbias2
+            jax.ShapeDtypeStruct(scale1.shape, f32),               # dscale1
+            jax.ShapeDtypeStruct(shift1.shape, f32),               # dshift1
+            jax.ShapeDtypeStruct(scale2.shape, f32),               # dscale2
+            jax.ShapeDtypeStruct(shift2.shape, f32))               # dshift2
+    res = pl.pallas_call(
+        functools.partial(_bwd_kernel, seq=seq),
+        grid=(n_h, n_b),
+        in_specs=[x_spec, map_spec, map_spec, vec_spec, vec_spec, vec_spec,
+                  vec_spec, x_spec],
+        out_specs=(x_spec, map_spec, map_spec, vec_spec, vec_spec, vec_spec,
+                   vec_spec),
+        out_shape=outs,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(_flat(x), bias1, bias2, scale1, shift1, scale2, shift2, _flat(dout))
+    dx, db1, db2, ds1, dsh1, ds2, dsh2 = res
+    return dx.reshape(x.shape), db1, db2, ds1, dsh1, ds2, dsh2
+
+
+def mixer_chain_reference(x, bias1, bias2, scale1, shift1, scale2, shift2):
+    """The unfused chain as plain jnp on [B,S,H,K] (same math the layer
+    stack composes) — parity oracle for the kernels."""
+    cdtype = x.dtype
+    f32 = jnp.float32
+    mask = _causal(x.shape[1], cdtype)
+
+    def norm(t, scale, shift):
+        t32 = t.astype(f32)
+        m1 = jnp.mean(t32, axis=-1, keepdims=True)
+        m2 = jnp.mean(t32 * t32, axis=-1, keepdims=True)
+        var = jnp.maximum(m2 - m1 * m1, 0.0)
+        mul = jax.lax.rsqrt(var + 1e-5) * scale[None, None].astype(f32)
+        add = shift[None, None].astype(f32) - m1 * mul
+        return (t32 * mul + add).astype(cdtype)
+
+    def apply_map(bias, v):
+        bm = bias * mask[None]
+        out = jnp.einsum("hst,bthk->bshk", bm, v,
+                         preferred_element_type=f32)
+        return out.astype(cdtype)
+
+    n1 = norm(x, scale1, shift1)
+    a1 = apply_map(bias1, n1)
+    n2 = norm(a1, scale2, shift2)
+    g = jax.nn.gelu(n2)
+    return apply_map(bias2, g)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
+def fused_mixer_block(x, bias1, bias2, scale1, shift1, scale2, shift2,
+                      interpret: bool = False):
+    """norm -> masked-map attention -> norm -> gelu -> masked-map attention
+    in one pallas kernel (fwd) + one kernel for the full vjp (bwd).
+
+    x: [B,S,H,K]; bias*: [H,S,S]; scale/shift*: [H,K] (all in the
+    calculation dtype).  Param cotangents come back in the primal dtype
+    (f32-accumulated in-kernel, cast on exit — nd.einsum's policy)."""
+    return _fwd_pallas(x, bias1, bias2, scale1, shift1, scale2, shift2,
+                       interpret=interpret)
+
+
+def _fused_fwd(x, bias1, bias2, scale1, shift1, scale2, shift2,
+               interpret: bool = False):
+    out = _fwd_pallas(x, bias1, bias2, scale1, shift1, scale2, shift2,
+                      interpret=interpret)
+    return out, (x, bias1, bias2, scale1, shift1, scale2, shift2)
+
+
+def _fused_bwd(interpret, res, dout):
+    x, bias1, bias2, scale1, shift1, scale2, shift2 = res
+    dx, db1, db2, ds1, dsh1, ds2, dsh2 = _bwd_pallas(
+        x, bias1, bias2, scale1, shift1, scale2, shift2, dout,
+        interpret=interpret)
+    return (dx, db1.astype(bias1.dtype), db2.astype(bias2.dtype),
+            ds1.astype(scale1.dtype), dsh1.astype(shift1.dtype),
+            ds2.astype(scale2.dtype), dsh2.astype(shift2.dtype))
+
+
+fused_mixer_block.defvjp(_fused_fwd, _fused_bwd)
