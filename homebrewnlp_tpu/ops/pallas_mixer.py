@@ -18,18 +18,22 @@ docs/perf/README.md roofline), and the backward doubles it with recompute
 reads plus f32 grad temporaries.  Per (batch, head) slice, however, the
 whole chain is a pair of tiny ``[S,S] @ [S,K]`` matmuls with elementwise
 glue — it fits VMEM whole.  This kernel runs the chain (forward) and its
-entire vjp (backward) per ``(head, batch-row)`` grid cell: the forward
-reads x and writes out ONCE; the backward reads x and d(out) once, writes
-dx once, recomputes the internals in VMEM (remat-in-kernel — the same
-FLOPs XLA's remat executes, for a fraction of the bytes), and accumulates
-the parameter gradients (dbias1, dbias2, dscale/dshift) in f32 across the
+entire vjp (backward) per ``(head, batch-block)`` grid cell — each cell
+covers ``_block_rows`` batch rows (python-unrolled), amortizing the
+per-cell bias load, causal-mask build and DMA latency: the forward reads
+x and writes out ONCE; the backward reads x and d(out) once, writes dx
+once, recomputes the internals in VMEM (remat-in-kernel — the same FLOPs
+XLA's remat executes, for a fraction of the bytes), and accumulates the
+parameter gradients (dbias1, dbias2, dscale/dshift) in f32 across the
 batch grid axis.
 
 Layout notes (pallas TPU tiling): activations are viewed as
-``[B, S, H*K]`` so the per-head block is a ``[S, K]`` lane-aligned column
-slice (the same trick ops/pallas_attn.py uses); the tiny ``[H, K]``
-scale/shift vectors ride whole into VMEM and are row-indexed by the grid's
-head coordinate.
+``[B, S, H*K]`` so the per-head block is a stack of ``[S, K]``
+lane-aligned column slices (the same trick ops/pallas_attn.py uses); the
+tiny ``[H, K]`` scale/shift vectors ride as ``[H, 1, K]`` with a
+``(1, 1, K)`` per-head block — mosaic rejects dynamic sublane offsets
+into a whole-``[H, K]`` tile, and a head-blocked window needs no
+in-kernel dynamic indexing at all.
 
 Numerics match the unfused chain's dtype walk: norms compute in f32 from
 the stored dtype (models/layers.py::norm), map matmuls take
@@ -98,100 +102,116 @@ def _chain_fwd_tiles(x, b1m, b2m, s1, sh1, s2, sh2, cdtype):
 
 
 def _fwd_kernel(x_ref, b1_ref, b2_ref, s1_ref, sh1_ref, s2_ref, sh2_ref,
-                out_ref, *, seq: int):
-    from jax.experimental import pallas as pl
-
+                out_ref, *, seq: int, n_bt: int):
     cdtype = x_ref.dtype
-    h = pl.program_id(0)
     mask = _causal(seq, cdtype)
-    x = x_ref[0]
     b1m = b1_ref[0] * mask
     b2m = b2_ref[0] * mask
-    out, _ = _chain_fwd_tiles(
-        x, b1m, b2m,
-        s1_ref[h].astype(jnp.float32), sh1_ref[h].astype(jnp.float32),
-        s2_ref[h].astype(jnp.float32), sh2_ref[h].astype(jnp.float32),
-        cdtype)
-    out_ref[0] = out
+    s1 = s1_ref[0, 0].astype(jnp.float32)
+    sh1 = sh1_ref[0, 0].astype(jnp.float32)
+    s2 = s2_ref[0, 0].astype(jnp.float32)
+    sh2 = sh2_ref[0, 0].astype(jnp.float32)
+    for i in range(n_bt):  # unrolled: amortizes mask/bias setup + grid DMA
+        out, _ = _chain_fwd_tiles(x_ref[i], b1m, b2m, s1, sh1, s2, sh2,
+                                  cdtype)
+        out_ref[i] = out
 
 
 def _bwd_kernel(x_ref, b1_ref, b2_ref, s1_ref, sh1_ref, s2_ref, sh2_ref,
                 dout_ref, dx_ref, db1_ref, db2_ref, ds1_ref, dsh1_ref,
-                ds2_ref, dsh2_ref, *, seq: int):
+                ds2_ref, dsh2_ref, *, seq: int, n_bt: int):
     from jax.experimental import pallas as pl
 
     cdtype = x_ref.dtype
     f32 = jnp.float32
-    h = pl.program_id(0)
     b = pl.program_id(1)  # batch is the fastest grid axis: accumulate here
 
     mask = _causal(seq, cdtype)
-    x = x_ref[0]
     b1m = b1_ref[0] * mask
     b2m = b2_ref[0] * mask
-    s1 = s1_ref[h].astype(f32)
-    sh1 = sh1_ref[h].astype(f32)
-    s2 = s2_ref[h].astype(f32)
-    sh2 = sh2_ref[h].astype(f32)
+    s1 = s1_ref[0, 0].astype(f32)
+    sh1 = sh1_ref[0, 0].astype(f32)
+    s2 = s2_ref[0, 0].astype(f32)
+    sh2 = sh2_ref[0, 0].astype(f32)
+    maskf = mask.astype(f32)
 
-    # recompute the forward internals in VMEM (remat-in-kernel)
-    _, (n1, a1, n2, g) = _chain_fwd_tiles(x, b1m, b2m, s1, sh1, s2, sh2,
-                                          cdtype)
+    db1 = db2 = ds1 = dsh1 = ds2 = dsh2 = None
+    acc = lambda t, u: u if t is None else t + u
+    for i in range(n_bt):  # unrolled over the cell's batch rows
+        x = x_ref[i]
+        # recompute the forward internals in VMEM (remat-in-kernel)
+        _, (n1, a1, n2, g) = _chain_fwd_tiles(x, b1m, b2m, s1, sh1, s2,
+                                              sh2, cdtype)
+        dout = dout_ref[i]
+        # out = b2m @ g
+        dg = jnp.dot(b2m.T, dout, preferred_element_type=f32)
+        db2 = acc(db2, jnp.dot(dout, g.T, preferred_element_type=f32))
+        # g = gelu(n2) in cdtype (vjp evaluated in f32 of the cdtype-rounded
+        # n2, matching the unfused chain's value to rounding); the vjp
+        # cotangent comes back in n2's dtype — grads accumulate in f32
+        _, gelu_vjp = jax.vjp(lambda t: jax.nn.gelu(t.astype(f32)), n2)
+        (dn2,) = gelu_vjp(dg)
+        dn2 = dn2.astype(f32)
+        # n2 = norm(a1)
+        da1, ds2_i, dsh2_i = _norm_bwd(a1.astype(f32), s2, dn2)
+        da1c = da1.astype(cdtype)
+        # a1 = b1m @ n1
+        dn1 = jnp.dot(b1m.T, da1c, preferred_element_type=f32)
+        db1 = acc(db1, jnp.dot(da1c, n1.T, preferred_element_type=f32))
+        # n1 = norm(x)
+        dx, ds1_i, dsh1_i = _norm_bwd(x.astype(f32), s1, dn1)
+        dx_ref[i] = dx.astype(dx_ref.dtype)
+        ds1 = acc(ds1, ds1_i)
+        dsh1 = acc(dsh1, dsh1_i)
+        ds2 = acc(ds2, ds2_i)
+        dsh2 = acc(dsh2, dsh2_i)
+    db1 = db1 * maskf
+    db2 = db2 * maskf
 
-    dout = dout_ref[0]
-    # out = b2m @ g
-    dg = jnp.dot(b2m.T, dout, preferred_element_type=f32)
-    db2 = (jnp.dot(dout, g.T, preferred_element_type=f32)
-           * mask.astype(f32))
-    # g = gelu(n2) in cdtype (vjp evaluated in f32 of the cdtype-rounded n2,
-    # matching the unfused chain's value to rounding)
-    _, gelu_vjp = jax.vjp(lambda t: jax.nn.gelu(t.astype(f32)), n2)
-    (dn2,) = gelu_vjp(dg)
-    # n2 = norm(a1)
-    da1, ds2, dsh2 = _norm_bwd(a1.astype(f32), s2, dn2)
-    da1c = da1.astype(cdtype)
-    # a1 = b1m @ n1
-    dn1 = jnp.dot(b1m.T, da1c, preferred_element_type=f32)
-    db1 = (jnp.dot(da1c, n1.T, preferred_element_type=f32)
-           * mask.astype(f32))
-    # n1 = norm(x)
-    dx, ds1, dsh1 = _norm_bwd(x.astype(f32), s1, dn1)
-    dx_ref[0] = dx.astype(dx_ref.dtype)
-
-    # parameter grads accumulate across the batch grid axis in f32; the
-    # per-head [S,S] map blocks re-init whenever their window moves to a
-    # new head (b == 0), the whole-[H,K] vector blocks init once at the
-    # very first grid step
+    # parameter grads accumulate across the batch grid axis in f32; every
+    # param block window is per-head and moves only when the head
+    # coordinate advances, so each re-inits at b == 0 and accumulates
+    # across the (fastest) batch axis
     @pl.when(b == 0)
-    def _init_maps():
+    def _init():
         db1_ref[0] = db1
         db2_ref[0] = db2
+        ds1_ref[0, 0] = ds1
+        dsh1_ref[0, 0] = dsh1
+        ds2_ref[0, 0] = ds2
+        dsh2_ref[0, 0] = dsh2
 
     @pl.when(b != 0)
-    def _acc_maps():
+    def _acc():
         db1_ref[0] += db1
         db2_ref[0] += db2
-
-    @pl.when((b == 0) & (h == 0))
-    def _init_vecs():
-        ds1_ref[...] = jnp.zeros_like(ds1_ref)
-        dsh1_ref[...] = jnp.zeros_like(dsh1_ref)
-        ds2_ref[...] = jnp.zeros_like(ds2_ref)
-        dsh2_ref[...] = jnp.zeros_like(dsh2_ref)
-
-    ds1_ref[h] += ds1
-    dsh1_ref[h] += dsh1
-    ds2_ref[h] += ds2
-    dsh2_ref[h] += dsh2
+        ds1_ref[0, 0] += ds1
+        dsh1_ref[0, 0] += dsh1
+        ds2_ref[0, 0] += ds2
+        dsh2_ref[0, 0] += dsh2
 
 
-def _specs(seq: int, key: int, n_h: int):
+def _block_rows(n_b: int, seq: int, key: int) -> int:
+    """Batch rows per grid cell: amortize the per-cell bias load + mask
+    build + DMA latency, bounded by a ~14 MB VMEM budget for the backward's
+    ~12 live [S,K]-f32 tiles per row."""
+    budget = 14 * 1024 * 1024 // max(1, 12 * seq * key * 4)
+    bt = max(1, min(8, budget))
+    while n_b % bt:
+        bt -= 1
+    return bt
+
+
+def _specs(seq: int, key: int, n_bt: int):
     from jax.experimental import pallas as pl
-    # activations viewed as [B, S, H*K]: per-head block = [S, K] column
-    # slice (lane-aligned); maps blocked per head; [H,K] vectors whole
-    x_spec = pl.BlockSpec((1, seq, key), lambda h, b: (b, 0, h))
+    # activations viewed as [B, S, H*K]: per-head block = [n_bt, S, K]
+    # lane-aligned column slices; maps blocked per head
+    x_spec = pl.BlockSpec((n_bt, seq, key), lambda h, b: (b, 0, h))
     map_spec = pl.BlockSpec((1, seq, seq), lambda h, b: (h, 0, 0))
-    vec_spec = pl.BlockSpec((n_h, key), lambda h, b: (0, 0))
+    # [H,K] vectors ride as [H,1,K] with a (1,1,K) per-head block: mosaic
+    # rejects dynamic sublane offsets into a whole-[H,K] tile, but a
+    # head-blocked window needs no in-kernel dynamic indexing at all
+    vec_spec = pl.BlockSpec((1, 1, key), lambda h, b: (h, 0, 0))
     return x_spec, map_spec, vec_spec
 
 
@@ -207,10 +227,11 @@ def _fwd_pallas(x, bias1, bias2, scale1, shift1, scale2, shift2,
     from jax.experimental.pallas import tpu as pltpu
 
     n_b, seq, n_h, key = x.shape
-    x_spec, map_spec, vec_spec = _specs(seq, key, n_h)
+    n_bt = _block_rows(n_b, seq, key)
+    x_spec, map_spec, vec_spec = _specs(seq, key, n_bt)
     out = pl.pallas_call(
-        functools.partial(_fwd_kernel, seq=seq),
-        grid=(n_h, n_b),
+        functools.partial(_fwd_kernel, seq=seq, n_bt=n_bt),
+        grid=(n_h, n_b // n_bt),
         in_specs=[x_spec, map_spec, map_spec, vec_spec, vec_spec, vec_spec,
                   vec_spec],
         out_specs=x_spec,
@@ -218,7 +239,8 @@ def _fwd_pallas(x, bias1, bias2, scale1, shift1, scale2, shift2,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(_flat(x), bias1, bias2, scale1, shift1, scale2, shift2)
+    )(_flat(x), bias1, bias2,
+      scale1[:, None], shift1[:, None], scale2[:, None], shift2[:, None])
     return out.reshape(x.shape)
 
 
@@ -229,18 +251,20 @@ def _bwd_pallas(x, bias1, bias2, scale1, shift1, scale2, shift2, dout,
     from jax.experimental.pallas import tpu as pltpu
 
     n_b, seq, n_h, key = x.shape
-    x_spec, map_spec, vec_spec = _specs(seq, key, n_h)
+    n_bt = _block_rows(n_b, seq, key)
+    x_spec, map_spec, vec_spec = _specs(seq, key, n_bt)
     f32 = jnp.float32
+    vec3 = (n_h, 1, key)
     outs = (jax.ShapeDtypeStruct((n_b, seq, n_h * key), x.dtype),  # dx
             jax.ShapeDtypeStruct(bias1.shape, f32),                # dbias1
             jax.ShapeDtypeStruct(bias2.shape, f32),                # dbias2
-            jax.ShapeDtypeStruct(scale1.shape, f32),               # dscale1
-            jax.ShapeDtypeStruct(shift1.shape, f32),               # dshift1
-            jax.ShapeDtypeStruct(scale2.shape, f32),               # dscale2
-            jax.ShapeDtypeStruct(shift2.shape, f32))               # dshift2
+            jax.ShapeDtypeStruct(vec3, f32),                       # dscale1
+            jax.ShapeDtypeStruct(vec3, f32),                       # dshift1
+            jax.ShapeDtypeStruct(vec3, f32),                       # dscale2
+            jax.ShapeDtypeStruct(vec3, f32))                       # dshift2
     res = pl.pallas_call(
-        functools.partial(_bwd_kernel, seq=seq),
-        grid=(n_h, n_b),
+        functools.partial(_bwd_kernel, seq=seq, n_bt=n_bt),
+        grid=(n_h, n_b // n_bt),
         in_specs=[x_spec, map_spec, map_spec, vec_spec, vec_spec, vec_spec,
                   vec_spec, x_spec],
         out_specs=(x_spec, map_spec, map_spec, vec_spec, vec_spec, vec_spec,
@@ -249,9 +273,12 @@ def _bwd_pallas(x, bias1, bias2, scale1, shift1, scale2, shift2, dout,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=interpret,
-    )(_flat(x), bias1, bias2, scale1, shift1, scale2, shift2, _flat(dout))
+    )(_flat(x), bias1, bias2,
+      scale1[:, None], shift1[:, None], scale2[:, None], shift2[:, None],
+      _flat(dout))
     dx, db1, db2, ds1, dsh1, ds2, dsh2 = res
-    return dx.reshape(x.shape), db1, db2, ds1, dsh1, ds2, dsh2
+    return (dx.reshape(x.shape), db1, db2, ds1[:, 0], dsh1[:, 0],
+            ds2[:, 0], dsh2[:, 0])
 
 
 def mixer_chain_reference(x, bias1, bias2, scale1, shift1, scale2, shift2):

@@ -284,3 +284,36 @@ def test_fused_mixer_block_matches_unfused():
         scale = max(1e-3, float(np.abs(a).max()))
         assert np.abs(a - b).max() < 5e-3 * scale, (
             k, float(np.abs(a - b).max()), scale)
+
+
+def test_fused_mixer_kernel_batch_accumulation():
+    """Kernel-level: the backward's cross-grid-cell parameter-grad
+    accumulation (the pl.when(b != 0) path) must run — batch large enough
+    that the batch grid axis has multiple steps — and match the unfused
+    reference in f32."""
+    import numpy as np
+
+    from homebrewnlp_tpu.ops.pallas_mixer import (_block_rows,
+                                                  fused_mixer_block,
+                                                  mixer_chain_reference)
+    B, S, H, K = 16, 128, 2, 128
+    assert B > _block_rows(B, S, K)  # multiple batch grid steps
+    ks = jax.random.split(jax.random.key(3), 7)
+    f32 = jnp.float32
+    x = jax.random.normal(ks[0], (B, S, H, K), f32)
+    b1 = jax.random.normal(ks[1], (H, S, S), f32) * 0.02
+    b2 = jax.random.normal(ks[2], (H, S, S), f32) * 0.02
+    s1 = 1 + jax.random.normal(ks[3], (H, K), f32) * 0.02
+    sh1 = jax.random.normal(ks[4], (H, K), f32) * 0.02
+    s2 = 1 + jax.random.normal(ks[5], (H, K), f32) * 0.02
+    sh2 = jax.random.normal(ks[6], (H, K), f32) * 0.02
+    args = (x, b1, b2, s1, sh1, s2, sh2)
+    gr = jax.grad(lambda a: jnp.sum(mixer_chain_reference(*a) ** 2))(args)
+    gf = jax.grad(lambda a: jnp.sum(fused_mixer_block(*a, True) ** 2))(args)
+    for name, a, b_ in zip(("dx", "db1", "db2", "ds1", "dsh1", "ds2",
+                            "dsh2"), gr, gf):
+        a = np.asarray(a, np.float32)
+        b_ = np.asarray(b_, np.float32)
+        scale = max(1e-3, float(np.abs(a).max()))
+        assert np.abs(a - b_).max() < 2e-4 * scale, (
+            name, float(np.abs(a - b_).max()), scale)
