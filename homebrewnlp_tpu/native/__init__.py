@@ -246,21 +246,43 @@ def _bpe_train_py(word_counts: typing.Dict[bytes, int], n_merges: int,
 
 def _bpe_encode_py(tokens: np.ndarray, pairs: np.ndarray, first_new_id: int
                    ) -> np.ndarray:
+    """Heap-driven greedy BPE (merge the globally lowest-(rank, pos)
+    occurrence each step) — the same order the native encoder applies,
+    O(n log n)."""
+    import heapq
     rank = {(int(l), int(r)): i for i, (l, r) in enumerate(pairs)}
-    buf = list(tokens)
-    while True:
-        best = min((rank.get((a, b), len(pairs))
-                    for a, b in zip(buf, buf[1:])), default=len(pairs))
-        if best == len(pairs):
-            return np.asarray(buf, np.int32)
-        left, right = map(int, pairs[best])
-        new_id = first_new_id + best
-        out, i = [], 0
-        while i < len(buf):
-            if i + 1 < len(buf) and buf[i] == left and buf[i + 1] == right:
-                out.append(new_id)
-                i += 2
-            else:
-                out.append(buf[i])
-                i += 1
-        buf = out
+    n = len(tokens)
+    buf = [int(t) for t in tokens]
+    nxt = list(range(1, n + 1))
+    prv = list(range(-1, n - 1))
+    # negative INPUT tokens (word-boundary sentinels) are preserved and
+    # never pair; consumption is tracked separately (same contract as the
+    # native encoder)
+    dead = [False] * n
+    none = len(pairs)
+    heap = [(rank[(a, b)], i)
+            for i, (a, b) in enumerate(zip(buf, buf[1:]))
+            if (a, b) in rank]
+    heapq.heapify(heap)
+    while heap:
+        r, i = heapq.heappop(heap)
+        if dead[i]:
+            continue
+        j = nxt[i]
+        if j >= n or dead[j] or rank.get((buf[i], buf[j]), none) != r:
+            continue  # stale entry: the pair at i changed since the push
+        buf[i] = first_new_id + r
+        dead[j] = True
+        nxt[i] = nxt[j]
+        if nxt[j] < n:
+            prv[nxt[j]] = i
+        if prv[i] >= 0:
+            pr = rank.get((buf[prv[i]], buf[i]), none)
+            if pr < none:
+                heapq.heappush(heap, (pr, prv[i]))
+        if nxt[i] < n:
+            nr = rank.get((buf[i], buf[nxt[i]]), none)
+            if nr < none:
+                heapq.heappush(heap, (nr, i))
+    return np.asarray([t for i, t in enumerate(buf) if not dead[i]],
+                      np.int32)

@@ -38,24 +38,33 @@ def make_reversible_chain(fs: typing.Sequence[typing.Callable],
     up, so each block's vjp still sees cotangents of its output dtype —
     vjp rejects a dtype mismatch outright).  None keeps the exact default.
 
-    ``remat_blocks`` wraps each block in ``jax.checkpoint`` for the
+    ``remat_blocks`` wraps blocks in ``jax.checkpoint`` for the
     backward's ``jax.vjp`` replay: the replay forward then stores no
     internal residuals (norm stats, pre-activations, widened mids) and the
     pullback recomputes them — more FLOPs for fewer HBM bytes, profitable
     exactly when the step sits on the bandwidth roofline while the MXU is
     idle (docs/perf/README.md round 4: the 32mixer_group workload).
-    Numerics are unchanged (same math, different schedule).
+    Numerics are unchanged (same math, different schedule).  A bool
+    applies to every block; a per-block sequence lets callers skip blocks
+    that are already byte-minimal (round 5: a fused-kernel block's
+    custom_vjp stores only its inputs, so checkpointing it would re-add
+    the exact recompute the kernel already performs).
     """
     fs = tuple(fs)
+    if isinstance(remat_blocks, (list, tuple)):
+        assert len(remat_blocks) == len(fs), (len(remat_blocks), len(fs))
+        remat_flags = tuple(bool(r) for r in remat_blocks)
+    else:
+        remat_flags = (bool(remat_blocks),) * len(fs)
 
     tsub = jax.tree_util.tree_map
     if mode == "revnet":
         def step(f, p, x1, x2):
             return x2, tsub(lambda a, b: a + b, x1, f(p, x2))
 
-        def inv_and_grads(f, p, y1, y2, dy1, dy2):
+        def inv_and_grads(f, p, y1, y2, dy1, dy2, remat):
             x2 = y1
-            fx, vjp = jax.vjp(jax.checkpoint(f) if remat_blocks else f, p, x2)
+            fx, vjp = jax.vjp(jax.checkpoint(f) if remat else f, p, x2)
             x1 = tsub(lambda a, b: a - b, y2, fx)
             dp, dx2_f = vjp(dy2)
             dx1 = dy2
@@ -68,10 +77,10 @@ def make_reversible_chain(fs: typing.Sequence[typing.Callable],
             new_x = tsub(lambda a, b: a + b, x, new_v)
             return new_x, new_v
 
-        def inv_and_grads(f, p, y1, y2, dy1, dy2):
+        def inv_and_grads(f, p, y1, y2, dy1, dy2, remat):
             # y1 = x + v', y2 = v' = a*v + (1-a)*f(p, x)
             x = tsub(lambda a, b: a - b, y1, y2)
-            fx, vjp = jax.vjp(jax.checkpoint(f) if remat_blocks else f, p, x)
+            fx, vjp = jax.vjp(jax.checkpoint(f) if remat else f, p, x)
             v = tsub(lambda a, b: (a - (1 - alpha) * b) / alpha, y2, fx)
             d_sum = tsub(lambda a, b: a + b, dy1, dy2)
             dp, dx_f = vjp(tsub(lambda a: (1 - alpha) * a, d_sum))
@@ -100,7 +109,7 @@ def make_reversible_chain(fs: typing.Sequence[typing.Callable],
         dparams = [None] * len(fs)
         for i in range(len(fs) - 1, -1, -1):
             y1, y2, dy1, dy2, dparams[i] = inv_and_grads(
-                fs[i], params[i], y1, y2, dy1, dy2)
+                fs[i], params[i], y1, y2, dy1, dy2, remat_flags[i])
             if cotangent_dtype is not None and i > 0:
                 squash = lambda d: d.astype(cotangent_dtype).astype(d.dtype)
                 dy1 = tsub(squash, dy1)

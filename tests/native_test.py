@@ -103,3 +103,14 @@ def test_tooling_scripts_end_to_end(tmp_path):
     ex = decode_example(payload)
     assert len(ex["text"]) == n_tokens
     assert n_tokens < 200 * 24  # BPE compressed below byte count
+
+
+def test_bpe_encode_preserves_negative_sentinels():
+    """Negative tokens (word-boundary sentinels in the train-corpus format)
+    must survive encoding unmerged and in place — the heap encoder tracks
+    consumption separately from the token values (round-5 regression)."""
+    pairs = np.asarray([[1, 2]], np.int32)
+    src = np.asarray([1, 2, -1, 1, 2, -7, 3], np.int32)
+    want = [256, -1, 256, -7, 3]
+    assert bpe_encode(src, pairs).tolist() == want
+    assert _bpe_encode_py(src.copy(), pairs, 256).tolist() == want
