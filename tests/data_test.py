@@ -393,3 +393,28 @@ def test_metric_writer_scalars_and_histograms(tmp_path):
     line = jsonlib.loads((tmp_path / "metrics.jsonl").read_text().splitlines()[0])
     assert line["loss"] == 1.5 and line["grad_norm/x"] == 2.0
     assert "grad_hist/x" not in line  # vectors go to TB only
+
+
+def test_bench_guard_threshold_logic():
+    """bench.evaluate_guard: round-4-record thresholds at full length,
+    reach-what-you-ran semantics for short development runs."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import evaluate_guard
+
+    def rows(pairs):
+        return [{"step": s, "loss": l} for s, l in pairs]
+
+    healthy = rows([(1, 7.77), (60, 4.32), (120, 4.10), (300, 3.56)])
+    assert evaluate_guard(healthy, 300)["pass"]
+    # short dev run: only the reached checkpoints are asserted
+    assert evaluate_guard(rows([(1, 7.77), (50, 5.9)]), 50)["pass"]
+    # not decreasing -> fail even short
+    assert not evaluate_guard(rows([(1, 7.77), (50, 7.9)]), 50)["pass"]
+    # bad init (loaded checkpoint instead of fresh) -> fail
+    assert not evaluate_guard(rows([(1, 3.0), (300, 2.5)]), 300)["pass"]
+    # stalls above the 120-step bar -> fail at full length
+    stalled = rows([(1, 7.77), (120, 6.2), (300, 6.0)])
+    assert not evaluate_guard(stalled, 300)["pass"]

@@ -317,3 +317,23 @@ def test_fused_mixer_kernel_batch_accumulation():
         scale = max(1e-3, float(np.abs(a).max()))
         assert np.abs(a - b_).max() < 2e-4 * scale, (
             name, float(np.abs(a - b_).max()), scale)
+
+
+def test_fused_mixer_falls_back_under_sharded_mesh(eight_devices):
+    """fused_mixer_block=true on a multi-device mesh must silently take the
+    unfused GSPMD chain (pallas custom calls cannot be partitioned) — the
+    knob is safe to leave on in a config that also runs sharded."""
+    import numpy as np
+
+    from homebrewnlp_tpu.parallel import make_mesh
+    from homebrewnlp_tpu.train import Trainer
+    cfg = mixer_config(sequence_length=128, features_per_head=128, heads=2,
+                       depth=2, train_batch_size=8, tpu_size=8,
+                       fused_mixer_block=True)
+    mesh = make_mesh(cfg)
+    assert mesh.size == 8
+    trainer = Trainer(cfg, mesh)
+    batch = text_batch(cfg)
+    state = trainer.init(batch)
+    state, m = trainer.step(state, batch, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
