@@ -169,6 +169,10 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # default, "" = disabled; consumed at the CLI/bench entry points via
     # utils.enable_compilation_cache)
     compilation_cache_dir=None,
+    # serving codec for tools/train_tokenizer.py artifacts: when set, the
+    # query/REST/sample text paths encode+decode through this tokenizer
+    # (serve/interface.py::HbnlpBpeTokenizer) instead of bytes/GPT-2
+    tokenizer_path="",
     # dtypes (storage/compute/optimizer policy; reference dataclass.py:82-86)
     storage_dtype="float32",
     slice_dtype="float32",

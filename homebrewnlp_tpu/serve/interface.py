@@ -44,7 +44,44 @@ class Gpt2Tokenizer:
         return self._tok.decode(list(ids))
 
 
+class HbnlpBpeTokenizer:
+    """Serving-side codec for a tools/train_tokenizer.py artifact
+    (byte-fallback BPE: ids < first_new_id are raw bytes, id
+    first_new_id+i expands to merge i's pair).  Encoding runs the same
+    heap-driven native encoder the tfrecord builder uses, so serving and
+    training tokenize identically."""
+
+    def __init__(self, path: str):
+        import json
+
+        import numpy as np
+        with open(path) as f:
+            art = json.load(f)
+        self._merges = np.asarray(art["merges"], np.int32)
+        self._first = int(art.get("first_new_id", 256))
+        # id -> bytes, built bottom-up (merge i only references ids < i)
+        table: typing.List[bytes] = [bytes([b]) for b in range(self._first)]
+        for left, right in self._merges:
+            table.append(table[int(left)] + table[int(right)])
+        self._bytes = table
+
+    def encode(self, text: str) -> typing.List[int]:
+        import numpy as np
+
+        from ..native import bpe_encode
+        raw = np.frombuffer(text.encode("utf-8", errors="replace"),
+                            np.uint8).astype(np.int32)
+        return [int(t) for t in bpe_encode(raw, self._merges, self._first)]
+
+    def decode(self, ids: typing.Sequence[int]) -> str:
+        out = b"".join(self._bytes[int(i)] for i in ids
+                       if 0 <= int(i) < len(self._bytes))
+        return out.decode("utf-8", errors="replace")
+
+
 def tokenizer_for(cfg: Config):
+    if getattr(cfg, "tokenizer_path", ""):
+        return HbnlpBpeTokenizer(cfg.tokenizer_path)
     if cfg.vocab_size <= 256:
         return ByteTokenizer()
     try:

@@ -460,3 +460,27 @@ def test_repl_smoke(cfg_params, monkeypatch, capsys):
     out = capsys.readouterr().out
     # more than the banner: a completion line was actually printed
     assert len([l for l in out.splitlines() if l.strip()]) >= 2
+
+
+def test_hbnlp_bpe_tokenizer_roundtrip():
+    """Serving codec for the committed in-house tokenizer artifact: encode
+    through the native BPE encoder, decode by merge-table expansion;
+    roundtrip must be identity for UTF-8 text and match the tfrecord
+    builder's token stream."""
+    import os
+    import numpy as np
+    from homebrewnlp_tpu.native import bpe_encode, clean_text
+    from homebrewnlp_tpu.serve.interface import HbnlpBpeTokenizer
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "datasets", "tokenizer65k.json")
+    tok = HbnlpBpeTokenizer(path)
+    text = "def main() -> None:\n    return os.path.join(a, b)  # comment\n"
+    ids = tok.encode(text)
+    assert len(ids) < len(text.encode())  # actually compresses code
+    assert tok.decode(ids) == text
+    # identical stream to the tfrecord builder's encode of the same bytes
+    raw = np.frombuffer(text.encode(), np.uint8).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(ids, np.int32),
+                                  bpe_encode(raw, tok._merges))
+    # unicode replacement path stays total
+    assert tok.decode([0, 70000, 5]) == tok.decode([0, 5])
