@@ -173,6 +173,10 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # query/REST/sample text paths encode+decode through this tokenizer
     # (serve/interface.py::HbnlpBpeTokenizer) instead of bytes/GPT-2
     tokenizer_path="",
+    # None = the reference's rule (only use_random_dataloader repeats,
+    # inputs.py:540-541); true forces deterministic epoch wrap-around on
+    # the sequential reader, false forces single-epoch
+    repeat_dataset=None,
     # dtypes (storage/compute/optimizer policy; reference dataclass.py:82-86)
     storage_dtype="float32",
     slice_dtype="float32",

@@ -236,9 +236,17 @@ class GptPipeline:
             cfg.data_seed * int(cfg.shuffle_input_filenames), runs_log)
         window = cfg.sequence_length + cfg.token_patch_size * cfg.output_offset
         self.rows = cfg.sequence_length // cfg.token_patch_size
+        # repeat_dataset=None keeps the reference's rule (only the random
+        # dataloader repeats, inputs.py:540-541 — the sequential reader is
+        # single-epoch and training DIES at exhaustion there); explicit
+        # true/false overrides it (epoch wrap-around reuses the modulo file
+        # indexing of _Interleave._open, so the deterministic order and the
+        # resume cursor survive the epoch boundary)
+        repeat = (cfg.use_random_dataloader if cfg.repeat_dataset is None
+                  else bool(cfg.repeat_dataset))
         self.interleave = _Interleave(
             files, file_skips, window, cfg.sequence_length,
-            cfg.interleaved_datasets, repeat=cfg.use_random_dataloader)
+            cfg.interleaved_datasets, repeat=repeat)
         self.stream: typing.Iterable = self.interleave
         if cfg.use_random_dataloader and cfg.shuffle_buffer > 1:
             self.stream = _ShuffleBuffer(self.interleave, cfg.shuffle_buffer,

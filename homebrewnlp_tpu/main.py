@@ -165,7 +165,18 @@ def train(cfg, args) -> None:
                           else None)
             ckpt.save(state, data_state, master_dtype=cfg.storage_dtype)
         if pipe is not None:
-            np_batch = next(batches)
+            try:
+                np_batch = next(batches)
+            except StopIteration:
+                # single-epoch dataset exhausted (the reference's sequential
+                # reader dies on OutOfRange here, inputs.py:540-541): stop
+                # CLEANLY — final checkpoint below, clear message, no
+                # traceback.  Set repeat_dataset=true for deterministic
+                # epoch wrap-around.
+                color_print(f"dataset exhausted after update {u + 1} "
+                            f"(step {int(state.step)}); stopping — set "
+                            "repeat_dataset=true for multi-epoch runs")
+                break
         else:
             np_batch = synthetic_text_batch(cfg, u + 1)
     if tracing:  # run ended inside the profile window

@@ -418,3 +418,32 @@ def test_bench_guard_threshold_logic():
     # stalls above the 120-step bar -> fail at full length
     stalled = rows([(1, 7.77), (120, 6.2), (300, 6.0)])
     assert not evaluate_guard(stalled, 300)["pass"]
+
+
+def test_repeat_dataset_epoch_wraparound(tmp_path):
+    """repeat_dataset=true: the sequential reader wraps deterministically at
+    the epoch boundary (same window order every epoch), and the resume
+    cursor keeps working across it — the reference's sequential path dies
+    on exhaustion here (inputs.py:540-541)."""
+    from homebrewnlp_tpu.data.synthetic import write_text_tfrecords
+
+    cfg = mixer_config(sequence_length=8, token_patch_size=1,
+                       use_random_dataloader=False, repeat_dataset=True,
+                       interleaved_datasets=2)
+    paths = write_text_tfrecords(str(tmp_path), n_files=2,
+                                 records_per_file=1, tokens_per_record=64,
+                                 seed=3)
+    pipe = GptPipeline(cfg, sub_batch_size=2, paths=paths)
+    it = iter(pipe)
+    # one epoch = 2 files x 64 tokens -> 14 windows of 9 -> 7 batches of 2
+    epoch1 = [next(it)["token_x"].copy() for _ in range(7)]
+    epoch2 = [next(it)["token_x"].copy() for _ in range(7)]
+    for a, b in zip(epoch1, epoch2):
+        np.testing.assert_array_equal(a, b)
+    # single-epoch default (reference rule): same config without the knob
+    cfg1 = mixer_config(sequence_length=8, token_patch_size=1,
+                        use_random_dataloader=False,
+                        interleaved_datasets=2)
+    it1 = iter(GptPipeline(cfg1, sub_batch_size=2, paths=paths))
+    n = sum(1 for _ in it1)
+    assert n == 7
