@@ -116,13 +116,14 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
     # counted; pallas kernels are opaque to cost analysis, so the unfused
     # chain is the only honest flop count)
     flops_algo = flops_exec
-    kernel_opaque = bool(cfg.fused_mixer_block)
+    kernel_opaque = bool(cfg.fused_mixer_block or cfg.fused_group_linear)
     if cfg.reversible_remat_blocks or kernel_opaque:
         from homebrewnlp_tpu.optim import Optimizer
         cfg_algo = load_config(f"configs/{name}.json", **_COMMON,
                                **WORKLOADS[name],
                                reversible_remat_blocks=False,
-                               fused_mixer_block=False)
+                               fused_mixer_block=False,
+                               fused_group_linear=False)
         # params/opt-state/axes are identical either way: adopt them from
         # the measured trainer instead of re-initializing on device
         tr_algo = Trainer(cfg_algo)

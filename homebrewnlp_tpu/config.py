@@ -158,6 +158,11 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # lever for the bandwidth-bound mixer workloads, ops/pallas_mixer.py).
     # Single-device only: the GSPMD/sharded paths keep the unfused chain.
     fused_mixer_block=False,
+    # fuse the [norm, bottleneck_group_linear] block into two pallas
+    # fwd+bwd kernel pairs split at the bottleneck activation (the second
+    # bytes lever for the group workload, ops/pallas_group.py).  Same
+    # single-device guard as fused_mixer_block.
+    fused_group_linear=False,
     debug_train_step=False,
     debug_gradients=False,
     current_step=0,

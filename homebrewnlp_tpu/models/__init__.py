@@ -218,9 +218,10 @@ def _body(ctx: Ctx, src: NT) -> NT:
             # remat skips fused-kernel blocks: their custom_vjp already
             # stores only inputs, so jax.checkpoint there would re-run the
             # forward kernel for nothing (measured +30 ms on 32mixer_group)
-            from .layers import fused_mixer_eligible
+            from .layers import fused_group_eligible, fused_mixer_eligible
             rb = [cfg.reversible_remat_blocks
                   and not fused_mixer_eligible(ctx, cfg.block_config[c], src)
+                  and not fused_group_eligible(ctx, cfg.block_config[c], src)
                   for _, c in seq]
             chain = make_reversible_chain(fs, mode=strategy,
                                           alpha=cfg.momentumnet_alpha,

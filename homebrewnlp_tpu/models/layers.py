@@ -639,6 +639,15 @@ def convolution(args: Args) -> NT:
 
 # -- fused mixer block (pallas bytes lever) ---------------------------------
 
+def _fused_norm_params(args: Args) -> typing.Tuple[NT, NT]:
+    """The norm layer's scale/shift constructor pair, shared by both fused
+    block replays so the two paths cannot diverge from the unfused norm()."""
+    fs = linear_shapes(args)[0]
+    scale = normal_var(args, fs, mean=1.0, name="scale")
+    shift = normal_var(args, fs, mean=0.0, name="shift")
+    return scale, shift
+
+
 MIXER_FUSED_PATTERN = (
     "norm-shift-scale-features-group",
     "attention-biased_attention_map-absolute-input_as_value-shared",
@@ -678,12 +687,6 @@ def fused_mixer_block_part(conf, ctx, x: NT) -> NT:
     cfg = ctx.cfg
     collected: typing.List[NT] = []
 
-    def norm_params(args: Args) -> typing.Tuple[NT, NT]:
-        fs = linear_shapes(args)[0]
-        scale = normal_var(args, fs, mean=1.0, name="scale")
-        shift = normal_var(args, fs, mean=0.0, name="shift")
-        return scale, shift
-
     def attn_params(args: Args) -> NT:
         ctx.attention_idx += 1
         dim = get_attention_dim(args).dim
@@ -696,7 +699,7 @@ def fused_mixer_block_part(conf, ctx, x: NT) -> NT:
         name, *extras = layer_spec.split("-")
         args = Args(ctx, x, extras, idx == len(specs))
         if name == "norm":
-            collected.append(ctx.scoped("norm_", norm_params, args))
+            collected.append(ctx.scoped("norm_", _fused_norm_params, args))
         elif name == "attention":
             collected.append(ctx.scoped("attention_", attn_params, args))
         else:  # activation: consumes its scope slot, holds no parameters
@@ -714,6 +717,93 @@ def fused_mixer_block_part(conf, ctx, x: NT) -> NT:
         shift1.transpose_to((HEADS, KEY)).x,
         scale2.transpose_to((HEADS, KEY)).x,
         shift2.transpose_to((HEADS, KEY)).x,
+        jax.default_backend() not in ("tpu", "axon"),  # interpret on CPU
+    )
+    return NT(out_x, order).transpose_to(x.names)
+
+
+# -- fused bottleneck-group-linear block (pallas bytes lever #2) ------------
+
+GROUP_FUSED_PATTERN = (
+    "norm-shift-scale-features-group",
+    "bottleneck_group_linear-in:relu-mid:relu-mid:norm-mid:shift-mid:scale"
+    "-mid:features",
+)
+
+
+def fused_group_eligible(ctx, conf, x: NT) -> bool:
+    """The two-kernel pair (ops/pallas_group.py) replaces exactly the group
+    configs' block-1 chain [group norm, bottleneck_group_linear] on an
+    unsharded device, in apply mode, on the plain rank-4 text layout with
+    lane-aligned widths (the block is per-position, so no mask/seq
+    constraint applies — only tiling)."""
+    cfg = ctx.cfg
+    layer = conf.layer if isinstance(conf.layer, (list, tuple)) else None
+    mid = cfg.features_per_head * cfg.group_linear_factor
+    n_rows = (x.dim_size(x.names[0]) * x.dim_size(SEQUENCE)
+              if SEQUENCE in x.names else 0)
+    return (cfg.fused_group_linear
+            and layer is not None and tuple(layer) == GROUP_FUSED_PATTERN
+            and ctx.params is not None and ctx.decode is None
+            and (ctx.mesh is None or ctx.mesh.size == 1)
+            and x.names[1:] == (SEQUENCE, HEADS, KEY)
+            and x.dim_size(KEY) % 128 == 0
+            and mid % 128 == 0
+            and cfg.intermediate_size % 128 == 0
+            and n_rows % 128 == 0
+            and jax.default_backend() in ("tpu", "axon", "cpu"))
+
+
+def fused_group_block_part(conf, ctx, x: NT) -> NT:
+    """Apply the [group norm, bottleneck_group_linear] block through the
+    fused pallas kernel pair.
+
+    The scope walk REPLAYS ``registry._get_block_part`` exactly — the same
+    ``ctx.scoped`` calls in the same order with the same parameter
+    constructors the unfused layers invoke (norm's normal_var pair, then
+    inside the bottleneck scope: linear's scoped orthogonal_var for W1/W2,
+    the mid-norm's normal_var pair, orthogonal_var for W3) — so parameter
+    names, shapes and init are bit-identical to the unfused chain and
+    checkpoints interchange freely between the two paths."""
+    from ..ops.pallas_group import fused_group_linear_block
+
+    cfg = ctx.cfg
+    anon_key = anonymize_name(KEY)
+    inter = cfg.intermediate_size
+    mid = cfg.features_per_head * cfg.group_linear_factor
+    in_dims = [(HEADS, cfg.heads), (KEY, cfg.features_per_head)]
+    mid_dims = [(HEADS, cfg.heads), (anon_key, mid)]
+
+    def bgl_params(args: Args):
+        w1 = ctx.scoped("orthogonal_var", orthogonal_var, args,
+                        in_dims + [(INTERMEDIATE, inter)], in_dims)
+        old1 = [(INTERMEDIATE, inter)]
+        w2 = ctx.scoped("orthogonal_var", orthogonal_var, args,
+                        old1 + mid_dims, old1)
+        s1 = normal_var(args, mid_dims, mean=1.0, name="scale")
+        h1 = normal_var(args, mid_dims, mean=0.0, name="shift")
+        w3 = ctx.scoped("orthogonal_var", orthogonal_var, args,
+                        mid_dims + in_dims, mid_dims)
+        return w1, w2, s1, h1, w3
+
+    specs = list(conf.layer)
+    norm_spec, bgl_spec = specs
+    norm_args = Args(ctx, x, norm_spec.split("-")[1:], False)
+    scale0, shift0 = ctx.scoped("norm_", _fused_norm_params, norm_args)
+    bgl_args = Args(ctx, x, bgl_spec.split("-")[1:], True)
+    w1, w2, s1, h1, w3 = ctx.scoped("bottleneck_group_linear_", bgl_params,
+                                    bgl_args)
+
+    order = (x.names[0], SEQUENCE, HEADS, KEY)
+    out_x = fused_group_linear_block(
+        x.transpose_to(order).x,
+        w1.transpose_to((HEADS, KEY, INTERMEDIATE)).x,
+        w2.transpose_to((INTERMEDIATE, HEADS, anon_key)).x,
+        w3.transpose_to((HEADS, anon_key, KEY)).x,
+        scale0.transpose_to((HEADS, KEY)).x,
+        shift0.transpose_to((HEADS, KEY)).x,
+        s1.transpose_to((HEADS, anon_key)).x,
+        h1.transpose_to((HEADS, anon_key)).x,
         jax.default_backend() not in ("tpu", "axon"),  # interpret on CPU
     )
     return NT(out_x, order).transpose_to(x.names)

@@ -319,6 +319,120 @@ def test_fused_mixer_kernel_batch_accumulation():
             name, float(np.abs(a - b_).max()), scale)
 
 
+def test_fused_group_block_matches_unfused():
+    """ops/pallas_group.py (interpret mode on CPU): the fused two-kernel
+    [group norm, bottleneck_group_linear] pair must reproduce the unfused
+    layer chain inside the REAL model — identical parameter names
+    (checkpoints interchange) and matching loss/grads in f32."""
+    import numpy as np
+    dt = dict(calculation_dtype="float32", storage_dtype="float32",
+              slice_dtype="float32", optimizer_slice_dtype="float32")
+    # memory_reduction_strategy="none" for the tight grad assertion: revnet's
+    # stream reconstruction (x1 = y1 - f(y2)) chaotically amplifies the
+    # fusion's benign summation-order differences (measured: 6e-7 rel grads
+    # under "none" vs 1.6e-2 under revnet for the SAME kernels — the same
+    # caveat docs/perf/README.md records for every remat/fusion change)
+    shape = dict(sequence_length=128, features_per_head=128, heads=2,
+                 depth=2, train_batch_size=2,
+                 memory_reduction_strategy="none")
+    cfg_u = mixer_config(**shape, **dt)
+    cfg_f = mixer_config(**shape, **dt, fused_group_linear=True)
+    # lane-aligned widths: K=128, mid=256, bottleneck I=128, N=256
+    assert cfg_f.intermediate_size % 128 == 0
+    pu, axu, batch, loss_u = init_and_loss(cfg_u)
+    pf, axf, _, loss_f = init_and_loss(cfg_f)
+    # identical scope walk => identical parameter census
+    assert set(pu) == set(pf)
+    for k in pu:
+        np.testing.assert_array_equal(np.asarray(pu[k]), np.asarray(pf[k]))
+
+    # XLA:CPU's DEFAULT f32 dot is split-bf16 (~1e-3 wobble, shape-
+    # dependent); pin exact-f32 dots on both paths so parity is tight
+    with jax.default_matmul_precision("highest"):
+        lu = float(jax.jit(loss_u)(pu, jax.random.key(0)))
+        lf = float(jax.jit(loss_f)(pu, jax.random.key(0)))
+        assert abs(lu - lf) < 1e-5 * max(1.0, abs(lu)), (lu, lf)
+
+        gu = jax.jit(jax.grad(loss_u))(pu, jax.random.key(0))
+        gf = jax.jit(jax.grad(loss_f))(pu, jax.random.key(0))
+    for k in gu:
+        a = np.asarray(gu[k], np.float32)
+        b = np.asarray(gf[k], np.float32)
+        scale = max(1e-3, float(np.abs(a).max()))
+        assert np.abs(a - b).max() < 1e-4 * scale, (
+            k, float(np.abs(a - b).max()), scale)
+
+    # under revnet the kernels still train the same model: loss parity holds
+    # (grads deviate only through the reconstruction's rounding chaos)
+    cfg_ur = mixer_config(**{**shape, "memory_reduction_strategy": "revnet"},
+                          **dt)
+    cfg_fr = mixer_config(**{**shape, "memory_reduction_strategy": "revnet"},
+                          **dt, fused_group_linear=True)
+    pur, _, _, loss_ur = init_and_loss(cfg_ur)
+    _, _, _, loss_fr = init_and_loss(cfg_fr)
+    with jax.default_matmul_precision("highest"):
+        lur = float(jax.jit(loss_ur)(pur, jax.random.key(0)))
+        lfr = float(jax.jit(loss_fr)(pur, jax.random.key(0)))
+    assert abs(lur - lfr) < 1e-4 * max(1.0, abs(lur)), (lur, lfr)
+
+
+def test_fused_group_kernel_row_accumulation():
+    """Kernel-level: the backward's cross-grid-cell parameter-grad
+    accumulation (the pl.when(r != 0) path) must run — rows beyond one
+    grid cell of BOTH kernels — and match the unfused reference in f32."""
+    import numpy as np
+
+    from homebrewnlp_tpu.ops.pallas_group import (fused_group_linear_block,
+                                                  group_chain_reference)
+    B, S, H, K, I, J = 8, 128, 2, 128, 128, 256
+    assert B * S > 512  # > kernel IN's row budget => multiple grid cells
+    ks = jax.random.split(jax.random.key(3), 8)
+    f32 = jnp.float32
+    x = jax.random.normal(ks[0], (B, S, H, K), f32)
+    w1 = jax.random.normal(ks[1], (H, K, I), f32) * 0.05
+    w2 = jax.random.normal(ks[2], (I, H, J), f32) * 0.05
+    w3 = jax.random.normal(ks[3], (H, J, K), f32) * 0.05
+    s0 = 1 + jax.random.normal(ks[4], (H, K), f32) * 0.02
+    h0 = jax.random.normal(ks[5], (H, K), f32) * 0.02
+    s1 = 1 + jax.random.normal(ks[6], (H, J), f32) * 0.02
+    h1 = jax.random.normal(ks[7], (H, J), f32) * 0.02
+    args = (x, w1, w2, w3, s0, h0, s1, h1)
+    # XLA:CPU's DEFAULT f32 dot is split-bf16 (~1e-3 wobble, shape-
+    # dependent); pin exact-f32 dots on both paths so parity is tight
+    with jax.default_matmul_precision("highest"):
+        gr = jax.grad(
+            lambda a: jnp.sum(group_chain_reference(*a) ** 2))(args)
+        gf = jax.grad(
+            lambda a: jnp.sum(fused_group_linear_block(*a, True) ** 2))(args)
+    for name, a, b_ in zip(("dx", "dw1", "dw2", "dw3", "ds0", "dh0",
+                            "ds1", "dh1"), gr, gf):
+        a = np.asarray(a, np.float32)
+        b_ = np.asarray(b_, np.float32)
+        scale = max(1e-3, float(np.abs(a).max()))
+        assert np.abs(a - b_).max() < 2e-4 * scale, (
+            name, float(np.abs(a - b_).max()), scale)
+
+
+def test_fused_group_falls_back_under_sharded_mesh(eight_devices):
+    """fused_group_linear=true on a multi-device mesh must silently take
+    the unfused GSPMD chain (pallas custom calls cannot be partitioned) —
+    the knob is safe to leave on in a config that also runs sharded."""
+    import numpy as np
+
+    from homebrewnlp_tpu.parallel import make_mesh
+    from homebrewnlp_tpu.train import Trainer
+    cfg = mixer_config(sequence_length=128, features_per_head=128, heads=2,
+                       depth=2, train_batch_size=8, tpu_size=8,
+                       fused_group_linear=True)
+    mesh = make_mesh(cfg)
+    assert mesh.size == 8
+    trainer = Trainer(cfg, mesh)
+    batch = text_batch(cfg)
+    state = trainer.init(batch)
+    state, m = trainer.step(state, batch, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_fused_mixer_falls_back_under_sharded_mesh(eight_devices):
     """fused_mixer_block=true on a multi-device mesh must silently take the
     unfused GSPMD chain (pallas custom calls cannot be partitioned) — the

@@ -1,0 +1,56 @@
+"""On-chip A/B probe: 32mixer_group with/without the fused group-linear
+kernel pair (ops/pallas_group.py).  Same harness as bench.bench_workload
+(median-of-5x10 windows, host-pull timing)."""
+import json
+import sys
+import time
+
+import jax
+
+sys.path.insert(0, ".")
+
+
+def run(fused: bool) -> dict:
+    from homebrewnlp_tpu.train import Trainer
+    from homebrewnlp_tpu.utils import load_config, random_text_batch
+
+    cfg = load_config("configs/32mixer_group.json", use_checkpointing=False,
+                      calc_accuracy=False, tpu_size=1,
+                      slice_dtype="bfloat16", train_batch_size=64,
+                      fused_group_linear=fused)
+    trainer = Trainer(cfg)
+    batch = random_text_batch(cfg)
+    state = trainer.init(batch)
+    rng = jax.random.key(1)
+    step_i = 0
+
+    def run_steps(n, state):
+        nonlocal step_i
+        metrics = None
+        for _ in range(n):
+            state, metrics = trainer.step(state, batch,
+                                          jax.random.fold_in(rng, step_i))
+            step_i += 1
+        return state, metrics
+
+    state, metrics = run_steps(3, state)
+    loss3 = float(metrics["loss"])
+    windows = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        state, metrics = run_steps(10, state)
+        float(metrics["loss"])
+        windows.append(time.perf_counter() - t0)
+    dt = sorted(windows)[2]
+    tokens = cfg.train_batch_size * cfg.sequence_length * 10
+    return {"fused_group": fused, "ms_per_step": round(dt / 10 * 1e3, 1),
+            "tok_s": round(tokens / dt, 0), "loss_after_3": round(loss3, 4),
+            "loss_after_53": round(float(metrics["loss"]), 4),
+            "windows_step_ms": [round(w / 10 * 1e3, 1) for w in windows]}
+
+
+if __name__ == "__main__":
+    from homebrewnlp_tpu.utils import enable_compilation_cache
+    enable_compilation_cache(None)
+    for fused in (sys.argv[1:] or ["true", "false"]):
+        print(json.dumps(run(fused == "true")), flush=True)
