@@ -25,10 +25,19 @@ physically-possible mfu is <= 1.0; if the host<->chip transport distorts
 wall-clock, ``distorted`` is set and the throughput must not be trusted.
 
 ``numerics_guard`` (VERDICT r4 item 9) replays the first N (default 300)
-steps of the real-corpus 32ctx run (``configs/32ctx_real_1chip.json``, the
-committed 84M-token corpus) through the full CLI train path and asserts the
-warmup trajectory: fresh-init loss > 6.5, loss below 5.0 by step 120, final
-loss < 4.6 and finite (round-4 measured 7.77 -> 4.10@120 -> 3.56@300).
+steps of the real-corpus 32ctx ACCEPTANCE run
+(``configs/32ctx_accept_10k.json`` — the Run-B hyperparameters, LR 0.002 /
+warmup 512, on the committed 84M-token corpus) through the full CLI train
+path and asserts the warmup trajectory: fresh-init loss > 6.5, loss below
+4.5 by step 120, final loss < 3.6 and finite (the committed 10k-run record
+measured 7.71 -> 3.45@100 -> 2.76-class@300, docs/perf/32ctx_10k_run.md).
+Round-5 correction: the guard originally ran ``32ctx_real_1chip.json``
+(the reference's LR 0.01 at batch 8) — an operating point
+docs/perf/32ctx_real_run.md already documents as UNSTABLE ("grad norms
+climb and the loss regresses to 5-8"); a guard anchored there flakes
+across environments (measured: the identical round-4-final code replays
+at 5.67@120 today).  The stable Run-B point is what the 10k acceptance
+record pins, so that is what the guard checks.
 
 The MTF reference publishes no numbers (see BASELINE.md), so ``vs_baseline``
 is computed against the first value this repo ever recorded
@@ -117,13 +126,18 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
     # chain is the only honest flop count)
     flops_algo = flops_exec
     kernel_opaque = bool(cfg.fused_mixer_block or cfg.fused_group_linear)
-    if cfg.reversible_remat_blocks or kernel_opaque:
+    if cfg.reversible_remat_blocks or kernel_opaque or cfg.blocked_causal_map:
         from homebrewnlp_tpu.optim import Optimizer
+        # blocked_causal_map also resets to 0: the algorithmic count is the
+        # CONVENTIONAL masked-einsum implementation, so mfu_algorithmic
+        # stays comparable round-over-round while mfu (executed) shows the
+        # carved-triangle saving
         cfg_algo = load_config(f"configs/{name}.json", **_COMMON,
                                **WORKLOADS[name],
                                reversible_remat_blocks=False,
                                fused_mixer_block=False,
-                               fused_group_linear=False)
+                               fused_group_linear=False,
+                               blocked_causal_map=0)
         # params/opt-state/axes are identical either way: adopt them from
         # the measured trainer instead of re-initializing on device
         tr_algo = Trainer(cfg_algo)
@@ -206,18 +220,42 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
 
 def numerics_guard(n_steps: int = 300) -> dict:
     """Real-corpus trajectory check, driver-visible (VERDICT r4 item 9):
-    run ``configs/32ctx_real_1chip.json`` (committed 84M-token corpus,
-    fixed data_seed) through the full CLI train path for ``n_steps`` and
-    assert the warmup trajectory of the round-4 record."""
+    run the first ``n_steps`` of the 10k acceptance setup
+    (``configs/32ctx_accept_10k.json``, committed 84M-token corpus, fixed
+    data_seed) through the full CLI train path and assert the warmup
+    trajectory of the committed 10k-run record (the STABLE Run-B
+    hyperparameters — see the module docstring for why not the LR-0.01
+    ``32ctx_real_1chip`` point)."""
     import argparse
+    import glob
+    import subprocess
+    import sys
     import tempfile
 
     from homebrewnlp_tpu import main as cli
     from homebrewnlp_tpu.utils import load_config
 
     with tempfile.TemporaryDirectory(prefix="bench_guard_") as tmp:
-        cfg = load_config("configs/32ctx_real_1chip.json",
+        cfg = load_config("configs/32ctx_accept_10k.json",
                           model_path=tmp, use_checkpointing=False)
+        # the guard is only meaningful on the REAL corpus: the train CLI's
+        # synthetic fallback flatlines at the uniform-byte floor (~5.55) and
+        # looks like a numerics failure (round-5 post-mortem: the corpus was
+        # believed committed but was not, and the guard silently trained on
+        # noise).  Rebuild deterministically when absent; refuse to run
+        # synthetic.
+        pattern = cfg.dataset_configs[0]["path"]
+        if not glob.glob(pattern):
+            try:
+                subprocess.run([sys.executable, "tools/build_corpus.py",
+                                "--out-dir", "datasets"], check=True)
+            except (subprocess.CalledProcessError, OSError) as e:
+                return {"pass": False,
+                        "error": f"corpus rebuild failed: {e}"[:300]}
+        if not glob.glob(pattern):
+            return {"pass": False,
+                    "error": f"no real corpus at {pattern}; refusing the "
+                             "synthetic fallback"}
         args = argparse.Namespace(steps=n_steps, profile="", workers=None)
         t0 = time.perf_counter()
         cli.train(cfg, args)
@@ -228,14 +266,15 @@ def numerics_guard(n_steps: int = 300) -> dict:
                 rows.append(json.loads(line))
     result = evaluate_guard(rows, n_steps)
     result["wall_s"] = round(wall, 1)
-    result["config"] = "configs/32ctx_real_1chip.json"
+    result["config"] = "configs/32ctx_accept_10k.json"
     return result
 
 
 def evaluate_guard(rows, n_steps: int) -> dict:
     """Pure threshold evaluation over metrics rows (separated so the logic
-    is unit-testable without a chip).  Thresholds follow the round-4 record
-    (7.77 -> 4.10@120 -> 3.56@300); shorter development runs
+    is unit-testable without a chip).  Thresholds follow the committed
+    10k-run record (7.71 -> 3.45@100 -> 2.76-class@300 with margin,
+    docs/perf/32ctx_10k_run.md); shorter development runs
     (HBNLP_BENCH_GUARD_STEPS < 120/300) only assert the checkpoints they
     actually reach, plus strict decrease."""
     by_step = {r["step"]: r["loss"] for r in rows}
@@ -246,9 +285,9 @@ def evaluate_guard(rows, n_steps: int) -> dict:
     loss_120 = by_step[at_120]
     ok = (first > 6.5 and final == final and final < first)
     if n_steps >= 120:
-        ok = ok and loss_120 < 5.0
+        ok = ok and loss_120 < 4.5
     if n_steps >= 300:
-        ok = ok and final < 4.6
+        ok = ok and final < 3.6
     return {"pass": bool(ok), "steps": rows[-1]["step"],
             "loss_first": round(first, 4),
             "loss_step120": round(loss_120, 4),

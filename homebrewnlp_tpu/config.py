@@ -163,6 +163,11 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # bytes lever for the group workload, ops/pallas_group.py).  Same
     # single-device guard as fused_mixer_block.
     fused_group_linear=False,
+    # recursion depth for the blocked causal map decomposition
+    # (models/layers.py::_blocked_map_rows): 0 = plain masked einsum; >0
+    # carves the triangle into dense sub-blocks so XLA skips the masked
+    # FLOPs — the measured lever for the compute-bound long-context shape
+    blocked_causal_map=0,
     debug_train_step=False,
     debug_gradients=False,
     current_step=0,

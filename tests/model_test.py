@@ -175,6 +175,98 @@ def test_pallas_causal_map_attention_parity():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_pallas_tri_map_attention_parity():
+    """Interpret-mode parity of the (measured-and-rejected) large-S
+    triangular map-attention kernels — fwd AND both backward kernels —
+    against the masked einsum (docs/perf/README.md round 5c)."""
+    import numpy as np
+
+    from homebrewnlp_tpu.ops.pallas_tri_attn import (tri_map_attention,
+                                                     tri_reference)
+    k1, k2 = jax.random.split(jax.random.key(0))
+    # S=512 -> 2 row tiles (the fori + diagonal paths both execute);
+    # K=256 -> the key axis splits into 2 half-panels
+    bias = jax.random.normal(k1, (2, 512, 512), jnp.float32) * 0.02
+    val = jax.random.normal(k2, (2, 512, 2, 256), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        a = np.asarray(tri_reference(bias, val))
+        b = np.asarray(tri_map_attention(bias, val, True))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+        gr = jax.grad(lambda t: jnp.sum(tri_reference(*t) ** 2))((bias, val))
+        gf = jax.grad(
+            lambda t: jnp.sum(tri_map_attention(*t, True) ** 2))((bias, val))
+    for name, x, y in zip(("dbias", "dval"), gr, gf):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_blocked_causal_map_matches_masked_einsum():
+    """models/layers.py::_blocked_map_rows: the block decomposition of the
+    causal triangle must reproduce the masked einsum inside the REAL model
+    (identical params — the embed scope walk is unchanged) and at the
+    helper level for every depth, including depths past the 256-row leaf
+    cutoff."""
+    import numpy as np
+
+    from homebrewnlp_tpu.models.layers import _blocked_map_rows
+    k1, k2 = jax.random.split(jax.random.key(1))
+    bias = jax.random.normal(k1, (2, 512, 512), jnp.float32) * 0.02
+    val = jax.random.normal(k2, (2, 512, 2, 64), jnp.float32)
+    row = jax.lax.broadcasted_iota(jnp.int32, (512, 512), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (512, 512), 1)
+    ref = jnp.einsum("hst,bthk->bshk", bias * (row >= col), val,
+                     preferred_element_type=jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        for depth in (0, 1, 2, 5):
+            out = _blocked_map_rows(bias, val, depth)
+            np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                       rtol=1e-5, atol=1e-5,
+                                       err_msg=f"depth {depth}")
+
+    # model level: same params, same loss/grads
+    dt = dict(calculation_dtype="float32", storage_dtype="float32",
+              slice_dtype="float32", optimizer_slice_dtype="float32")
+    shape = dict(sequence_length=512, features_per_head=64, heads=2,
+                 depth=2, train_batch_size=2,
+                 memory_reduction_strategy="none")
+    cfg0 = mixer_config(**shape, **dt)
+    cfg1 = mixer_config(**shape, **dt, blocked_causal_map=3)
+    p0, _, _, l0 = init_and_loss(cfg0)
+    p1, _, _, l1 = init_and_loss(cfg1)
+    assert set(p0) == set(p1)
+    with jax.default_matmul_precision("highest"):
+        a = float(jax.jit(l0)(p0, jax.random.key(0)))
+        b = float(jax.jit(l1)(p0, jax.random.key(0)))
+        assert abs(a - b) < 1e-5 * max(1.0, abs(a)), (a, b)
+        g0 = jax.jit(jax.grad(l0))(p0, jax.random.key(0))
+        g1 = jax.jit(jax.grad(l1))(p0, jax.random.key(0))
+    for k in g0:
+        x = np.asarray(g0[k], np.float32)
+        y = np.asarray(g1[k], np.float32)
+        scale = max(1e-3, float(np.abs(x).max()))
+        assert np.abs(x - y).max() < 1e-4 * scale, (
+            k, float(np.abs(x - y).max()))
+
+
+def test_blocked_causal_map_composes_with_sharding(eight_devices):
+    """blocked_causal_map on a data x model mesh: the decomposition slices
+    only the (unsharded) sequence axis, so GSPMD composition must hold."""
+    import numpy as np
+
+    from homebrewnlp_tpu.parallel import make_mesh
+    from homebrewnlp_tpu.train import Trainer
+    cfg = mixer_config(sequence_length=512, features_per_head=64, heads=2,
+                       depth=2, train_batch_size=8, tpu_size=8,
+                       blocked_causal_map=3)
+    mesh = make_mesh(cfg)
+    assert mesh.size == 8
+    trainer = Trainer(cfg, mesh)
+    batch = text_batch(cfg)
+    state = trainer.init(batch)
+    state, m = trainer.step(state, batch, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_reversible_cotangent_dtype_is_noop_under_bf16():
     import numpy as np
     """Round-4 measured finding pinned as a test: under bf16 calculation
