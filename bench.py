@@ -218,6 +218,33 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
     return row
 
 
+def ensure_real_corpus(pattern: str, builder=None):
+    """None when files matching ``pattern`` exist (rebuilding them
+    deterministically if needed), else a structured guard-failure dict —
+    the trajectory guard REFUSES the train CLI's silent synthetic fallback
+    (round-5 post-mortem, docs/perf/README.md).  ``builder`` is injectable
+    for tests; the default shells out to tools/build_corpus.py."""
+    import glob
+    import subprocess
+    import sys
+
+    def default_builder():
+        subprocess.run([sys.executable, "tools/build_corpus.py",
+                        "--out-dir", "datasets"], check=True)
+
+    if not glob.glob(pattern):
+        try:
+            (builder or default_builder)()
+        except Exception as e:  # noqa: BLE001 - report, don't crash the line
+            return {"pass": False,
+                    "error": f"corpus rebuild failed: {e}"[:300]}
+    if not glob.glob(pattern):
+        return {"pass": False,
+                "error": f"no real corpus at {pattern}; refusing the "
+                         "synthetic fallback"}
+    return None
+
+
 def numerics_guard(n_steps: int = 300) -> dict:
     """Real-corpus trajectory check, driver-visible (VERDICT r4 item 9):
     run the first ``n_steps`` of the 10k acceptance setup
@@ -227,9 +254,6 @@ def numerics_guard(n_steps: int = 300) -> dict:
     hyperparameters — see the module docstring for why not the LR-0.01
     ``32ctx_real_1chip`` point)."""
     import argparse
-    import glob
-    import subprocess
-    import sys
     import tempfile
 
     from homebrewnlp_tpu import main as cli
@@ -238,24 +262,9 @@ def numerics_guard(n_steps: int = 300) -> dict:
     with tempfile.TemporaryDirectory(prefix="bench_guard_") as tmp:
         cfg = load_config("configs/32ctx_accept_10k.json",
                           model_path=tmp, use_checkpointing=False)
-        # the guard is only meaningful on the REAL corpus: the train CLI's
-        # synthetic fallback flatlines at the uniform-byte floor (~5.55) and
-        # looks like a numerics failure (round-5 post-mortem: the corpus was
-        # believed committed but was not, and the guard silently trained on
-        # noise).  Rebuild deterministically when absent; refuse to run
-        # synthetic.
-        pattern = cfg.dataset_configs[0]["path"]
-        if not glob.glob(pattern):
-            try:
-                subprocess.run([sys.executable, "tools/build_corpus.py",
-                                "--out-dir", "datasets"], check=True)
-            except (subprocess.CalledProcessError, OSError) as e:
-                return {"pass": False,
-                        "error": f"corpus rebuild failed: {e}"[:300]}
-        if not glob.glob(pattern):
-            return {"pass": False,
-                    "error": f"no real corpus at {pattern}; refusing the "
-                             "synthetic fallback"}
+        err = ensure_real_corpus(cfg.dataset_configs[0]["path"])
+        if err is not None:
+            return err
         args = argparse.Namespace(steps=n_steps, profile="", workers=None)
         t0 = time.perf_counter()
         cli.train(cfg, args)

@@ -425,6 +425,38 @@ def test_bench_guard_threshold_logic():
                               300)["pass"]
 
 
+def test_bench_guard_refuses_synthetic_fallback(tmp_path):
+    """bench.ensure_real_corpus: missing corpus triggers the injectable
+    builder; a builder that fails (or produces nothing) yields a structured
+    refusal instead of letting the guard train on synthetic noise (the
+    round-5 post-mortem, docs/perf/README.md round 5d)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import ensure_real_corpus
+
+    pattern = str(tmp_path / "corpus" / "*.tfrecord")
+    # builder that fails outright -> structured error, no exception
+    res = ensure_real_corpus(pattern,
+                             builder=lambda: (_ for _ in ()).throw(
+                                 RuntimeError("roots missing")))
+    assert res is not None and not res["pass"] and "rebuild failed" in res["error"]
+    # builder that "succeeds" but produces nothing -> refusal
+    res = ensure_real_corpus(pattern, builder=lambda: None)
+    assert res is not None and not res["pass"] and "synthetic" in res["error"]
+    # builder that creates the files -> None (guard proceeds on real data)
+    def build():
+        os.makedirs(tmp_path / "corpus", exist_ok=True)
+        (tmp_path / "corpus" / "a.tfrecord").write_bytes(b"x")
+    res = ensure_real_corpus(pattern, builder=build)
+    assert res is None
+    # files already present -> builder not invoked
+    res = ensure_real_corpus(pattern, builder=lambda: (_ for _ in ()).throw(
+        AssertionError("must not be called")))
+    assert res is None
+
+
 def test_repeat_dataset_epoch_wraparound(tmp_path):
     """repeat_dataset=true: the sequential reader wraps deterministically at
     the epoch boundary (same window order every epoch), and the resume
