@@ -53,7 +53,6 @@ ops/pallas_mixer.py).
 from __future__ import annotations
 
 import functools
-import typing
 
 import jax
 import jax.numpy as jnp
