@@ -301,9 +301,15 @@ class Config:
             raise ValueError(
                 f"sampling_top_p must be in (0, 1]; got {self.sampling_top_p}")
         # GPipe pipeline parallelism (ops/pipeline.py): stages must cut the
-        # depth loop evenly, compose with none/checkpoint rematerialization
-        # only (reversible chains carry custom_vjp state across stages), and
-        # excludes the sequence-parallel ring (nested shard_map regions).
+        # depth loop evenly and compose with none/checkpoint rematerialization
+        # only (reversible chains carry custom_vjp state across stages).
+        # The sequence-parallel ring COMPOSES since round 5 — it nests a
+        # seq-manual shard_map inside the pipe-manual region (ops/ring.py) —
+        # but only under the 1f1b schedule: its per-tick jax.vjp runs the
+        # ring's backward immediately, whereas jax.grad THROUGH the gpipe
+        # scan delays it, and delayed partial evaluation hoists the ring
+        # backward's seq-manual internals across the scan boundary where the
+        # partitioner cannot express them (sdy rejects the factor order).
         if self.pipeline_parallel < 1:
             raise ValueError("pipeline_parallel must be a positive integer")
         if self.pipeline_schedule not in ("gpipe", "1f1b"):
@@ -322,10 +328,12 @@ class Config:
                 raise ValueError(
                     "pipeline_parallel requires memory_reduction_strategy "
                     "'none' or 'checkpoint'")
-            if self.sequence_parallel > 1:
+            if self.sequence_parallel > 1 and self.pipeline_schedule != "1f1b":
                 raise ValueError(
-                    "pipeline_parallel and sequence_parallel cannot combine "
-                    "(nested shard_map regions are not supported)")
+                    "sequence_parallel with pipeline_parallel requires "
+                    "pipeline_schedule='1f1b' (gradients through the gpipe "
+                    "scan cannot express the nested ring attention's "
+                    "backward — see the validation comment above)")
             if self.use_video:
                 raise ValueError(
                     "pipeline_parallel supports text (gpt) models only: the "

@@ -269,7 +269,8 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
         for spec in (blk["layer"] if isinstance(blk, dict) else blk.layer))
     stage_fn, stacked, n_stages = _pipeline_machinery(
         cfg, ctx.params, src.names, ctx.rng, ctx.train, ctx.seed,
-        seq, attn_starts, mode_scope=ctx._scope[0], with_aux=needs_aux)
+        seq, attn_starts, mode_scope=ctx._scope[0], with_aux=needs_aux,
+        mesh=ctx.mesh)
     # match the training schedule's micro partition: for 1F1B configs the
     # balance loss and capacity-dropped tokens of routed-MoE layers depend on
     # M, so eval/build() must pick the same M the 1F1B training path picks
@@ -288,7 +289,8 @@ def _pipelined_body(ctx: Ctx, src: NT, seq, attn_starts, acc) -> NT:
 
 
 def _pipeline_machinery(cfg: Config, params, names, rng, train, seed,
-                        seq, attn_starts, mode_scope, with_aux=False):
+                        seq, attn_starts, mode_scope, with_aux=False,
+                        mesh=None):
     """(stage_fn, stacked slot list, n_stages) shared by the GPipe forward
     body and the 1F1B loss-and-grad path.  ``stage_fn(slot_params, idx, x)``
     runs one stage's block groups on one microbatch; ``stacked`` is the
@@ -328,8 +330,11 @@ def _pipeline_machinery(cfg: Config, params, names, rng, train, seed,
             if rng is not None:
                 key = jax.random.fold_in(
                     jax.random.fold_in(rng, 2000 + j), stage_idx)
+            # mesh=None: constraint() cannot fire inside the manual pipe
+            # region; outer_mesh carries the real axis sizes for the
+            # eligibility checks and the nested ring-attention path
             bctx = Ctx(cfg, params=subparams, train=train, seed=seed,
-                       rng=key, mesh=None)
+                       rng=key, mesh=None, outer_mesh=mesh)
             bctx._scope = [mode_scope, "body"]
             bctx.attention_idx = attn_starts[j]
             with bctx.scope(_block_scope(i0, c0)):
@@ -446,7 +451,7 @@ def pipelined_loss_and_grads(cfg: Config, params, batch, rng, mesh,
     # any seed-dependent apply-time behavior matches the eval walk)
     stage_fn, stacked, n_stages = _pipeline_machinery(
         cfg, params, names, rng, True, seed, seq, attn_starts,
-        mode_scope=cfg.model_mode, with_aux=True)
+        mode_scope=cfg.model_mode, with_aux=True, mesh=mesh)
     n_micro = _pipeline_n_micro(src_nt.x.shape[0], n_stages, "1f1b")
 
     batch_keys = sorted(batch.keys())

@@ -53,10 +53,17 @@ class Ctx:
     def __init__(self, cfg: Config, params: typing.Optional[dict] = None,
                  seed: int = 0, train: bool = True,
                  rng: typing.Optional[jax.Array] = None, mesh=None,
-                 decode: typing.Optional[DecodeState] = None):
+                 decode: typing.Optional[DecodeState] = None,
+                 outer_mesh=None):
         self.cfg = cfg
         self.params = params  # None => init (collect) mode
         self.mesh = mesh  # device mesh for shard_map islands (ring attention)
+        # the concrete mesh when building INSIDE a manual shard_map region
+        # (pipeline stage bodies): ``mesh`` must stay None there — a
+        # with_sharding_constraint over the concrete mesh cannot apply inside
+        # the region — but eligibility checks (ring/fused-kernel/blocked-map)
+        # and the nested ring-attention path still need the real axis sizes
+        self.outer_mesh = outer_mesh
         self.decode = decode  # KV-cache incremental decode state
         self.collected: typing.Dict[str, jnp.ndarray] = {}
         self.axis_names: typing.Dict[str, typing.Tuple[str, ...]] = {}
@@ -73,6 +80,14 @@ class Ctx:
         # only propagated out of non-reversible bodies — see _body
         self.aux_losses: typing.List[jnp.ndarray] = []
         self.param_count = 0
+
+    @property
+    def effective_mesh(self):
+        """The mesh for eligibility decisions (ring/fused-kernel/blocked-map)
+        regardless of where the build is running: ``mesh`` at top level,
+        ``outer_mesh`` inside a pipeline stage.  Consumers that APPLY
+        constraints must keep using ``mesh`` (None inside manual regions)."""
+        return self.mesh if self.mesh is not None else self.outer_mesh
 
     # -- scoping ------------------------------------------------------------
     def scope(self, name: str) -> "_Scope":

@@ -385,9 +385,10 @@ def _ring_eligible(args: Args, dim: str) -> bool:
     """Sequence-parallel ring attention replaces the plain dot-product
     softmax path when the mesh has a sequence axis; the learned-bias-map
     variants keep the GSPMD path (their seq x seq parameters are row-sharded
-    instead)."""
+    instead).  Inside a pipeline stage (ctx.mesh is None there) the real
+    mesh arrives via ctx.outer_mesh and the ring nests (ops/ring.py)."""
     from ..parallel.mesh import SEQ_AXIS
-    mesh = args.ctx.mesh
+    mesh = args.ctx.effective_mesh
     return (mesh is not None
             and args.ctx.params is not None
             and mesh.shape.get(SEQ_AXIS, 1) > 1
@@ -526,7 +527,8 @@ def _ring_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
     from ..parallel.sharding import spec_for
     t = args.tensor
     order = (t.names[0], dim, HEADS, KEY)
-    mesh = args.ctx.mesh
+    ctx = args.ctx
+    mesh = ctx.effective_mesh
     spec = spec_for(order, mesh)
     out = ring_attention(qry.transpose_to(order).x, key.transpose_to(order).x,
                          val.transpose_to(order).x, mesh, SEQ_AXIS, spec,
@@ -579,13 +581,13 @@ def _blocked_map_eligible(args: Args, dim: str) -> bool:
     from ..parallel.mesh import SEQ_AXIS
     ctx = args.ctx
     t = args.tensor
+    mesh = ctx.effective_mesh
     return (args.cfg.blocked_causal_map > 0
             and is_masked(args)
             and ctx.decode is None
             and dim == SEQUENCE
             and t.names[1:] == (SEQUENCE, HEADS, KEY)
-            and (ctx.mesh is None
-                 or ctx.mesh.shape.get(SEQ_AXIS, 1) == 1))
+            and (mesh is None or mesh.shape.get(SEQ_AXIS, 1) == 1))
 
 
 def attention(args: Args) -> NT:
@@ -730,10 +732,11 @@ def fused_mixer_eligible(ctx, conf, x: NT) -> bool:
     plain rank-4 text layout with the sequence axis causally masked."""
     cfg = ctx.cfg
     layer = conf.layer if isinstance(conf.layer, (list, tuple)) else None
+    mesh = ctx.effective_mesh
     return (cfg.fused_mixer_block
             and layer is not None and tuple(layer) == MIXER_FUSED_PATTERN
             and ctx.params is not None and ctx.decode is None
-            and (ctx.mesh is None or ctx.mesh.size == 1)
+            and (mesh is None or mesh.size == 1)
             and x.names[1:] == (SEQUENCE, HEADS, KEY)
             and 0 in cfg.masked_attention_dimensions
             and x.dim_size(SEQUENCE) % 128 == 0
@@ -809,10 +812,11 @@ def fused_group_eligible(ctx, conf, x: NT) -> bool:
     mid = cfg.features_per_head * cfg.group_linear_factor
     n_rows = (x.dim_size(x.names[0]) * x.dim_size(SEQUENCE)
               if SEQUENCE in x.names else 0)
+    mesh = ctx.effective_mesh
     return (cfg.fused_group_linear
             and layer is not None and tuple(layer) == GROUP_FUSED_PATTERN
             and ctx.params is not None and ctx.decode is None
-            and (ctx.mesh is None or ctx.mesh.size == 1)
+            and (mesh is None or mesh.size == 1)
             and x.names[1:] == (SEQUENCE, HEADS, KEY)
             and x.dim_size(KEY) % 128 == 0
             and mid % 128 == 0

@@ -13,7 +13,10 @@ sequential composition in tests.
 Mechanics (jax >= 0.8 shard_map typing):
 - ``shard_map`` is manual over ONLY the pipe axis (``axis_names``); data /
   model / sequence axes stay automatic, so GSPMD keeps handling batch and
-  head sharding inside each stage.
+  head sharding inside each stage.  A stage body may open a NESTED manual
+  region over an axis that is still automatic here — the sequence-parallel
+  ring attention does exactly that (ops/ring.py), which is how seq and pipe
+  parallelism compose.
 - the scan carry is ``pvary``-ed over the pipe axis up front so its
   varying-manual-axes type is loop-invariant.
 - the output keeps the pipe axis SHARDED (each stage returns its slice;

@@ -10,10 +10,37 @@ the visiting K/V block and folds the result into running (max, denominator,
 accumulator) statistics, so the full softmax is exact while no device ever
 holds more than one (s_local x s_local) logit block.
 
-Used from models/layers.attention through ``shard_map`` when the mesh's
-sequence axis is >1 and the layer is plain dot-product attention; the
-bias-map mixer variants keep the GSPMD path (their learned seq x seq maps are
-row-sharded parameters instead).
+Differentiation is a ``jax.custom_vjp`` with an explicit flash-style ring
+backward rather than autodiff through the forward ring: the backward saves
+only the per-row softmax stats (m, l — O(b*h*s), never an [s, s] block) and
+recomputes each probability block from the visiting K/V as the gradient
+accumulators ride one full lap around the ring (dk/dv travel WITH their
+blocks and arrive home after n hops).  Besides the memory profile, the
+explicit vjp is what lets the ring NEST inside the pipeline's manual region:
+autodiff through a nested shard_map forwards region-internal residuals into
+the transposed region, which the shardy partitioner cannot express when
+those residuals are also varying over the outer (pipe) axis — with
+custom_vjp, only explicit arguments with explicit specs ever cross a region
+boundary.
+
+Used from models/layers.attention when the mesh's sequence axis is >1 and
+the layer is plain dot-product attention; the bias-map mixer variants keep
+the GSPMD path (their learned seq x seq maps are row-sharded parameters
+instead).
+
+Composition with pipeline parallelism: when the caller already sits inside a
+manual ``shard_map`` region (the pipeline stage body, ops/pipeline.py —
+manual over ONLY the pipe axis), ``ring_attention`` opens a NESTED region
+over the context mesh that manualizes just the sequence axis; data/model
+axes stay automatic in both regions.  Three lowering constraints shape the
+code: the inner region's specs may only name its own (seq) axis;
+``jax.lax.axis_index`` cannot lower inside a nested manual region under the
+shardy partitioner, so the kernel takes its ring position as a seq-sharded
+iota argument; and the nested region keeps vma typing ON — with
+``check_vma=False`` its output would drop the varying-over-pipe type and the
+enclosing region's transpose would insert a hidden psum over the pipe axis,
+silently summing every stage's cotangent into each (measured: body grads off
+by O(1) relative while the forward stayed exact).
 """
 from __future__ import annotations
 
@@ -22,8 +49,24 @@ import typing
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec
 
 NEG_INF = -2e38  # the reference's mask value (spatial.py:68)
+
+
+def _match_vma(x: jnp.ndarray, target: frozenset) -> jnp.ndarray:
+    """pvary ``x`` over whatever axes of ``target`` it is not yet varying
+    over (idempotent — pcast rejects no-ops).  Under ``check_vma=False``
+    every vma set is empty and this is a no-op; under the typed nested
+    region the loop carries below must enter with their steady-state vma."""
+    have = getattr(jax.typeof(x), "vma", frozenset())
+    missing = tuple(a for a in target if a not in have)
+    return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+
+def _input_vma(*tensors) -> frozenset:
+    return frozenset().union(*(getattr(jax.typeof(t), "vma", frozenset())
+                               for t in tensors))
 
 
 def _block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -51,20 +94,28 @@ def _block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def ring_attention_kernel(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                          axis_name: str, causal: bool = True) -> jnp.ndarray:
-    """Per-shard body (run under shard_map): exact attention over the ring.
-
-    All inputs are local blocks [b, s_local, h, d] of the sequence-sharded
-    global arrays; returns the local output block."""
-    n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
+                          idx_arr: jnp.ndarray, axis_name: str,
+                          n_shards: int, causal: bool = True):
+    """Per-shard forward (run under shard_map): exact attention over the
+    ring.  All inputs are local blocks [b, s_local, h, d] of the
+    sequence-sharded global arrays; returns ``(out, m, l)`` — the local
+    output block plus the f32 row stats [b, h, s_local] the backward needs.
+    ``idx_arr`` is this shard's slice of a seq-sharded ``arange(n_shards)``
+    — its one element is the shard's ring position (``jax.lax.axis_index``
+    cannot lower inside a nested manual region, so the position arrives as
+    data)."""
+    n = n_shards
+    idx = idx_arr[0]
     s_local = q.shape[1]
     row0 = idx * s_local
 
-    m = jnp.full(q.shape[:1] + (q.shape[2], s_local), NEG_INF,
-                 jnp.float32)  # [b, h, sq]
-    l = jnp.zeros_like(m)
-    acc = jnp.zeros(q.shape, jnp.float32)
+    vma = _input_vma(q, k, v, idx_arr)
+    m = _match_vma(jnp.full(q.shape[:1] + (q.shape[2], s_local), NEG_INF,
+                            jnp.float32), vma)  # [b, h, sq]
+    l = _match_vma(jnp.zeros(m.shape, jnp.float32), vma)
+    acc = _match_vma(jnp.zeros(q.shape, jnp.float32), vma)
+    k = _match_vma(k, vma)
+    v = _match_vma(v, vma)
     qf = q.astype(jnp.float32)
 
     def fold(kv, vv, col_shard, m, l, acc):
@@ -88,14 +139,175 @@ def ring_attention_kernel(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     _, _, m, l, acc = jax.lax.fori_loop(1, n, hop, (k, v, m, l, acc))
     out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    return out.astype(q.dtype), m, l
 
 
-def ring_attention(q, k, v, mesh, seq_axis: str, spec, causal: bool = True):
+def ring_attention_bwd_kernel(q, k, v, idx_arr, out, m, l, dout,
+                              axis_name: str, n_shards: int,
+                              causal: bool = True):
+    """Per-shard backward: flash-style recompute over one full ring lap.
+
+    Each probability block is rebuilt from the saved row stats (m, l) as the
+    K/V blocks revisit; dk/dv accumulators travel WITH their blocks, so
+    after ``n_shards`` process-and-rotate steps every block's gradient has
+    collected its contribution from every query shard and sits back on its
+    home device.  Identity: with normalized p = exp(z - m)/l,
+    ``ds = p * (dp - rowsum(dout * out))`` — the softmax normalizer's
+    derivative is already inside (standard flash attention backward)."""
+    n = n_shards
+    idx = idx_arr[0]
+    s_local = q.shape[1]
+    row0 = idx * s_local
+    f32 = jnp.float32
+    qf = q.astype(f32)
+    doutf = dout.astype(f32)
+    inv_l = 1.0 / jnp.maximum(l, 1e-30)  # [b, h, sq], matches the fwd clamp
+    D = jnp.einsum("bshd,bshd->bhs", doutf, out.astype(f32))  # [b, h, sq]
+
+    vma = _input_vma(q, k, v, idx_arr, out, m, l, dout)
+    dq = _match_vma(jnp.zeros(q.shape, f32), vma)
+    kc = _match_vma(k, vma)
+    vc = _match_vma(v, vma)
+    dkc = _match_vma(jnp.zeros(k.shape, f32), vma)
+    dvc = _match_vma(jnp.zeros(v.shape, f32), vma)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def fold(kc, vc, dkc, dvc, dq, i):
+        """Accumulate the local queries' contribution to the visiting block
+        (idx - i) and to dq."""
+        kf = kc.astype(f32)
+        vf = vc.astype(f32)
+        col0 = jnp.mod(idx - i, n) * s_local
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)
+        if causal:
+            rows = row0 + jnp.arange(s_local)
+            cols = col0 + jnp.arange(s_local)
+            mask = rows[:, None] >= cols[None, :]
+            logits = jnp.where(mask[None, None], logits, NEG_INF)
+        p = jnp.exp(logits - m[..., None]) * inv_l[..., None]
+        dvc = dvc + jnp.einsum("bhqk,bqhd->bkhd", p, doutf)
+        dp = jnp.einsum("bqhd,bkhd->bhqk", doutf, vf)
+        ds = p * (dp - D[..., None])
+        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+        dkc = dkc + jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+        return dkc, dvc, dq
+
+    # mirror the forward's hop structure: fold the own block first, then
+    # rotate-and-fold n-1 times, so kc/vc ride exactly n-1 ppermute pairs
+    # (a process-then-rotate loop would send one dead K/V rotation per
+    # call — XLA cannot DCE collectives out of the loop body); dkc/dvc
+    # take one extra hop after the loop to land back on their home shard
+    dkc, dvc, dq = fold(kc, vc, dkc, dvc, dq, 0)
+
+    def step(i, carry):
+        kc, vc, dkc, dvc, dq = carry
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        dkc = jax.lax.ppermute(dkc, axis_name, perm)
+        dvc = jax.lax.ppermute(dvc, axis_name, perm)
+        dkc, dvc, dq = fold(kc, vc, dkc, dvc, dq, i)
+        return kc, vc, dkc, dvc, dq
+
+    _, _, dkc, dvc, dq = jax.lax.fori_loop(
+        1, n, step, (kc, vc, dkc, dvc, dq))
+    dkc = jax.lax.ppermute(dkc, axis_name, perm)
+    dvc = jax.lax.ppermute(dvc, axis_name, perm)
+    return dq.astype(q.dtype), dkc.astype(k.dtype), dvc.astype(v.dtype)
+
+
+def _seq_only(spec: PartitionSpec, seq_axis: str) -> PartitionSpec:
+    """The spec as seen by a NESTED region that manualizes only the seq
+    axis: every other entry must be None (specs may only name axes the
+    region itself manualizes; data/model sharding stays automatic)."""
+    return PartitionSpec(*[p if p == seq_axis else None for p in spec])
+
+
+def _run(kernel, args, mesh, seq_axis: str, in_specs, out_specs):
+    """Dispatch one ring kernel as a top-level (all-manual, untyped) or
+    nested (seq-manual, vma-typed) shard_map region."""
+    manual = getattr(jax.sharding.get_abstract_mesh(), "manual_axes", ())
+    if manual:
+        assert seq_axis not in manual, (
+            f"ring_attention cannot nest inside a region already manual "
+            f"over {seq_axis!r}")
+        in_s = tuple(_seq_only(s, seq_axis) for s in in_specs)
+        out_s = tuple(_seq_only(s, seq_axis) for s in out_specs)
+        return jax.shard_map(kernel, in_specs=in_s, out_specs=out_s,
+                             axis_names=frozenset({seq_axis}))(*args)
+    return jax.shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(*args)
+
+
+def _specs(spec: PartitionSpec):
+    """(tensor spec, row-stats spec): stats are [b, h, sq] from a
+    [b, s, h, d] tensor spec."""
+    e = list(spec) + [None] * (4 - len(list(spec)))
+    return spec, PartitionSpec(e[0], e[2], e[1])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _ring_attention(mesh, seq_axis, spec, causal, q, k, v):
+    out, _, _ = _ring_fwd(mesh, seq_axis, spec, causal, q, k, v)
+    return out
+
+
+def _ring_fwd(mesh, seq_axis, spec, causal, q, k, v):
+    n = mesh.shape[seq_axis]
+    kernel = functools.partial(ring_attention_kernel, axis_name=seq_axis,
+                               n_shards=n, causal=causal)
+    idxs = jnp.arange(n, dtype=jnp.int32)
+    tspec, sspec = _specs(spec)
+    idx_spec = PartitionSpec(seq_axis)
+    return _run(kernel, (q, k, v, idxs), mesh, seq_axis,
+                (tspec, tspec, tspec, idx_spec), (tspec, sspec, sspec))
+
+
+def _ring_attention_vjp_fwd(mesh, seq_axis, spec, causal, q, k, v):
+    out, m, l = _ring_fwd(mesh, seq_axis, spec, causal, q, k, v)
+    return out, (q, k, v, out, m, l)
+
+
+def _ring_attention_vjp_bwd(mesh, seq_axis, spec, causal, res, dout):
+    q, k, v, out, m, l = res
+    n = mesh.shape[seq_axis]
+    kernel = functools.partial(ring_attention_bwd_kernel, axis_name=seq_axis,
+                               n_shards=n, causal=causal)
+    # Partial-eval barrier (load-bearing): when this vjp is staged out under
+    # delayed partial evaluation (jax.grad around the enclosing jit /
+    # shard_map), every cotangent-independent subcomputation of the backward
+    # is "known" and gets hoisted into the FORWARD pass as residuals — and
+    # since the whole flash recompute (masks, logits, probabilities) depends
+    # only on saved residuals, all of it qualifies.  That defeats the
+    # recompute's O(b*h*s) memory profile outright, and inside the pipeline
+    # the hoisted seq-manual values cannot be expressed by the partitioner
+    # at all when they also vary over the pipe axis (sdy rejects the factor
+    # order; this is why seq x pipe additionally requires the 1f1b schedule,
+    # whose per-tick jax.vjp never delays the backward — config.py).  A
+    # zero-valued data dependency on the cotangent makes every kernel input
+    # "unknown", pinning the entire kernel to the backward pass; XLA folds
+    # the zero after partitioning, so the runtime cost is nil.
+    zero = dout.ravel()[0] * 0
+    izero = zero.astype(jnp.int32)
+    q, k, v, out, m, l = (t + zero.astype(t.dtype)
+                          for t in (q, k, v, out, m, l))
+    idxs = jnp.arange(n, dtype=jnp.int32) + izero
+    tspec, sspec = _specs(spec)
+    idx_spec = PartitionSpec(seq_axis)
+    return _run(kernel, (q, k, v, idxs, out, m, l, dout), mesh, seq_axis,
+                (tspec, tspec, tspec, idx_spec, tspec, sspec, sspec, tspec),
+                (tspec, tspec, tspec))
+
+
+_ring_attention.defvjp(_ring_attention_vjp_fwd, _ring_attention_vjp_bwd)
+
+
+def ring_attention(q, k, v, mesh, seq_axis: str, spec,
+                   causal: bool = True) -> jnp.ndarray:
     """shard_map wrapper: q/k/v are global [b, s, h, d] arrays inside jit;
     ``spec`` is their full PartitionSpec (batch/seq/heads dims per the
-    caller's sharding rules — heads stay model-sharded inside the kernel)."""
-    kernel = functools.partial(ring_attention_kernel, axis_name=seq_axis,
-                               causal=causal)
-    return jax.shard_map(kernel, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    caller's sharding rules — heads stay model-sharded inside the kernel).
+
+    Inside an enclosing manual region (the pipeline stage body), the call
+    nests over the context mesh manualizing only ``seq_axis`` — see the
+    module docstring for the constraints that shape this."""
+    return _ring_attention(mesh, seq_axis, spec, causal, q, k, v)
