@@ -98,6 +98,13 @@ def test_four_process_pipeline_and_checkpoint(tmp_path):
     for r in range(4):
         assert "MULTIPROC_OK" in outs[r], outs[r][-2000:]
         assert f"ckpt restored step=5 cursor={1000 + r}" in outs[r]
+    # every rank observed the SAME global loss sequence for each schedule
+    # case — incl. seq x pipe composed across process boundaries
+    for case in ("dp_pp", "dp_pp_1f1b", "sp_pp_1f1b"):
+        lines = [[l for l in outs[r].splitlines() if f" {case} " in l][0]
+                 for r in range(4)]
+        payloads = {l.split(": ", 1)[1] for l in lines}
+        assert len(payloads) == 1, (case, lines)
     # every rank observed the SAME global loss sequence for each case
     for case in ("dp_pp", "dp_pp_1f1b", "dp_tp_ckpt"):
         lines = [[l for l in outs[r].splitlines() if f" {case} " in l][0]

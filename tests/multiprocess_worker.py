@@ -92,6 +92,17 @@ else:
     # ...and the 1F1B interleaved schedule over the same cross-process ring
     run_case("dp_pp_1f1b", heads=1, pipeline_parallel=4, depth=4,
              pipeline_schedule="1f1b", memory_reduction_strategy="none")
+    # c) seq x pipe COMPOSED across processes: the nested seq-manual ring
+    #    (ops/ring.py) rotates K/V blocks over one process boundary while
+    #    the pipe ring hops activations over another — both collectives
+    #    ride the gloo "DCN" inside one 1F1B step
+    run_case("sp_pp_1f1b", heads=2, sequence_parallel=2, pipeline_parallel=2,
+             depth=2, sequence_length=32, train_batch_size=16,
+             pipeline_schedule="1f1b", memory_reduction_strategy="none",
+             block_config=[
+                 {"layer": ["norm-shift-scale",
+                            "attention-in:relu-dot_product-embedded-relative"]},
+                 {"layer": ["norm-shift-scale", "feed_forward-in:relu"]}])
     # b) orbax save/restore under jax.distributed with PER-PROCESS data
     #    cursors (each host's reader position differs; the sidecar is
     #    per-process like the reference's per-host DataLog)
