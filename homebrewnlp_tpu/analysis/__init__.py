@@ -1,0 +1,34 @@
+"""graftcheck: static analysis pinning parallelism/dtype/sharding invariants.
+
+The reference framework keeps pod-scale graphs correct through deterministic
+naming and mtf's named-dim algebra; this JAX port re-derives those invariants
+dynamically at trace time, so a bad ``PartitionSpec``, a silent f32->f64
+promotion, or a dropped ``donate_argnums`` historically only surfaced as a
+slow or OOMing TPU run.  This package is the correctness gate that catches
+them BEFORE compilation, on CPU, in seconds:
+
+- :mod:`~homebrewnlp_tpu.analysis.trace` abstractly traces the train / eval /
+  decode steps of a config (``jax.jit(...).trace`` over ShapeDtypeStructs —
+  no FLOPs, no XLA compile) and exposes the jaxprs plus donation metadata.
+- :mod:`~homebrewnlp_tpu.analysis.graph_rules` runs rule passes over those
+  jaxprs: collective census vs golden budgets, dtype-promotion audit,
+  donation audit, sharding-spec validation, constant-bloat check.
+- :mod:`~homebrewnlp_tpu.analysis.ast_rules` lints the source tree for the
+  ``NT`` named-axis discipline: axis literals against the nd registry,
+  ``.x`` escape ratchet, Python-side RNG/time in traced code, and
+  ``PartitionSpec`` literals naming unknown mesh axes.
+
+Entry point: ``python tools/graftcheck.py --all-configs`` (see
+docs/static_analysis.md).
+"""
+from .findings import Finding, Severity, render_report, worst_severity  # noqa: F401
+from .trace import ConfigTraces, trace_config  # noqa: F401
+from .graph_rules import run_graph_rules  # noqa: F401
+from .ast_rules import run_ast_rules  # noqa: F401
+
+GRAPH_RULES = ("collective-census", "dtype-promotion", "donation",
+               "sharding-spec", "constant-bloat")
+# "dtype-promotion" appears in both: the AST pass carries its static twin
+AST_RULES = ("axis-literal", "x-escape", "traced-rng", "partitionspec-axis",
+             "dtype-promotion")
+ALL_RULES = tuple(dict.fromkeys(GRAPH_RULES + AST_RULES))

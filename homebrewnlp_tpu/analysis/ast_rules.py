@@ -1,0 +1,369 @@
+"""AST lint: repo-specific rules enforcing the NT named-axis discipline.
+
+Static source rules (no tracing, no jax beyond the axis registry import):
+
+- ``axis-literal``: a string literal used in an axis position (NT
+  construction, nd reductions/einsum/slicing, rename/transpose, spec_for)
+  must be registered in the nd axis registry (``nd.register_axis``; config.py
+  registers the canonical dimension constants).  Anonymized twins
+  (``_sequence``) validate via their base name.  A typoed axis builds a
+  silently mis-broadcast graph — this catches it at lint time.
+- ``x-escape``: ``.x`` raw-array escapes outside ``ops/`` are a ratchet:
+  per-file counts are pinned in a golden and may only go down.  (The ops/
+  kernels legitimately live on raw arrays; model code should stay in the
+  named algebra.)
+- ``traced-rng``: no Python-side ``random`` / ``np.random`` / ``time`` /
+  ``datetime`` calls inside traced model code (models/ and ops/) — they bake
+  trace-time values into the graph and break determinism across rebuilds.
+- ``partitionspec-axis``: ``PartitionSpec`` literals may only name mesh axes
+  that exist (parallel/mesh.py MESH_AXES); an unknown axis is silently
+  treated as replicated by the sharding machinery.
+
+Suppression: append ``# graftcheck: disable=<rule>`` (or a bare
+``# graftcheck: disable``) to the offending line.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import typing
+
+from .findings import Finding
+
+# rule -> relative directories it applies to (package-relative)
+AXIS_LITERAL_SCOPE = ("homebrewnlp_tpu/models", "homebrewnlp_tpu/ops",
+                      "homebrewnlp_tpu/infer", "homebrewnlp_tpu/data")
+TRACED_RNG_SCOPE = ("homebrewnlp_tpu/models", "homebrewnlp_tpu/ops")
+X_ESCAPE_SCOPE = "homebrewnlp_tpu"
+X_ESCAPE_EXEMPT = ("homebrewnlp_tpu/ops", "homebrewnlp_tpu/nd.py",
+                   "homebrewnlp_tpu/analysis")
+
+#: call-name -> axis argument positions.  Each entry: (positional index
+#: AFTER any self, keyword name, kind) with kind "name" (one string) or
+#: "seq" (tuple/list of strings).  Matching is by the call's terminal name,
+#: so both ``nd.concat(...)`` and ``concat(...)`` resolve.
+AXIS_CALLS: typing.Dict[str, typing.Tuple[typing.Tuple[int, str, str], ...]] = {
+    "NT": ((1, "names", "seq"),),
+    "einsum": ((1, "out_names", "seq"),),
+    "reduce_sum": ((1, "reduced", "seq"), (2, "out_names", "seq")),
+    "reduce_mean": ((1, "reduced", "seq"), (2, "out_names", "seq")),
+    "reduce_max": ((1, "reduced", "seq"), (2, "out_names", "seq")),
+    "reduce_min": ((1, "reduced", "seq"), (2, "out_names", "seq")),
+    "nt_slice": ((1, "axis", "name"),),
+    "concat": ((1, "axis", "name"),),
+    "pad": ((1, "axis", "name"),),
+    "one_hot": ((1, "axis_name", "name"),),
+    "arange": ((0, "name", "name"),),
+    "cumsum": ((1, "axis", "name"),),
+    "full": ((0, "names", "seq"),),
+    "compare_range": ((0, "name0", "name"), (2, "name1", "name")),
+    "rename": ((0, "old", "name"), (1, "new", "name")),
+    "transpose_to": ((0, "names", "seq"),),
+    "expand": ((0, "name", "name"),),
+    "dim_size": ((0, "name", "name"),),
+    "spec_for": ((0, "names", "seq"),),
+}
+
+_RNG_MODULES = {"random", "time", "datetime"}
+
+
+def _known_axes() -> typing.FrozenSet[str]:
+    # import every module that calls nd.register_axis so the registry is
+    # complete regardless of what else this process imported: config.py
+    # (canonical dimension constants) and the layer library (layer-local
+    # scratch axes like "rows")
+    from .. import config  # noqa: F401
+    from .. import nd
+    from ..models import layers  # noqa: F401
+    return nd.known_axes()
+
+
+def _mesh_axes() -> typing.FrozenSet[str]:
+    from ..parallel.mesh import MESH_AXES
+    return frozenset(MESH_AXES)
+
+
+def _suppressed(lines: typing.Sequence[str], lineno: int, rule: str) -> bool:
+    if not 1 <= lineno <= len(lines):
+        return False
+    line = lines[lineno - 1]
+    if "graftcheck: disable" not in line:
+        return False
+    tail = line.split("graftcheck: disable", 1)[1]
+    return not tail.startswith("=") or rule in tail[1:].replace(",", " ").split()
+
+
+def _terminal_name(func: ast.expr) -> typing.Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _axis_literals(node: ast.expr, kind: str) -> typing.List[ast.Constant]:
+    out: typing.List[ast.Constant] = []
+    if kind == "name":
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.append(node)
+    else:  # seq
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.append(el)
+    return out
+
+
+def _valid_axis(name: str, registry: typing.FrozenSet[str]) -> bool:
+    if name == "":
+        return True
+    base = name[1:] if name.startswith("_") else name
+    return name in registry or base in registry
+
+
+def _iter_py_files(root: str, scopes: typing.Sequence[str]
+                   ) -> typing.Iterator[typing.Tuple[str, str]]:
+    """Yield (abs_path, rel_path) of every .py file under the scopes."""
+    for scope in scopes:
+        top = os.path.join(root, scope)
+        if os.path.isfile(top):
+            yield top, scope
+            continue
+        for dirpath, _, files in os.walk(top):
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    p = os.path.join(dirpath, fn)
+                    yield p, os.path.relpath(p, root)
+
+
+def check_axis_literals(root: str) -> typing.List[Finding]:
+    registry = _known_axes()
+    findings: typing.List[Finding] = []
+    for path, rel in _iter_py_files(root, AXIS_LITERAL_SCOPE):
+        src = open(path).read()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            spec = AXIS_CALLS.get(name or "")
+            if spec is None:
+                continue
+            # table indices are AST-positional: bound method calls
+            # (t.rename(a, b)) never carry self in node.args, and the
+            # method-only entries are written method-relative
+            for idx, kw, kind in spec:
+                arg_node = None
+                if 0 <= idx < len(node.args):
+                    arg_node = node.args[idx]
+                for k in node.keywords:
+                    if k.arg == kw:
+                        arg_node = k.value
+                if arg_node is None:
+                    continue
+                for lit in _axis_literals(arg_node, kind):
+                    if _valid_axis(lit.value, registry):
+                        continue
+                    if _suppressed(lines, lit.lineno, "axis-literal"):
+                        continue
+                    findings.append(Finding(
+                        "axis-literal", "error", f"{rel}:{lit.lineno}",
+                        f"axis name {lit.value!r} (arg {kw!r} of {name}) is "
+                        f"not in the nd axis registry — register it with "
+                        f"nd.register_axis or fix the typo"))
+    return findings
+
+
+def x_escape_counts(root: str) -> typing.Dict[str, int]:
+    counts: typing.Dict[str, int] = {}
+    for path, rel in _iter_py_files(root, (X_ESCAPE_SCOPE,)):
+        norm = rel.replace(os.sep, "/")
+        if any(norm == e or norm.startswith(e + "/") for e in X_ESCAPE_EXEMPT):
+            continue
+        tree = ast.parse(open(path).read(), filename=rel)
+        n = sum(1 for node in ast.walk(tree)
+                if isinstance(node, ast.Attribute) and node.attr == "x"
+                and isinstance(node.ctx, ast.Load))
+        if n:
+            counts[norm] = n
+    return counts
+
+
+def x_escape_golden_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "goldens", "ast_x_escapes.json")
+
+
+def check_x_escapes(root: str, update_goldens: bool = False
+                    ) -> typing.List[Finding]:
+    counts = x_escape_counts(root)
+    path = x_escape_golden_path()
+    if update_goldens:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(counts, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return [Finding("x-escape", "info", path,
+                        f"ratchet updated ({sum(counts.values())} escapes in "
+                        f"{len(counts)} files)")]
+    if not os.path.exists(path):
+        return [Finding("x-escape", "error", path,
+                        "no x-escape ratchet golden; run --update-goldens")]
+    golden = json.load(open(path))
+    findings: typing.List[Finding] = []
+    for rel in sorted(set(counts) | set(golden)):
+        got, want = counts.get(rel, 0), golden.get(rel, 0)
+        if got > want:
+            findings.append(Finding(
+                "x-escape", "error", rel,
+                f"{got} raw .x escapes (ratchet allows {want}) — keep model "
+                f"code in the named-axis algebra, or re-record with "
+                f"--update-goldens if the new escapes are deliberate"))
+        elif got < want:
+            findings.append(Finding(
+                "x-escape", "info", rel,
+                f".x escapes improved {want} -> {got}; re-record the ratchet "
+                f"with --update-goldens"))
+    return findings
+
+
+def check_traced_rng(root: str) -> typing.List[Finding]:
+    findings: typing.List[Finding] = []
+    for path, rel in _iter_py_files(root, TRACED_RNG_SCOPE):
+        src = open(path).read()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        # module aliases imported in this file: {"random", "time", ...} plus
+        # numpy aliases for the np.random case
+        mod_alias: typing.Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod_alias[a.asname or a.name.split(".")[0]] = \
+                        a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                base = node.module.split(".")[0]
+                if base in _RNG_MODULES:
+                    for a in node.names:
+                        mod_alias[a.asname or a.name] = base
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            root_name = None
+            chain: typing.List[str] = []
+            cur: ast.expr = func
+            while isinstance(cur, ast.Attribute):
+                chain.append(cur.attr)
+                cur = cur.value
+            if isinstance(cur, ast.Name):
+                root_name = cur.id
+                chain.append(cur.id)
+            chain.reverse()
+            bad = None
+            if root_name and mod_alias.get(root_name) in _RNG_MODULES:
+                bad = ".".join(chain)
+            elif (root_name and mod_alias.get(root_name) == "numpy"
+                    and len(chain) >= 2 and chain[1] == "random"):
+                bad = ".".join(chain)
+            if bad and not _suppressed(lines, node.lineno, "traced-rng"):
+                findings.append(Finding(
+                    "traced-rng", "error", f"{rel}:{node.lineno}",
+                    f"host-side call {bad}() inside traced model code — it "
+                    f"bakes a trace-time value into the graph; use jax.random "
+                    f"via ctx.next_rng() (or hoist it out of models/ops)"))
+    return findings
+
+
+#: scopes where an f64 dtype request is always a defect: model/op/optimizer
+#: code gets its dtypes from the config policy knobs, never from literals.
+#: (The graph-level f64 audit in graph_rules only sees real f64 avals, which
+#: jax's default x64-disabled mode silently squashes to f32 — this static
+#: check catches the request itself.)
+F64_SCOPE = ("homebrewnlp_tpu/models", "homebrewnlp_tpu/ops",
+             "homebrewnlp_tpu/optim", "homebrewnlp_tpu/train")
+
+
+def check_f64_literals(root: str) -> typing.List[Finding]:
+    findings: typing.List[Finding] = []
+    for path, rel in _iter_py_files(root, F64_SCOPE):
+        src = open(path).read()
+        if "float64" not in src and "complex128" not in src:
+            continue
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        for node in ast.walk(tree):
+            hit = None
+            if (isinstance(node, ast.Attribute)
+                    and node.attr in ("float64", "complex128")):
+                hit = node.attr
+            elif (isinstance(node, ast.Constant)
+                    and node.value in ("float64", "complex128")):
+                hit = node.value
+            if hit and not _suppressed(lines, node.lineno, "dtype-promotion"):
+                findings.append(Finding(
+                    "dtype-promotion", "error", f"{rel}:{node.lineno}",
+                    f"{hit} dtype request in traced/optimizer code — jax's "
+                    f"default x64-disabled mode silently computes f32 here "
+                    f"while a TPU x64 run would double every byte; take the "
+                    f"dtype from the config policy instead"))
+    return findings
+
+
+def check_partitionspec_literals(root: str) -> typing.List[Finding]:
+    mesh_axes = _mesh_axes()
+    findings: typing.List[Finding] = []
+    for path, rel in _iter_py_files(root, ("homebrewnlp_tpu", "tools")):
+        src = open(path).read()
+        if "PartitionSpec" not in src:
+            continue
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _terminal_name(node.func) != "PartitionSpec":
+                continue
+            # one spec entry may also be a tuple of axes (sharding one dim
+            # over several mesh axes) — check the nested literals too
+            flat: typing.List[ast.expr] = []
+            for arg in node.args:
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    flat.extend(arg.elts)
+                else:
+                    flat.append(arg)
+            for arg in flat:
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)):
+                    continue
+                if arg.value in mesh_axes:
+                    continue
+                if _suppressed(lines, arg.lineno, "partitionspec-axis"):
+                    continue
+                findings.append(Finding(
+                    "partitionspec-axis", "error", f"{rel}:{arg.lineno}",
+                    f"PartitionSpec names unknown mesh axis {arg.value!r} "
+                    f"(known: {sorted(mesh_axes)}) — the sharding machinery "
+                    f"silently replicates unknown axes"))
+    return findings
+
+
+def run_ast_rules(root: str, update_goldens: bool = False,
+                  rules: typing.Optional[typing.Sequence[str]] = None
+                  ) -> typing.List[Finding]:
+    table = {
+        "axis-literal": lambda: check_axis_literals(root),
+        "x-escape": lambda: check_x_escapes(root, update_goldens),
+        "traced-rng": lambda: check_traced_rng(root),
+        "partitionspec-axis": lambda: check_partitionspec_literals(root),
+        # static twin of graph_rules.check_dtype_promotion (x64-off traces
+        # cannot carry real f64 avals, so the request itself is linted)
+        "dtype-promotion": lambda: check_f64_literals(root),
+    }
+    findings: typing.List[Finding] = []
+    for name, fn in table.items():
+        if rules is None or name in rules:
+            findings.extend(fn())
+    return findings

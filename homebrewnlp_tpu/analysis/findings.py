@@ -1,0 +1,58 @@
+"""Finding record + report rendering for graftcheck.
+
+A finding pins one violated invariant to a location (source file:line for AST
+rules, config + step for graph rules).  Severities:
+
+- ``error``   — the invariant is broken; graftcheck exits non-zero.
+- ``warning`` — suspicious but not certainly wrong (e.g. a large tensor left
+  fully replicated); reported, exit 0 unless ``--strict``.
+- ``info``    — bookkeeping (e.g. a ratchet count that IMPROVED and should be
+  re-recorded); never affects the exit code.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+
+Severity = str  # "error" | "warning" | "info"
+_SEVERITY_ORDER = {"info": 0, "warning": 1, "error": 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: Severity
+    location: str  # "path/to/file.py:123" or "configs/x.json[train]"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.severity.upper():7s} [{self.rule}] {self.location}: {self.message}"
+
+
+def worst_severity(findings: typing.Iterable[Finding]) -> typing.Optional[Severity]:
+    worst = None
+    for f in findings:
+        if worst is None or _SEVERITY_ORDER[f.severity] > _SEVERITY_ORDER[worst]:
+            worst = f.severity
+    return worst
+
+
+def render_report(findings: typing.Sequence[Finding], as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps([dataclasses.asdict(f) for f in findings], indent=2)
+    if not findings:
+        return "graftcheck: clean — no findings"
+    lines = []
+    by_rule: typing.Dict[str, typing.List[Finding]] = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    for rule in sorted(by_rule):
+        group = by_rule[rule]
+        lines.append(f"-- {rule} ({len(group)}) " + "-" * max(0, 58 - len(rule)))
+        lines.extend(f.render() for f in group)
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = sum(1 for f in findings if f.severity == "warning")
+    n_info = len(findings) - n_err - n_warn
+    lines.append(f"graftcheck: {n_err} error(s), {n_warn} warning(s), {n_info} info")
+    return "\n".join(lines)

@@ -1,0 +1,332 @@
+"""Graph auditors: rule passes over abstractly-traced step jaxprs.
+
+Five rules, each pinning an invariant that historically only failed at TPU
+runtime (slow step, OOM, or silently wrong layout):
+
+- ``collective-census``: count data-moving collectives (+ sharding
+  constraints and half->f32 upcasts) per step and diff against the config's
+  golden budget file.  An accidental all-gather from a PartitionSpec mismatch
+  — or a new upcast in the hot path — shows up as a census diff.
+- ``dtype-promotion``: no f64/complex128 values anywhere in a step unless the
+  config itself declares an f64 dtype policy.
+- ``donation``: every TrainState buffer entering the train step must be
+  donated (``donate_argnums``) — a dropped donation doubles peak HBM.
+- ``sharding-spec``: every mesh axis named by the sharding rule table or by
+  an in-graph sharding annotation must exist on the mesh (``spec_for``
+  silently replicates unknown axes — exactly the failure this pins); large
+  parameters left fully replicated on the config's intended pod mesh are
+  flagged.
+- ``constant-bloat``: closed-over array constants above a size threshold are
+  baked into the program (recompile hazard + wasted HBM per executable).
+
+Golden budgets live in ``homebrewnlp_tpu/analysis/goldens/census/`` — one
+JSON per config, regenerated with ``python tools/graftcheck.py
+--update-goldens`` (see docs/static_analysis.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import typing
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..parallel.mesh import MESH_AXES, axis_sizes
+from ..parallel.sharding import RULES, spec_for
+from .findings import Finding
+from .trace import (COLLECTIVE_PRIMS, ConfigTraces, eqn_location, iter_eqns,
+                    iter_closed_jaxprs)
+
+GOLDENS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "goldens")
+
+# constant-bloat thresholds (bytes): above ERROR the constant is certainly a
+# closure bug; WARN..ERROR is worth a look (tables etc.)
+CONST_WARN_BYTES = 64 * 1024
+CONST_ERROR_BYTES = 1024 * 1024
+
+# sharding-spec: parameters at least this large (elements) should not be
+# fully replicated when the config's intended mesh has a >1 model axis
+REPLICATED_PARAM_ELEMS = 1 << 23  # 8M elements (32 MB at f32)
+
+_F64 = (jnp.float64, jnp.complex128)
+
+
+def census_of(step_trace) -> typing.Dict[str, typing.Any]:
+    """Static per-call-site counts of collectives and upcasts for one step."""
+    collectives: typing.Dict[str, int] = {}
+    upcasts = 0
+    n_eqns = 0
+    for eqn in iter_eqns(step_trace.jaxpr):
+        n_eqns += 1
+        name = COLLECTIVE_PRIMS.get(eqn.primitive.name)
+        if name is not None:
+            collectives[name] = collectives.get(name, 0) + 1
+        elif eqn.primitive.name == "convert_element_type":
+            new = eqn.params.get("new_dtype")
+            old = getattr(getattr(eqn.invars[0], "aval", None), "dtype", None)
+            if (old is not None and new == jnp.float32
+                    and old in (jnp.bfloat16, jnp.float16)):
+                upcasts += 1
+    return {"collectives": dict(sorted(collectives.items())),
+            "half_to_f32_upcasts": upcasts,
+            "n_eqns": n_eqns}
+
+
+def golden_path(config_name: str) -> str:
+    return os.path.join(GOLDENS_DIR, "census", config_name + ".json")
+
+
+def _loc(traces: ConfigTraces, step: str) -> str:
+    return f"configs/{traces.config_name}.json[{step}]"
+
+
+def check_collective_census(traces: ConfigTraces,
+                            update_goldens: bool = False
+                            ) -> typing.List[Finding]:
+    findings: typing.List[Finding] = []
+    actual = {name: census_of(st) for name, st in sorted(traces.steps.items())}
+    path = golden_path(traces.config_name)
+    if update_goldens:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        import jax
+        # merge over any existing golden: steps pinned earlier but not
+        # traced this run (e.g. --steps train, or a toolchain that cannot
+        # trace one step) keep their budget instead of being erased
+        merged = dict(actual)
+        if os.path.exists(path):
+            with open(path) as f:
+                for step, budget in json.load(f).get("steps", {}).items():
+                    merged.setdefault(step, budget)
+        with open(path, "w") as f:
+            json.dump({"config": traces.config_name,
+                       "mesh": {k: int(v) for k, v in traces.mesh.shape.items()},
+                       "jax": jax.__version__,
+                       "steps": merged}, f, indent=2, sort_keys=True)
+            f.write("\n")
+        findings.append(Finding(
+            "collective-census", "info", path,
+            f"golden updated ({', '.join(actual) or 'no steps'}"
+            + (f"; kept {', '.join(sorted(set(merged) - set(actual)))}"
+               if set(merged) - set(actual) else "") + ")"))
+        return findings
+    if not os.path.exists(path):
+        findings.append(Finding(
+            "collective-census", "error", _loc(traces, "*"),
+            f"no golden budget at {os.path.relpath(path)}; run "
+            f"`python tools/graftcheck.py --config configs/"
+            f"{traces.config_name}.json --update-goldens`"))
+        return findings
+    with open(path) as f:
+        golden = json.load(f)
+    gsteps = golden.get("steps", {})
+    for step in sorted(set(actual) | set(gsteps)):
+        if step not in actual:
+            findings.append(Finding(
+                "collective-census", "warning", _loc(traces, step),
+                "step present in golden but not traced this run "
+                f"(trace errors: {traces.errors.get(step, 'step skipped')})"))
+            continue
+        if step not in gsteps:
+            # a step outside the golden's recorded set (e.g. --steps eval
+            # when the budget pins train+decode) is unpinned, not wrong
+            findings.append(Finding(
+                "collective-census", "warning", _loc(traces, step),
+                "step traced but not pinned by the golden budget; record it "
+                "with --update-goldens to gate it"))
+            continue
+        got, want = actual[step], gsteps[step]
+        for key in sorted(set(got["collectives"]) | set(want["collectives"])):
+            g = got["collectives"].get(key, 0)
+            w = want["collectives"].get(key, 0)
+            if g != w:
+                findings.append(Finding(
+                    "collective-census", "error", _loc(traces, step),
+                    f"{key} count {g} != golden {w} — an unplanned "
+                    f"collective usually means a sharding-spec mismatch; "
+                    f"if intended, re-record with --update-goldens"))
+        if got["half_to_f32_upcasts"] != want.get("half_to_f32_upcasts", 0):
+            findings.append(Finding(
+                "collective-census", "error", _loc(traces, step),
+                f"half->f32 upcast count {got['half_to_f32_upcasts']} != "
+                f"golden {want.get('half_to_f32_upcasts', 0)} — check the "
+                f"hot path for unintended promotions; if intended, "
+                f"re-record with --update-goldens"))
+    return findings
+
+
+def check_dtype_promotion(traces: ConfigTraces) -> typing.List[Finding]:
+    cfg = traces.cfg
+    declared_f64 = any(
+        getattr(cfg, a) == jnp.float64
+        for a in ("storage_dtype", "slice_dtype", "calculation_dtype",
+                  "optimizer_slice_dtype", "optimizer_calculation_dtype"))
+    if declared_f64:
+        return []
+    findings: typing.List[Finding] = []
+    for step, st in sorted(traces.steps.items()):
+        hits: typing.List[str] = []
+        for eqn in iter_eqns(st.jaxpr):
+            for v in eqn.outvars:
+                dt = getattr(getattr(v, "aval", None), "dtype", None)
+                if dt in _F64:
+                    hits.append(f"{eqn.primitive.name} -> {dt} at "
+                                f"{eqn_location(eqn)}")
+                    break
+            if len(hits) >= 5:
+                break
+        for h in hits:
+            findings.append(Finding(
+                "dtype-promotion", "error", _loc(traces, step),
+                f"f64 value in the graph ({h}); no config dtype declares "
+                f"float64 — check for Python floats promoted via x64 or an "
+                f"explicit astype"))
+    return findings
+
+
+def check_donation(traces: ConfigTraces) -> typing.List[Finding]:
+    st = traces.steps.get("train")
+    if st is None or st.state_info is None:
+        return []
+    import jax
+    findings: typing.List[Finding] = []
+    leaves = jax.tree_util.tree_leaves_with_path(st.state_info)
+    missing = [jax.tree_util.keystr(path) for path, info in leaves
+               if not getattr(info, "donated", False)]
+    shown = missing[:10]
+    for name in shown:
+        findings.append(Finding(
+            "donation", "error", _loc(traces, "train"),
+            f"train-state buffer {name} is not donated — the step keeps a "
+            f"second copy live (check donate_argnums on the jitted step, "
+            f"train/state.py)"))
+    if len(missing) > len(shown):
+        findings.append(Finding(
+            "donation", "error", _loc(traces, "train"),
+            f"... and {len(missing) - len(shown)} more non-donated "
+            f"train-state buffers"))
+    return findings
+
+
+class _IntendedMesh:
+    """Duck-typed stand-in for spec_for's mesh argument carrying the axis
+    sizes of the config's INTENDED pod (tpu_size), not the local CPU mesh."""
+
+    def __init__(self, shape: typing.Dict[str, int]):
+        self.shape = shape
+        self.axis_names = tuple(shape)
+
+
+def intended_mesh(cfg: Config) -> _IntendedMesh:
+    try:
+        sizes = axis_sizes(cfg, max(cfg.tpu_size, 1))
+    except ValueError:
+        sizes = {a: 1 for a in MESH_AXES}
+    return _IntendedMesh(dict(sizes))
+
+
+def check_sharding_specs(traces: ConfigTraces) -> typing.List[Finding]:
+    findings: typing.List[Finding] = []
+    known = set(MESH_AXES)
+    # 1. the rule table itself: an unknown mesh axis is SILENTLY treated as
+    # replicated by spec_for — the classic mis-shard
+    for logical, mesh_axis in sorted(RULES.items()):
+        if mesh_axis not in known:
+            findings.append(Finding(
+                "sharding-spec", "error", "homebrewnlp_tpu/parallel/sharding.py",
+                f"RULES maps logical axis {logical!r} to unknown mesh axis "
+                f"{mesh_axis!r} (known: {sorted(known)}) — spec_for silently "
+                f"replicates it"))
+    # 2. in-graph sharding annotations must only name real mesh axes
+    for step, st in sorted(traces.steps.items()):
+        seen_bad: typing.Set[str] = set()
+        for eqn in iter_eqns(st.jaxpr):
+            sharding = eqn.params.get("sharding")
+            spec = getattr(sharding, "spec", None)
+            if spec is None:
+                continue
+            for part in spec:
+                axes = part if isinstance(part, tuple) else (part,)
+                for ax in axes:
+                    if ax is not None and ax not in known and ax not in seen_bad:
+                        seen_bad.add(ax)
+                        findings.append(Finding(
+                            "sharding-spec", "error", _loc(traces, step),
+                            f"sharding annotation names unknown mesh axis "
+                            f"{ax!r} at {eqn_location(eqn)}"))
+    # 3. large params fully replicated on the intended pod mesh
+    imesh = intended_mesh(traces.cfg)
+    if any(v > 1 for v in imesh.shape.values()):
+        for name, sds in sorted(traces.param_shapes.items()):
+            elems = int(np.prod(sds.shape)) if sds.shape else 1
+            if elems < REPLICATED_PARAM_ELEMS:
+                continue
+            spec = spec_for(traces.param_axes.get(name, ()), imesh)
+            if not any(p is not None for p in spec):
+                findings.append(Finding(
+                    "sharding-spec", "warning", _loc(traces, "params"),
+                    f"parameter {name} ({elems} elements, axes "
+                    f"{traces.param_axes.get(name, ())}) is fully replicated "
+                    f"on the intended {dict(imesh.shape)} mesh — consider a "
+                    f"sharding rule for one of its axes"))
+    return findings
+
+
+def check_constant_bloat(traces: ConfigTraces) -> typing.List[Finding]:
+    findings: typing.List[Finding] = []
+    for step, st in sorted(traces.steps.items()):
+        for cj in iter_closed_jaxprs(st.jaxpr):
+            for c in getattr(cj, "consts", ()):
+                size = getattr(c, "size", 0)
+                itemsize = getattr(getattr(c, "dtype", None), "itemsize", 1)
+                nbytes = int(size) * int(itemsize)
+                if nbytes < CONST_WARN_BYTES:
+                    continue
+                sev = "error" if nbytes >= CONST_ERROR_BYTES else "warning"
+                findings.append(Finding(
+                    "constant-bloat", sev, _loc(traces, step),
+                    f"closed-over constant {getattr(c, 'shape', ())} "
+                    f"{getattr(c, 'dtype', '?')} ({nbytes} bytes) is baked "
+                    f"into the program — pass it as an argument (recompile "
+                    f"hazard + per-executable HBM copy)"))
+    return findings
+
+
+#: jax API names whose absence marks a known toolchain gap (older jax than
+#: the parallel modules target), as opposed to a real defect in model code
+_TOOLCHAIN_GAP_APIS = ("shard_map", "get_abstract_mesh", "pcast", "typeof",
+                       "pvary", "CompilerParams")
+
+
+def check_trace_errors(traces: ConfigTraces) -> typing.List[Finding]:
+    """Trace failures are findings too: severity depends on whether the
+    failure is a known toolchain gap (a specific missing jax API -> warning,
+    the config is simply not analyzable on this toolchain) or a real defect
+    (error)."""
+    findings: typing.List[Finding] = []
+    for step, err in sorted(traces.errors.items()):
+        toolchain = ("has no attribute" in err and any(
+            f"'{api}'" in err for api in _TOOLCHAIN_GAP_APIS))
+        findings.append(Finding(
+            "trace", "warning" if toolchain else "error",
+            _loc(traces, step), f"step failed to trace: {err}"))
+    return findings
+
+
+def run_graph_rules(traces: ConfigTraces, update_goldens: bool = False,
+                    rules: typing.Optional[typing.Sequence[str]] = None
+                    ) -> typing.List[Finding]:
+    table = {
+        "collective-census": lambda t: check_collective_census(t, update_goldens),
+        "dtype-promotion": check_dtype_promotion,
+        "donation": check_donation,
+        "sharding-spec": check_sharding_specs,
+        "constant-bloat": check_constant_bloat,
+    }
+    findings = check_trace_errors(traces)
+    for name, fn in table.items():
+        if rules is None or name in rules:
+            findings.extend(fn(traces))
+    return findings

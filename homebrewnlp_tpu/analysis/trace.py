@@ -1,0 +1,317 @@
+"""Abstract tracing harness: config -> jaxprs of its train/eval/decode steps.
+
+Everything here runs on CPU with ShapeDtypeStruct parameters — ``jax.jit(
+step).trace(...)`` / ``jax.make_jaxpr`` stage the computation out without
+allocating parameter memory, running FLOPs, or invoking XLA, so auditing the
+flagship configs (billions of abstract parameter elements) takes seconds on a
+laptop.  The resulting :class:`StepTrace` bundles expose:
+
+- ``jaxpr``      — the ClosedJaxpr rule passes walk (:func:`iter_eqns`)
+- ``args_info``  — donation metadata (train step only): the pytree of
+  ``jax.stages.ArgInfo`` for the step's arguments
+- ``mesh``       — the concrete mesh the step was traced under
+
+Toolchain compatibility: the pipeline/ring modules target the jax >= 0.8
+``jax.shard_map`` API (``axis_names=``, vma typing, ``jax.lax.pcast``).  On
+older toolchains those attributes are missing and the parallel-composed
+configs could not even be *traced* — so :func:`trace_compat` provides
+TRACE-ONLY shims (``jax.experimental.shard_map`` with ``auto=``, identity
+``pcast``) inside a restoring context manager.  The shims are sufficient for
+staging out the jaxpr and counting collectives; they are NOT numerically
+faithful for execution (untyped transpose semantics) and are never installed
+outside an active trace.  Census counts exclude the vma-typing bookkeeping
+primitives (``pvary``/``pbroadcast``) so goldens generated under the shims
+match newer toolchains.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import typing
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.feed import axes_for
+from ..data.synthetic import synthetic_text_batch, synthetic_video_batch
+from ..models import build, pipeline_params_stacked, stack_pipeline_params
+from ..models.ctx import Ctx
+from ..nd import NT
+from ..optim import Optimizer
+from ..parallel import make_mesh
+from ..train.state import Trainer, TrainState
+
+#: data-moving collective primitives the census counts, with cross-version
+#: name normalization.  vma bookkeeping (pvary/pbroadcast) is deliberately
+#: absent: it moves no bytes and differs between typed/untyped toolchains.
+COLLECTIVE_PRIMS: typing.Dict[str, str] = {
+    "psum": "psum",
+    "psum2": "psum",
+    "psum_invariant": "psum",
+    "all_gather": "all_gather",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute",
+    "reduce_scatter": "reduce_scatter",
+    "pgather": "pgather",
+    "sharding_constraint": "sharding_constraint",
+}
+
+
+@dataclasses.dataclass
+class StepTrace:
+    name: str  # "train" | "eval" | "decode"
+    jaxpr: typing.Any  # jax.core.ClosedJaxpr
+    mesh: typing.Any
+    args_info: typing.Any = None  # pytree of jax.stages.ArgInfo (train only)
+    state_info: typing.Any = None  # the TrainState subtree of args_info
+
+
+@dataclasses.dataclass
+class ConfigTraces:
+    config_name: str
+    cfg: Config
+    mesh: typing.Any
+    steps: typing.Dict[str, StepTrace]
+    param_axes: typing.Dict[str, typing.Tuple[str, ...]]
+    param_shapes: typing.Dict[str, typing.Any]  # name -> ShapeDtypeStruct
+    errors: typing.Dict[str, str]  # step -> repr of trace failure
+
+
+@contextlib.contextmanager
+def trace_compat():
+    """Install trace-only jax API shims for toolchains older than the
+    ``jax.shard_map`` / vma-typing surface the parallel modules target; a
+    no-op (beyond bookkeeping) when the real APIs exist.  Always restores."""
+    saved: typing.List[typing.Tuple[typing.Any, str, typing.Any, bool]] = []
+
+    def patch(obj, name, value):
+        saved.append((obj, name, getattr(obj, name, None), hasattr(obj, name)))
+        setattr(obj, name, value)
+
+    try:
+        if not hasattr(jax, "shard_map"):
+            from jax.experimental.shard_map import shard_map as _sm
+
+            def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                          axis_names=None, check_vma=None, **kw):
+                if mesh is None:
+                    from jax._src.mesh import thread_resources
+                    mesh = thread_resources.env.physical_mesh
+                auto = frozenset()
+                if axis_names is not None:
+                    auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+                return _sm(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False, auto=auto)
+
+            patch(jax, "shard_map", shard_map)
+        if not hasattr(jax.lax, "pcast"):
+            patch(jax.lax, "pcast", lambda x, axes, to=None: x)
+        if not hasattr(jax, "typeof"):
+            patch(jax, "typeof", lambda x: jax.core.get_aval(x))
+        if not hasattr(jax.sharding, "get_abstract_mesh"):
+            class _NoManual:
+                manual_axes = ()
+
+            patch(jax.sharding, "get_abstract_mesh", lambda: _NoManual())
+        yield
+    finally:
+        for obj, name, old, existed in reversed(saved):
+            if existed:
+                setattr(obj, name, old)
+            else:
+                delattr(obj, name)
+
+
+def iter_eqns(jaxpr) -> typing.Iterator:
+    """Yield every equation of ``jaxpr`` (ClosedJaxpr or Jaxpr) and of every
+    sub-jaxpr reachable through equation params (pjit/scan/while/cond/
+    custom_vjp/shard_map/checkpoint), one yield per static call site."""
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in inner.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for item in vals:
+                if hasattr(item, "eqns") or (
+                        hasattr(item, "jaxpr")
+                        and hasattr(item.jaxpr, "eqns")):
+                    yield from iter_eqns(item)
+
+
+def iter_closed_jaxprs(jaxpr, _seen=None) -> typing.Iterator:
+    """Yield ``jaxpr`` and every nested ClosedJaxpr once (for const walks)."""
+    if _seen is None:
+        _seen = set()
+    if id(jaxpr) in _seen:
+        return
+    _seen.add(id(jaxpr))
+    yield jaxpr
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in inner.eqns:
+        for v in eqn.params.values():
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for item in vals:
+                if hasattr(item, "eqns") or (
+                        hasattr(item, "jaxpr")
+                        and hasattr(item.jaxpr, "eqns")):
+                    yield from iter_closed_jaxprs(item, _seen)
+
+
+def eqn_location(eqn) -> str:
+    """Best-effort ``file:line (fn)`` of an equation's user frame."""
+    try:
+        from jax._src import source_info_util
+        return source_info_util.summarize(eqn.source_info)
+    except Exception:
+        return "<unknown>"
+
+
+def abstract_batch(cfg: Config) -> typing.Dict[str, NT]:
+    """Model-input batch with the exact shapes the data pipeline delivers
+    (synthetic generators are the format reference), as tiny concrete arrays
+    — token ids and masks only, never activations."""
+    raw = (synthetic_video_batch(cfg) if cfg.use_video
+           else synthetic_text_batch(cfg))
+    return {k: NT(jnp.asarray(v), axes_for(k, v, cfg)) for k, v in raw.items()}
+
+
+def abstract_params(cfg: Config, batch: typing.Dict[str, NT]
+                    ) -> typing.Tuple[typing.Dict[str, typing.Any],
+                                      typing.Dict[str, typing.Tuple[str, ...]]]:
+    """(ShapeDtypeStruct params, axis-name metadata) via eval_shape — the
+    abstract twin of ``models.init_params`` (no QR inits, no memory)."""
+    meta: typing.Dict[str, typing.Tuple[str, ...]] = {}
+
+    def _collect():
+        ctx = Ctx(cfg, params=None, seed=0, train=False)
+        build(ctx, batch)
+        meta.update(ctx.axis_names)
+        return ctx.collected
+
+    params = jax.eval_shape(_collect)
+    params, meta = dict(params), dict(meta)
+    if cfg.pipeline_parallel > 1:
+        # stage-stacked layout, abstractly: shapes via eval_shape, axis
+        # metadata via a dummy value tree (the axis transform only needs keys)
+        dummy = {k: np.zeros((1,), np.int8) for k in params}
+        _, meta = stack_pipeline_params(cfg, dummy, meta)
+        params = jax.eval_shape(lambda p: stack_pipeline_params(cfg, p),
+                                params)
+        assert pipeline_params_stacked(cfg, params)
+    return params, meta
+
+
+def _micro_sds(batch: typing.Dict[str, NT], n_micro: int
+               ) -> typing.Dict[str, NT]:
+    if n_micro <= 1:
+        return batch
+    return {k: NT(jnp.zeros((t.x.shape[0] // n_micro,) + t.x.shape[1:],
+                            t.x.dtype), t.names)
+            for k, t in batch.items()}
+
+
+def trace_train(cfg: Config, mesh=None) -> typing.Tuple[StepTrace, dict, dict]:
+    """Trace the full jitted train step (grads + optimizer update) against
+    abstract state.  Returns (StepTrace, param shapes, param axes)."""
+    mesh = make_mesh(cfg) if mesh is None else mesh
+    batch = abstract_batch(cfg)
+    trainer = Trainer(cfg, mesh)
+    micro = _micro_sds(batch, trainer.n_micro)
+    params, axes = abstract_params(cfg, micro)
+    trainer.axes = axes
+    trainer.optimizer = Optimizer(cfg, axes)
+    opt_state = jax.eval_shape(trainer.optimizer.init, params)
+    state = TrainState(params, opt_state,
+                       jax.ShapeDtypeStruct((), jnp.int32))
+    step = trainer._make_step()
+    with trace_compat(), mesh:
+        traced = step.trace(state, batch, jax.random.key(0))
+    args_info = traced.args_info
+    # args_info mirrors the call tree: ((state, batch, rng), {}) — the
+    # TrainState subtree carries the donation bits the audit needs
+    state_info = args_info[0][0]
+    return (StepTrace("train", traced.jaxpr, mesh, args_info, state_info),
+            params, axes)
+
+
+def trace_eval(cfg: Config, params, mesh=None) -> StepTrace:
+    """Trace the forward/eval walk (build -> total loss)."""
+    mesh = make_mesh(cfg) if mesh is None else mesh
+    batch = abstract_batch(cfg)
+
+    def eval_fn(p, b):
+        ctx = Ctx(cfg, params=p, train=False, rng=None, mesh=mesh)
+        return build(ctx, b).loss
+
+    with trace_compat(), mesh:
+        jaxpr = jax.make_jaxpr(eval_fn)(params, batch)
+    return StepTrace("eval", jaxpr, mesh)
+
+
+def decode_traceable(cfg: Config) -> bool:
+    from ..infer.kv_cache import cache_eligible
+    return bool(cfg.use_language) and not cfg.use_video and cache_eligible(cfg)
+
+
+def trace_decode(cfg: Config, params, mesh=None) -> StepTrace:
+    """Trace ONE incremental KV-cached decode step (the serving hot path)."""
+    from ..infer.kv_cache import _decode_logits
+    mesh = make_mesh(cfg) if mesh is None else mesh
+    names = ("batch", "sequence", "language_token_patch")
+    seq = cfg.sequence_length // cfg.token_patch_size
+    row = jax.ShapeDtypeStruct((1, 1, cfg.token_patch_size), jnp.int32)
+    # decode runs the flat per-depth layout (serve/interface.py unstacks)
+    if cfg.pipeline_parallel > 1 and pipeline_params_stacked(cfg, params):
+        from ..models import unstack_pipeline_params
+        params = jax.eval_shape(
+            lambda p: unstack_pipeline_params(cfg, p), params)
+
+    def probe(p):
+        return _decode_logits(cfg, p, jnp.zeros(row.shape, row.dtype),
+                              jnp.int32(0), {}, seq, names)[1]
+
+    with trace_compat():
+        caches = jax.eval_shape(probe, params)
+
+        def decode_step(p, r, c):
+            return _decode_logits(cfg, p, r, jnp.int32(1), c, seq, names)
+
+        jaxpr = jax.make_jaxpr(decode_step)(params, row, caches)
+    return StepTrace("decode", jaxpr, mesh)
+
+
+def trace_config(cfg: Config, config_name: str,
+                 steps: typing.Sequence[str] = ("train", "decode"),
+                 ) -> ConfigTraces:
+    """Trace the requested steps of one config, collecting per-step failures
+    instead of aborting the whole audit."""
+    mesh = make_mesh(cfg)
+    out: typing.Dict[str, StepTrace] = {}
+    errors: typing.Dict[str, str] = {}
+    params: typing.Dict[str, typing.Any] = {}
+    axes: typing.Dict[str, typing.Tuple[str, ...]] = {}
+    if "train" in steps:
+        try:
+            out["train"], params, axes = trace_train(cfg, mesh)
+        except Exception as e:  # surfaces as a trace-failure finding
+            errors["train"] = f"{type(e).__name__}: {e}"
+    if not params:
+        try:
+            trainer = Trainer(cfg, mesh)
+            micro = _micro_sds(abstract_batch(cfg), trainer.n_micro)
+            params, axes = abstract_params(cfg, micro)
+        except Exception as e:
+            errors.setdefault("params", f"{type(e).__name__}: {e}")
+    if "eval" in steps and params:
+        try:
+            out["eval"] = trace_eval(cfg, params, mesh)
+        except Exception as e:
+            errors["eval"] = f"{type(e).__name__}: {e}"
+    if "decode" in steps and params and decode_traceable(cfg):
+        try:
+            out["decode"] = trace_decode(cfg, params, mesh)
+        except Exception as e:
+            errors["decode"] = f"{type(e).__name__}: {e}"
+    return ConfigTraces(config_name, cfg, mesh, out, axes, params, errors)

@@ -37,6 +37,15 @@ PIPE_STAGE = "pipe_stage"
 
 ANON_PREFIX = "_"
 
+# the canonical axis constants above are THE registry the graftcheck
+# axis-literal lint validates against (analysis/ast_rules.py); an anonymized
+# twin ("_sequence") validates via its base name
+from . import nd as _nd  # noqa: E402  (registry import, no cycle: nd is leaf)
+
+_nd.register_axis(BATCH, SEQUENCE, HEADS, KEY, INTERMEDIATE, VOCAB,
+                  TOKEN_PATCH, HEIGHT, WIDTH, COLOR_CHANNELS, EXPERTS,
+                  ROUTED_EXPERTS, PKM_AXES, PKM_VALUES, PIPE_STAGE)
+
 
 def anonymize_name(name: str) -> str:
     """Leading underscore marks a replicated twin of an axis (reference

@@ -191,9 +191,9 @@ def _body(ctx: Ctx, src: NT) -> NT:
             def f(subparams: dict, x: NT):
                 bctx = Ctx(cfg, params=subparams, train=ctx.train, seed=ctx.seed,
                            rng=rng, mesh=ctx.mesh)
-                bctx._scope = [mode_scope, "body"]
                 bctx.attention_idx = a_start
-                with bctx.scope(_block_scope(i, c)):
+                with bctx.preset_scope(mode_scope, "body"), \
+                        bctx.scope(_block_scope(i, c)):
                     out = block_part_fn(bctx, conf, x)
                 if with_aux:
                     # aux losses (routed-MoE balance term) returned as real
@@ -335,9 +335,9 @@ def _pipeline_machinery(cfg: Config, params, names, rng, train, seed,
             # eligibility checks and the nested ring-attention path
             bctx = Ctx(cfg, params=subparams, train=train, seed=seed,
                        rng=key, mesh=None, outer_mesh=mesh)
-            bctx._scope = [mode_scope, "body"]
             bctx.attention_idx = attn_starts[j]
-            with bctx.scope(_block_scope(i0, c0)):
+            with bctx.preset_scope(mode_scope, "body"), \
+                    bctx.scope(_block_scope(i0, c0)):
                 out = block_part_fn(bctx, conf, x_nt)
             if not with_aux:
                 return out

@@ -24,6 +24,7 @@ import typing
 import jax
 import jax.numpy as jnp
 
+from .. import nd
 from ..config import Config
 from ..nd import NT
 
@@ -92,6 +93,13 @@ class Ctx:
     # -- scoping ------------------------------------------------------------
     def scope(self, name: str) -> "_Scope":
         return _Scope(self, name)
+
+    def preset_scope(self, *parts: str) -> "_PresetScope":
+        """Seed the scope stack of a per-block sub-Ctx (reversible chain /
+        pipeline stage builds) with an already-resolved prefix, mirroring it
+        onto the nd diagnostic stack so rank-mismatch errors raised inside
+        the block name the FULL parameter path, not just the block suffix."""
+        return _PresetScope(self, parts)
 
     def scoped(self, name: str, fn, *args, **kwargs):
         with self.scope(name):
@@ -164,11 +172,33 @@ class _Scope:
         key = ("/".join(ctx._scope), self.name)
         idx = ctx._counters.get(key, 0)
         ctx._counters[key] = idx + 1
-        ctx._scope.append(f"{self.name}{idx}" if idx else self.name)
+        resolved = f"{self.name}{idx}" if idx else self.name
+        ctx._scope.append(resolved)
+        # mirror onto the nd-module diagnostic stack so rank-mismatch errors
+        # (and analyzer findings) can name the enclosing parameter path
+        nd.push_scope(resolved)
         return ctx
 
     def __exit__(self, *exc):
         self.ctx._scope.pop()
+        nd.pop_scope()
+        return False
+
+
+class _PresetScope:
+    def __init__(self, ctx: Ctx, parts: typing.Sequence[str]):
+        self.ctx = ctx
+        self.parts = tuple(parts)
+
+    def __enter__(self):
+        self.ctx._scope = list(self.parts)
+        for p in self.parts:
+            nd.push_scope(p)
+        return self.ctx
+
+    def __exit__(self, *exc):
+        for _ in self.parts:
+            nd.pop_scope()
         return False
 
 

@@ -26,6 +26,10 @@ from .linear import (Dim, get_intermediate, linear, linear_shapes, normal_var,
 
 ATTENTION_DIM = typing.NamedTuple("AttentionDim", (("index", int), ("dim", str)))
 
+# layer-local scratch axis: the routed-MoE dispatch flattens all non-group
+# token axes into one row axis ("_rows", anonymized: never sharded)
+nd.register_axis("rows")
+
 
 # -- shape helpers ----------------------------------------------------------
 

@@ -17,6 +17,49 @@ import typing
 import jax
 import jax.numpy as jnp
 
+# -- axis-name registry ------------------------------------------------------
+# Central registry of every logical axis name the framework may attach to an
+# NT.  config.py registers its canonical dimension constants at import time;
+# modules that invent additional axes (layer-local scratch axes and the like)
+# register them where they are defined.  The registry is the ground truth for the graftcheck
+# axis-literal lint (homebrewnlp_tpu/analysis/ast_rules.py): a string literal
+# used in an axis position must resolve here, so a typoed axis name fails
+# static analysis instead of silently building a mis-broadcast graph.
+_KNOWN_AXES: typing.Set[str] = set()
+
+
+def register_axis(*names: str) -> None:
+    """Register logical axis names as valid (idempotent)."""
+    _KNOWN_AXES.update(names)
+
+
+def known_axes() -> typing.FrozenSet[str]:
+    """Snapshot of every registered logical axis name."""
+    return frozenset(_KNOWN_AXES)
+
+
+# -- scope provider ----------------------------------------------------------
+# Best-effort pointer at the model scope currently being built (pushed/popped
+# by models/ctx.py's scope stack).  Purely diagnostic: NT errors raised while
+# a scope is active name the enclosing parameter path, so an analyzer finding
+# or a trace-time rank mismatch points at the offending layer instead of only
+# at anonymous shapes.
+_SCOPE_STACK: typing.List[str] = []
+
+
+def push_scope(name: str) -> None:
+    _SCOPE_STACK.append(name)
+
+
+def pop_scope() -> None:
+    if _SCOPE_STACK:
+        _SCOPE_STACK.pop()
+
+
+def current_scope() -> str:
+    """The innermost model scope path being built, or '' outside any scope."""
+    return "/".join(_SCOPE_STACK)
+
 
 @jax.tree_util.register_pytree_node_class
 class NT:
@@ -27,7 +70,10 @@ class NT:
     def __init__(self, x: jnp.ndarray, names: typing.Sequence[str]):
         names = tuple(names)
         if hasattr(x, "ndim") and x.ndim != len(names):
-            raise ValueError(f"rank mismatch: array {x.shape} vs names {names}")
+            where = current_scope()
+            raise ValueError(
+                f"rank mismatch: array {x.shape} vs names {names}"
+                + (f" (while building scope {where!r})" if where else ""))
         self.x = x
         self.names = names
 

@@ -256,9 +256,12 @@ def pipeline_1f1b(stage_fn: typing.Callable, tail_fn: typing.Callable,
 
             (_, aux_loss_m), svjp = jax.vjp(stage_varying_aux, params, x_in)
             # the stage aux loss enters the total with weight 1/M; its
-            # cotangent seeds the replay vjp alongside the activation's
-            aux_seed = to_var(jnp.where(bvalid, 1.0 / M, 0.0).astype(
-                aux_loss_m.dtype))
+            # cotangent seeds the replay vjp alongside the activation's.
+            # (dtype pinned BEFORE the where: bare Python floats would
+            # become f64 under x64 — flagged by graftcheck's dtype audit)
+            aux_seed = to_var(jnp.where(
+                bvalid, jnp.asarray(1.0 / M, aux_loss_m.dtype),
+                jnp.zeros((), aux_loss_m.dtype)))
             dp, dx = svjp((cot, aux_seed))
             stage_aux = stage_aux + jnp.where(
                 bvalid, aux_loss_m.astype(f32) / M, 0)

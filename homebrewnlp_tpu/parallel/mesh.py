@@ -21,6 +21,10 @@ MODEL_AXIS = "model"
 SEQ_AXIS = "sequence_parallel"
 PIPE_AXIS = "pipeline"
 
+#: every mesh axis a PartitionSpec in this codebase may legally name — the
+#: ground truth for graftcheck's sharding-spec validation
+MESH_AXES = (DATA_AXIS, SEQ_AXIS, PIPE_AXIS, MODEL_AXIS)
+
 
 def axis_sizes(cfg: Config, n_devices: int) -> typing.Dict[str, int]:
     """Resolve mesh axis sizes for ``n_devices``.  ``heads`` bounds the model

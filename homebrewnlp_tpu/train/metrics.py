@@ -60,7 +60,7 @@ class MetricWriter:
                 # histogram counts over GRAD_HIST_EDGES buckets emitted by
                 # debug_gradients (train/state.py); other non-scalar metrics
                 # are skipped
-                hists[k] = arr.astype(np.float64)
+                hists[k] = arr.astype(np.float64)  # host-side TB writer, never traced — graftcheck: disable=dtype-promotion
         scalars["step"] = int(step)
         scalars["wall_time"] = now
         scalars["step_seconds"] = now - self._last_step_time

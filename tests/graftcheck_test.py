@@ -1,0 +1,340 @@
+"""graftcheck static-analysis subsystem: trace harness, graph rules (census
+goldens, donation, sharding specs, constant bloat), AST lint (axis literals,
+f64 requests, RNG/time, PartitionSpec axes, .x ratchet), NT scope-named
+errors, and the CLI."""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from homebrewnlp_tpu import nd
+from homebrewnlp_tpu.analysis import (ast_rules, graph_rules, trace as
+                                      atrace)
+from homebrewnlp_tpu.analysis.findings import Finding, worst_severity
+from homebrewnlp_tpu.config import Config
+
+from .backend import tiny_config
+
+
+def _load_config(name):
+    raw = json.load(open(os.path.join(REPO, "configs", name)))
+    raw.pop("_comment", None)
+    return Config(raw)
+
+
+# -- NT scope-path errors (ISSUE satellite) ---------------------------------
+
+def test_nt_rank_mismatch_names_scope():
+    nd.push_scope("gpt")
+    nd.push_scope("body")
+    try:
+        with pytest.raises(ValueError, match=r"gpt/body"):
+            nd.NT(jnp.zeros((2, 3)), ("batch",))
+    finally:
+        nd.pop_scope()
+        nd.pop_scope()
+    # outside any scope the message stays shape-only
+    with pytest.raises(ValueError) as e:
+        nd.NT(jnp.zeros((2, 3)), ("batch",))
+    assert "scope" not in str(e.value)
+
+
+def test_model_build_error_names_layer_scope():
+    """A rank mismatch raised while building a real model names the
+    enclosing block scope, making analyzer findings actionable."""
+    from homebrewnlp_tpu.models import build
+    from homebrewnlp_tpu.models.ctx import Ctx
+    from homebrewnlp_tpu.models.registry import LAYER_FUNCTIONS
+    from .backend import text_batch
+    cfg = tiny_config()
+    batch = text_batch(cfg)
+    orig = LAYER_FUNCTIONS["feed_forward"]
+
+    def broken(args):
+        out = orig(args)
+        return nd.NT(out.x, out.names[:-1])  # drop a name -> rank mismatch
+
+    LAYER_FUNCTIONS["feed_forward"] = broken
+    try:
+        with pytest.raises(ValueError, match=r"scope '.*body.*'"):
+            build(Ctx(cfg, params=None, seed=0, train=False), batch)
+    finally:
+        LAYER_FUNCTIONS["feed_forward"] = orig
+
+
+def test_axis_registry_has_canonical_names():
+    known = nd.known_axes()
+    for name in ("batch", "sequence", "heads", "features_per_head", "vocab",
+                 "pipe_stage"):
+        assert name in known, name
+
+
+# -- trace harness ----------------------------------------------------------
+
+def test_trace_tiny_config_train_and_decode(eight_devices):
+    cfg = tiny_config()
+    traces = atrace.trace_config(cfg, "tiny", steps=("train", "eval",
+                                                     "decode"))
+    assert not traces.errors, traces.errors
+    assert set(traces.steps) == {"train", "eval", "decode"}
+    assert traces.param_shapes and traces.param_axes
+    # abstract params: no leaf is a concrete array
+    for v in traces.param_shapes.values():
+        assert isinstance(v, jax.ShapeDtypeStruct)
+    census = graph_rules.census_of(traces.steps["train"])
+    assert census["n_eqns"] > 0
+    # clean tree: donation + dtype + sharding + const rules all quiet
+    findings = [f for f in graph_rules.run_graph_rules(traces)
+                if f.rule != "collective-census"]
+    errors = [f for f in findings if f.severity == "error"]
+    assert not errors, [f.render() for f in errors]
+
+
+def test_composed_dryrun_census_matches_golden(eight_devices):
+    """The DP/SP/PP/TP composed config (ring attention nested in 1F1B
+    pipeline stages) traces and its collective census matches the committed
+    golden — the ppermute budget only changes deliberately."""
+    cfg = _load_config("8dev_composed_dryrun.json")
+    traces = atrace.trace_config(cfg, "8dev_composed_dryrun",
+                                 steps=("train", "decode"))
+    assert not traces.errors, traces.errors
+    findings = graph_rules.check_collective_census(traces)
+    assert not findings, [f.render() for f in findings]
+    census = graph_rules.census_of(traces.steps["train"])
+    # the composed graph must actually move data around the rings: pipeline
+    # hops + ring attention rotations
+    assert census["collectives"].get("ppermute", 0) >= 8, census
+
+
+def test_census_diff_detected(eight_devices, monkeypatch, tmp_path):
+    """An unplanned collective (census drift vs golden) is an error."""
+    cfg = tiny_config()
+    traces = atrace.trace_config(cfg, "tinycensus", steps=("train",))
+    monkeypatch.setattr(graph_rules, "GOLDENS_DIR", str(tmp_path))
+    # record, verify clean, then tamper the golden budget
+    graph_rules.check_collective_census(traces, update_goldens=True)
+    assert graph_rules.check_collective_census(traces) == []
+    path = graph_rules.golden_path("tinycensus")
+    golden = json.load(open(path))
+    train = golden["steps"]["train"]
+    train["collectives"]["all_gather"] = \
+        train["collectives"].get("all_gather", 0) + 2
+    json.dump(golden, open(path, "w"))
+    findings = graph_rules.check_collective_census(traces)
+    assert any(f.severity == "error" and "all_gather" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+# -- graph rules: seeded defects --------------------------------------------
+
+def test_injected_bad_partitionspec_rule_is_caught(eight_devices,
+                                                   monkeypatch):
+    """Regression (ISSUE acceptance): a mesh-unknown axis in the sharding
+    rule table — which spec_for silently replicates — fails the validator."""
+    from homebrewnlp_tpu.parallel import sharding as shmod
+    cfg = tiny_config()
+    traces = atrace.trace_config(cfg, "tiny", steps=())
+    bad = dict(shmod.RULES)
+    bad["batch"] = "dataa"  # graftcheck: disable=partitionspec-axis
+    monkeypatch.setattr(graph_rules, "RULES", bad)
+    findings = graph_rules.check_sharding_specs(traces)
+    assert any(f.severity == "error" and "dataa" in f.message
+               for f in findings), [f.render() for f in findings]
+    # clean table passes
+    monkeypatch.setattr(graph_rules, "RULES", dict(shmod.RULES))
+    assert not [f for f in graph_rules.check_sharding_specs(traces)
+                if f.severity == "error"]
+
+
+def test_dropped_donation_is_caught(eight_devices):
+    """A train step jitted WITHOUT donate_argnums fails the donation audit;
+    the real step (donating) passes."""
+    from homebrewnlp_tpu.train.state import TrainState
+    cfg = tiny_config()
+    traces = atrace.trace_config(cfg, "tiny", steps=("train",))
+    assert graph_rules.check_donation(traces) == []
+
+    params = traces.param_shapes
+    state = TrainState(params, {}, jax.ShapeDtypeStruct((), jnp.int32))
+
+    def fake_step(state, rng):
+        return state
+
+    traced = jax.jit(fake_step).trace(state, jax.random.key(0))
+    st = atrace.StepTrace("train", traced.jaxpr, traces.mesh,
+                          traced.args_info, traced.args_info[0][0])
+    bad = atrace.ConfigTraces("tiny", cfg, traces.mesh, {"train": st},
+                              traces.param_axes, params, {})
+    findings = graph_rules.check_donation(bad)
+    assert findings and all(f.severity == "error" for f in findings)
+    assert "donate" in findings[0].message
+
+
+def test_constant_bloat_detected(eight_devices):
+    big = jnp.asarray(np.ones((512, 1024), np.float32))  # 2 MB closure
+
+    def f(x):
+        return x @ big
+
+    jaxpr = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((4, 512), jnp.float32))
+    cfg = tiny_config()
+    mesh = traces_mesh = None
+    st = atrace.StepTrace("train", jaxpr, traces_mesh)
+    traces = atrace.ConfigTraces("tiny", cfg, mesh, {"train": st}, {}, {}, {})
+    findings = graph_rules.check_constant_bloat(traces)
+    assert any(f.severity == "error" for f in findings), findings
+
+
+def test_f64_in_graph_detected(eight_devices):
+    """The jaxpr-level dtype audit flags real f64 avals (as produced when
+    x64 is enabled)."""
+    import dataclasses
+
+    def f(x):
+        return x + 1
+
+    jaxpr = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((4,), jnp.float32))
+    # forge an f64 aval on the output eqn (x64 cannot be toggled in-process)
+    eqn = jaxpr.jaxpr.eqns[-1]
+    var = eqn.outvars[0]
+    var.aval = var.aval.update(dtype=jnp.dtype("float64"))
+    cfg = tiny_config()
+    st = atrace.StepTrace("train", jaxpr, None)
+    traces = atrace.ConfigTraces("tiny", cfg, None, {"train": st}, {}, {}, {})
+    findings = graph_rules.check_dtype_promotion(traces)
+    assert findings and findings[0].severity == "error"
+    assert "f64" in findings[0].message
+
+
+# -- AST rules --------------------------------------------------------------
+
+def _mini_tree(tmp_path, models_src="", ops_src=""):
+    for rel, src in (("homebrewnlp_tpu/models/m.py", models_src),
+                     ("homebrewnlp_tpu/ops/o.py", ops_src),
+                     ("homebrewnlp_tpu/infer/__init__.py", ""),
+                     ("homebrewnlp_tpu/data/__init__.py", ""),
+                     ("homebrewnlp_tpu/optim/__init__.py", ""),
+                     ("homebrewnlp_tpu/train/__init__.py", ""),
+                     ("tools/__init__.py", "")):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+def test_ast_axis_literal_typo_caught(tmp_path):
+    root = _mini_tree(tmp_path, models_src=(
+        "from homebrewnlp_tpu.nd import NT\n"
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    a = NT(x, ('batch', 'sequnce'))\n"          # typo -> error
+        "    b = a.rename('sequence', '_sequence')\n"    # anonymized ok
+        "    return b\n"))
+    findings = ast_rules.check_axis_literals(root)
+    assert len(findings) == 1 and "sequnce" in findings[0].message
+    assert findings[0].location.endswith("m.py:4")
+
+
+def test_ast_axis_literal_suppression(tmp_path):
+    root = _mini_tree(tmp_path, models_src=(
+        "from homebrewnlp_tpu.nd import NT\n"
+        "def f(x):\n"
+        "    return NT(x, ('totally_custom',))"
+        "  # graftcheck: disable=axis-literal\n"))
+    assert ast_rules.check_axis_literals(root) == []
+
+
+def test_ast_f64_literal_caught(tmp_path):
+    root = _mini_tree(tmp_path, models_src=(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.float64)\n"))
+    findings = ast_rules.check_f64_literals(root)
+    assert len(findings) == 1 and findings[0].severity == "error"
+
+
+def test_ast_traced_rng_caught(tmp_path):
+    root = _mini_tree(tmp_path, ops_src=(
+        "import time\n"
+        "import numpy as np\n"
+        "def f(x):\n"
+        "    t = time.time()\n"
+        "    r = np.random.normal()\n"
+        "    return x + r + t\n"))
+    findings = ast_rules.check_traced_rng(root)
+    msgs = " ".join(f.message for f in findings)
+    assert len(findings) == 2 and "time.time" in msgs and "np.random" in msgs
+
+
+def test_ast_partitionspec_unknown_axis_caught(tmp_path):
+    root = _mini_tree(tmp_path, models_src=(
+        "from jax.sharding import PartitionSpec\n"
+        "SPEC = PartitionSpec('data', 'modell')\n"))
+    findings = ast_rules.check_partitionspec_literals(root)
+    assert len(findings) == 1 and "modell" in findings[0].message
+
+
+def test_ast_x_escape_ratchet(tmp_path, monkeypatch):
+    root = _mini_tree(tmp_path, models_src=(
+        "def f(t):\n    return t.x + t.x\n"))
+    golden = tmp_path / "goldens" / "ast_x_escapes.json"
+    monkeypatch.setattr(ast_rules, "x_escape_golden_path",
+                        lambda: str(golden))
+    ast_rules.check_x_escapes(root, update_goldens=True)
+    assert ast_rules.check_x_escapes(root) == []
+    # a NEW escape beyond the ratchet is an error
+    p = tmp_path / "homebrewnlp_tpu/models/m.py"
+    p.write_text(p.read_text() + "\ndef g(t):\n    return t.x\n")
+    findings = ast_rules.check_x_escapes(root)
+    assert len(findings) == 1 and findings[0].severity == "error"
+
+
+def test_ast_rules_clean_on_repo():
+    """The committed tree carries no AST-lint errors (ratchet is current)."""
+    findings = ast_rules.run_ast_rules(REPO)
+    errors = [f for f in findings if f.severity == "error"]
+    assert not errors, "\n".join(f.render() for f in errors)
+
+
+# -- findings / CLI ---------------------------------------------------------
+
+def test_worst_severity_ordering():
+    mk = lambda s: Finding("r", s, "loc", "m")
+    assert worst_severity([]) is None
+    assert worst_severity([mk("info"), mk("warning")]) == "warning"
+    assert worst_severity([mk("warning"), mk("error"), mk("info")]) == "error"
+
+
+def test_cli_ast_only_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftcheck.py"),
+         "--ast-only"], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no findings" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftcheck.py"),
+         "--list-rules"], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0
+    for rule in ("collective-census", "donation", "axis-literal"):
+        assert rule in proc.stdout
+
+
+@pytest.mark.slow
+def test_cli_all_configs_clean():
+    """The full CI gate: every bundled config audits clean in one process
+    (the ISSUE acceptance bound is 120 s on CPU)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftcheck.py"),
+         "--all-configs"], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "no findings" in proc.stdout

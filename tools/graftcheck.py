@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""graftcheck: static audit of every bundled config without touching a TPU.
+
+Abstractly traces the train / eval / decode steps of each config on CPU
+(ShapeDtypeStruct parameters — no FLOPs, no XLA compile) and runs graph rule
+passes over the jaxprs (collective census vs goldens, dtype promotion,
+donation, sharding specs, constant bloat), plus AST lint of the source tree
+(axis-literal registry, .x escape ratchet, traced RNG/time, PartitionSpec
+axes).  See docs/static_analysis.md for the rule catalogue, golden update
+workflow, and suppression syntax.
+
+Usage:
+  python tools/graftcheck.py --all-configs            # the CI gate
+  python tools/graftcheck.py --config configs/x.json  # one config
+  python tools/graftcheck.py --ast-only               # source lint only
+  python tools/graftcheck.py --all-configs --update-goldens
+Exit code: 1 if any ERROR finding (or any WARNING under --strict), else 0.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CPU + 8 virtual devices BEFORE jax import: the census goldens are defined
+# on the same virtual mesh the test suite uses (tests/conftest.py)
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--all-configs", action="store_true",
+                   help="audit every configs/*.json plus the AST rules")
+    p.add_argument("--config", action="append", default=[],
+                   help="audit one config JSON (repeatable)")
+    p.add_argument("--ast-only", action="store_true",
+                   help="run only the source-tree AST rules")
+    p.add_argument("--graph-only", action="store_true",
+                   help="skip the AST rules")
+    p.add_argument("--steps", default="train,decode",
+                   help="comma list of steps to trace (train,eval,decode)")
+    p.add_argument("--rules", default=None,
+                   help="comma list restricting which rules run")
+    p.add_argument("--update-goldens", action="store_true",
+                   help="re-record census + ratchet goldens from this tree")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings also fail the run")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--list-rules", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from homebrewnlp_tpu import analysis
+    if args.list_rules:
+        for r in analysis.GRAPH_RULES:
+            print(f"graph  {r}")
+        for r in analysis.AST_RULES:
+            print(f"ast    {r}")
+        return 0
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = sorted(set(rules) - set(analysis.ALL_RULES))
+        if unknown:
+            print(f"unknown rule(s) {', '.join(unknown)}; valid: "
+                  f"{', '.join(analysis.ALL_RULES)}", file=sys.stderr)
+            return 2
+    steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
+    unknown_steps = sorted(set(steps) - {"train", "eval", "decode"})
+    if unknown_steps:
+        print(f"unknown step(s) {', '.join(unknown_steps)}; valid: "
+              f"train, eval, decode", file=sys.stderr)
+        return 2
+    config_paths = list(args.config)
+    if args.all_configs:
+        config_paths += sorted(glob.glob(os.path.join(REPO, "configs", "*.json")))
+    if not config_paths and not args.ast_only:
+        print("nothing to do: pass --all-configs, --config, or --ast-only",
+              file=sys.stderr)
+        return 2
+
+    findings = []
+    t0 = time.time()
+    if not args.ast_only:
+        import jax  # noqa: F401  (env is pinned above)
+        from homebrewnlp_tpu.config import Config
+        for path in config_paths:
+            name = os.path.splitext(os.path.basename(path))[0]
+            with open(path) as f:
+                raw = json.load(f)
+            raw.pop("_comment", None)
+            t1 = time.time()
+            try:
+                cfg = Config(raw)
+            except Exception as e:
+                findings.append(analysis.Finding(
+                    "config", "error", path,
+                    f"config failed to load: {type(e).__name__}: {e}"))
+                continue
+            traces = analysis.trace_config(cfg, name, steps=steps)
+            findings.extend(analysis.run_graph_rules(
+                traces, update_goldens=args.update_goldens, rules=rules))
+            if not args.as_json:
+                print(f"[graftcheck] {name}: "
+                      f"{', '.join(sorted(traces.steps)) or 'no steps'} "
+                      f"({time.time() - t1:.1f}s)", file=sys.stderr)
+    if not args.graph_only:
+        # the AST ratchet golden is tree-wide: only re-record it on a
+        # tree-wide run (--all-configs / --ast-only), never as a side effect
+        # of updating one config's census budget
+        ast_update = args.update_goldens and (args.all_configs or args.ast_only)
+        findings.extend(analysis.run_ast_rules(
+            REPO, update_goldens=ast_update, rules=rules))
+
+    print(analysis.render_report(findings, as_json=args.as_json))
+    if not args.as_json:
+        print(f"[graftcheck] total {time.time() - t0:.1f}s", file=sys.stderr)
+    worst = analysis.worst_severity(findings)
+    if worst == "error" or (args.strict and worst == "warning"):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
