@@ -15,8 +15,9 @@ them BEFORE compilation, on CPU, in seconds:
   donation audit, sharding-spec validation, constant-bloat check.
 - :mod:`~homebrewnlp_tpu.analysis.ast_rules` lints the source tree for the
   ``NT`` named-axis discipline: axis literals against the nd registry,
-  ``.x`` escape ratchet, Python-side RNG/time in traced code, and
-  ``PartitionSpec`` literals naming unknown mesh axes.
+  ``.x`` escape ratchet, Python-side RNG/time in traced code,
+  ``PartitionSpec`` literals naming unknown mesh axes, and the host-sync
+  ratchet (no blocking device->host reads inside the async train loop).
 
 Entry point: ``python tools/graftcheck.py --all-configs`` (see
 docs/static_analysis.md).
@@ -30,5 +31,5 @@ GRAPH_RULES = ("collective-census", "dtype-promotion", "donation",
                "sharding-spec", "constant-bloat")
 # "dtype-promotion" appears in both: the AST pass carries its static twin
 AST_RULES = ("axis-literal", "x-escape", "traced-rng", "partitionspec-axis",
-             "dtype-promotion")
+             "dtype-promotion", "host-sync")
 ALL_RULES = tuple(dict.fromkeys(GRAPH_RULES + AST_RULES))

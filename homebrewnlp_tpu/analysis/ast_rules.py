@@ -18,6 +18,13 @@ Static source rules (no tracing, no jax beyond the axis registry import):
 - ``partitionspec-axis``: ``PartitionSpec`` literals may only name mesh axes
   that exist (parallel/mesh.py MESH_AXES); an unknown axis is silently
   treated as replicated by the sharding machinery.
+- ``host-sync``: no blocking device->host reads (``int()``/``float()``/
+  ``.item()``/``block_until_ready``) inside the step loop of ``train()`` —
+  the async-dispatch loop (main.py, docs/performance.md) computes step
+  indices on host and drains metrics through a deferred window; one stray
+  ``float(loss)`` re-serializes every step.  Ratcheted like ``x-escape``:
+  per-file counts pinned in ``goldens/ast_host_sync.json`` may only go
+  down.
 
 Suppression: append ``# graftcheck: disable=<rule>`` (or a bare
 ``# graftcheck: disable``) to the offending line.
@@ -196,37 +203,108 @@ def x_escape_golden_path() -> str:
                         "goldens", "ast_x_escapes.json")
 
 
-def check_x_escapes(root: str, update_goldens: bool = False
-                    ) -> typing.List[Finding]:
-    counts = x_escape_counts(root)
-    path = x_escape_golden_path()
+def _check_ratchet(rule: str, counts: typing.Dict[str, int], path: str,
+                   update_goldens: bool, unit: str, over_hint: str
+                   ) -> typing.List[Finding]:
+    """Shared golden-ratchet machinery for per-file count rules (x-escape,
+    host-sync): counts pinned in a committed golden may only go DOWN; a
+    count above the golden is an error (with ``over_hint`` naming the fix),
+    below is an info asking to re-record; ``--update-goldens`` re-records."""
     if update_goldens:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "w") as f:
             json.dump(counts, f, indent=2, sort_keys=True)
             f.write("\n")
-        return [Finding("x-escape", "info", path,
-                        f"ratchet updated ({sum(counts.values())} escapes in "
+        return [Finding(rule, "info", path,
+                        f"ratchet updated ({sum(counts.values())} {unit} in "
                         f"{len(counts)} files)")]
     if not os.path.exists(path):
-        return [Finding("x-escape", "error", path,
-                        "no x-escape ratchet golden; run --update-goldens")]
+        return [Finding(rule, "error", path,
+                        f"no {rule} ratchet golden; run --update-goldens")]
     golden = json.load(open(path))
     findings: typing.List[Finding] = []
     for rel in sorted(set(counts) | set(golden)):
         got, want = counts.get(rel, 0), golden.get(rel, 0)
         if got > want:
             findings.append(Finding(
-                "x-escape", "error", rel,
-                f"{got} raw .x escapes (ratchet allows {want}) — keep model "
-                f"code in the named-axis algebra, or re-record with "
-                f"--update-goldens if the new escapes are deliberate"))
+                rule, "error", rel,
+                f"{got} {unit} (ratchet allows {want}) — {over_hint}, or "
+                f"re-record with --update-goldens if deliberate"))
         elif got < want:
             findings.append(Finding(
-                "x-escape", "info", rel,
-                f".x escapes improved {want} -> {got}; re-record the ratchet "
+                rule, "info", rel,
+                f"{unit} improved {want} -> {got}; re-record the ratchet "
                 f"with --update-goldens"))
     return findings
+
+
+def check_x_escapes(root: str, update_goldens: bool = False
+                    ) -> typing.List[Finding]:
+    return _check_ratchet(
+        "x-escape", x_escape_counts(root), x_escape_golden_path(),
+        update_goldens, unit="raw .x escapes",
+        over_hint="keep model code in the named-axis algebra")
+
+
+#: files whose ``train()`` step loop the host-sync rule audits
+HOST_SYNC_SCOPE = ("homebrewnlp_tpu/main.py",)
+#: builtins whose call on a device value forces a D2H sync
+HOST_SYNC_CALLS = frozenset({"int", "float", "bool"})
+#: method names that force a D2H sync (or a full-device barrier)
+HOST_SYNC_METHODS = frozenset({"item", "block_until_ready"})
+
+
+def host_sync_counts(root: str) -> typing.Dict[str, int]:
+    """Per-file counts of potentially-blocking host reads inside loop bodies
+    of functions named ``train``.  Purely syntactic (no type inference): any
+    ``int(...)``/``float(...)``/``bool(...)`` call or ``.item()``/
+    ``.block_until_ready()`` method call in the loop counts — host-only
+    arithmetic belongs outside the loop or behind a suppression, which is
+    exactly the ratchet discipline."""
+    counts: typing.Dict[str, int] = {}
+    for path, rel in _iter_py_files(root, HOST_SYNC_SCOPE):
+        src = open(path).read()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        hits: typing.Set[int] = set()  # node ids: nested loops walk twice
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name != "train":
+                continue
+            for loop in ast.walk(fn):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    sync = ((isinstance(node.func, ast.Name)
+                             and node.func.id in HOST_SYNC_CALLS)
+                            or (isinstance(node.func, ast.Attribute)
+                                and node.func.attr in HOST_SYNC_METHODS))
+                    if sync and not _suppressed(lines, node.lineno,
+                                               "host-sync"):
+                        hits.add(id(node))
+        if hits:
+            counts[rel.replace(os.sep, "/")] = len(hits)
+    return counts
+
+
+def host_sync_golden_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "goldens", "ast_host_sync.json")
+
+
+def check_host_sync(root: str, update_goldens: bool = False
+                    ) -> typing.List[Finding]:
+    return _check_ratchet(
+        "host-sync", host_sync_counts(root), host_sync_golden_path(),
+        update_goldens,
+        unit="blocking device->host read(s) inside train()'s step loop",
+        over_hint="int()/float()/.item()/block_until_ready re-serializes "
+                  "the async-dispatch loop (docs/performance.md); compute "
+                  "step indices on host and route metrics through the "
+                  "deferred drain")
 
 
 def check_traced_rng(root: str) -> typing.List[Finding]:
@@ -361,6 +439,7 @@ def run_ast_rules(root: str, update_goldens: bool = False,
         # static twin of graph_rules.check_dtype_promotion (x64-off traces
         # cannot carry real f64 avals, so the request itself is linted)
         "dtype-promotion": lambda: check_f64_literals(root),
+        "host-sync": lambda: check_host_sync(root, update_goldens),
     }
     findings: typing.List[Finding] = []
     for name, fn in table.items():

@@ -179,6 +179,15 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     blocked_causal_map=0,
     debug_train_step=False,
     debug_gradients=False,
+    # async-dispatch step loop (main.py, docs/performance.md): up to N
+    # dispatched-but-undrained updates may be in flight before the loop
+    # blocks on the oldest one's metrics.  0 (or debug_train_step) drains
+    # every step synchronously — the parity-reference path.
+    async_inflight_steps=2,
+    # device-side batch prefetch (data/feed.py::DeviceFeeder): a background
+    # thread assembles + H2D-transfers up to N upcoming global batches while
+    # the current step runs.  0 assembles inline on the critical path.
+    device_prefetch_depth=1,
     current_step=0,
     steps_per_checkpoint=100_000,
     use_checkpointing=False,
@@ -275,6 +284,12 @@ class Config:
         # micro-batches per optimizer update (train/state.py).
         assert self.macro_batching > 0
         assert self.grad_accumulation > 0
+        if self.async_inflight_steps < 0:
+            raise ValueError("async_inflight_steps must be >= 0 "
+                             "(0 = synchronous drain every step)")
+        if self.device_prefetch_depth < 0:
+            raise ValueError("device_prefetch_depth must be >= 0 "
+                             "(0 = inline batch assembly)")
 
         for attr in ("position_embedding", "token_embedding", "output_embedding",
                      "empty_frame_embedding"):

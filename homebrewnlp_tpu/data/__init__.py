@@ -4,7 +4,7 @@ mixtures, host-sharded device feeding, deterministic resume.
 JAX re-design of the reference's tf.data stack (/root/reference/src/inputs.py,
 src/run/dataloader_placement.py) — see pipeline.py for the parity map.
 """
-from .feed import to_global  # noqa: F401
+from .feed import DeviceFeeder, to_global  # noqa: F401
 from .pipeline import (GptPipeline, JannetTextPipeline, MixturePipeline,  # noqa: F401
                        dataset, split_files)
 from .resume import RunLog, skips_for_restart  # noqa: F401

@@ -10,6 +10,8 @@ the same host-locality the reference's placement logic hand-computed.
 from __future__ import annotations
 
 import functools
+import queue as queuelib
+import threading
 import typing
 
 import jax
@@ -131,3 +133,115 @@ def to_global(batch: typing.Dict[str, np.ndarray], cfg: Config, mesh: Mesh
         x = jax.make_array_from_callback(global_shape, sharding, cb)
         out[name] = NT(x, names)
     return out
+
+
+class DeviceFeeder:
+    """Host->device double buffer for the async step loop (main.py).
+
+    A background thread pulls the NEXT host batch from ``source``, snapshots
+    the host pipeline's cursor (``state_fn``), assembles the global device
+    batch (``to_global`` — the H2D transfer), and parks it in a bounded
+    queue of ``depth`` entries, so batch assembly never sits on the critical
+    path between steps.  ``depth=0`` disables the thread and assembles
+    inline — the synchronous parity-reference path.
+
+    Checkpoint-cursor semantics: ``state_dict`` always reflects the last
+    batch HANDED TO THE CONSUMER, never batches prefetched into the queue —
+    each queue entry carries the cursor snapshot taken right after its batch
+    left the host pipeline, and the snapshot only becomes ``state_dict``'s
+    answer when the consumer receives that batch.  A checkpoint written
+    after update N therefore resumes the stream at batch N+1 regardless of
+    how far ahead the producer ran.
+
+    Exhaustion and errors propagate to the consumer: the producer parks a
+    sentinel, ``__next__`` raises ``StopIteration`` (or the producer's
+    exception), and ``close()`` always leaves the thread joined — a full
+    queue cannot strand it (puts poll the stop flag)."""
+
+    _DONE = object()
+
+    def __init__(self, source: typing.Iterable, cfg: Config, mesh: Mesh,
+                 depth: int = 1,
+                 state_fn: typing.Optional[typing.Callable[[], dict]] = None):
+        self.source = iter(source)
+        self.cfg = cfg
+        self.mesh = mesh
+        self.depth = int(depth)
+        self.state_fn = state_fn
+        self._state: dict = state_fn() if state_fn is not None else {}
+        self._err: typing.List[BaseException] = []
+        self._thread: typing.Optional[threading.Thread] = None
+        self._queue: typing.Optional[queuelib.Queue] = None
+        self._stop = threading.Event()
+        if self.depth > 0:
+            self._queue = queuelib.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(target=self._produce,
+                                            name="device-feeder", daemon=True)
+            self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except queuelib.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    np_batch = next(self.source)
+                except StopIteration:
+                    break
+                snap = self.state_fn() if self.state_fn is not None else None
+                gb = to_global(np_batch, self.cfg, self.mesh)
+                if not self._put((gb, snap)):
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            self._err.append(e)
+        self._put((self._DONE, None))
+
+    def __iter__(self) -> "DeviceFeeder":
+        return self
+
+    def __next__(self) -> typing.Dict[str, NT]:
+        if self._queue is None:  # depth 0: inline, synchronous
+            np_batch = next(self.source)  # StopIteration propagates
+            snap = self.state_fn() if self.state_fn is not None else None
+            gb = to_global(np_batch, self.cfg, self.mesh)
+            if snap is not None:
+                self._state = snap
+            return gb
+        item, snap = self._queue.get()
+        if item is self._DONE:
+            if self._err:
+                raise self._err[0]
+            raise StopIteration
+        if snap is not None:
+            self._state = snap
+        return item
+
+    def state_dict(self) -> dict:
+        """Cursor of the last CONSUMED batch (see class docstring)."""
+        return dict(self._state)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the producer and join it; safe to call repeatedly.
+
+        Unlike ``Prefetcher.close`` no consumer-wake sentinel is needed:
+        close() is called BY the consumer thread, so nothing can be parked
+        on ``get()`` while it runs.  A producer blocked on the SOURCE
+        (e.g. the host-prefetch queue) is woken by closing the source
+        first — main.py closes the pipe before the feeder."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        try:  # unjam a put-blocked producer so it can see the stop flag
+            while True:
+                self._queue.get_nowait()
+        except queuelib.Empty:
+            pass
+        self._thread.join(timeout)
+        self._thread = None

@@ -397,7 +397,10 @@ class Prefetcher:
     Resume stays exact: the producer snapshots the inner pipeline's cursor
     *after* producing each batch and attaches it to the queue entry, so
     ``state_dict`` reflects the last batch actually handed to the consumer —
-    batches still sitting in the queue are not lost."""
+    batches still sitting in the queue are not lost.  ``close()`` stops and
+    joins the producer even when it is parked on a full queue (puts poll the
+    stop flag), so an abandoning consumer — e.g. the async train loop's
+    DeviceFeeder shutting down mid-stream — never strands the thread."""
 
     _DONE = object()
 
@@ -407,23 +410,34 @@ class Prefetcher:
         self._state = getattr(inner, "state_dict", dict)()
         self._thread = None
         self._queue = None
+        self._stop = None
 
     def __iter__(self):
         import queue as queuelib
         import threading
 
         self._queue = queuelib.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
         err: typing.List[BaseException] = []
+
+        def put(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(item, timeout=0.05)
+                    return True
+                except queuelib.Full:
+                    continue
+            return False
 
         def produce():
             try:
                 for item in self.inner:
-                    self._queue.put(
-                        (item, getattr(self.inner, "state_dict", dict)()))
+                    if not put((item, getattr(self.inner, "state_dict",
+                                              dict)())):
+                        return
             except BaseException as e:  # surfaced on the consumer side
                 err.append(e)
-            finally:
-                self._queue.put((self._DONE, None))
+            put((self._DONE, None))
 
         self._thread = threading.Thread(target=produce, daemon=True)
         self._thread.start()
@@ -443,6 +457,34 @@ class Prefetcher:
         if hasattr(self.inner, "load_state_dict"):
             self.inner.load_state_dict(state)
         self._state = dict(state)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop and join the producer thread; safe to call repeatedly."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        import queue as queuelib
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queuelib.Empty:
+            pass
+        # wake a consumer parked on the queue.  Bounded retry: a producer
+        # put that entered before _stop was set can land in the freshly
+        # drained queue and swallow a single-shot sentinel — re-drain and
+        # retry until the sentinel sticks (the producer is stopping, so
+        # this terminates after at most one in-flight item per slot)
+        for _ in range(100):
+            try:
+                self._queue.put_nowait((self._DONE, None))
+                break
+            except queuelib.Full:
+                try:
+                    self._queue.get_nowait()
+                except queuelib.Empty:
+                    pass
+        self._thread.join(timeout)
+        self._thread = None
 
 
 def dataset(cfg: Config, sub_batch_size: int, slice_index: int = 0,

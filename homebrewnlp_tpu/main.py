@@ -77,10 +77,19 @@ def _dump_run_artifacts(cfg, trainer, params) -> None:
 
 
 def train(cfg, args) -> None:
+    """Async-dispatch step loop (docs/performance.md): step indices are
+    computed ON HOST (``step0 + (u - u0) * m`` — no device value is read on
+    the hot path; graftcheck's ``host-sync`` rule pins this), batches are
+    assembled + transferred by a background ``DeviceFeeder`` thread, and
+    metrics drain through a bounded ``AsyncMetricWriter`` window so up to
+    ``cfg.async_inflight_steps`` updates stay dispatched-but-undrained."""
+    import itertools
+
     import jax
     from .data import RunLog, dataset, to_global
+    from .data.feed import DeviceFeeder
     from .data.synthetic import synthetic_text_batch
-    from .train import MetricWriter, color_print
+    from .train import AsyncMetricWriter, MetricWriter, color_print
 
     have_data = _have_dataset_files(cfg)
     from .parallel import make_mesh
@@ -120,14 +129,12 @@ def train(cfg, args) -> None:
         pipe = dataset(cfg, local_batch, slice_index, slice_count)
         if data_state and "pipeline" in data_state:
             pipe.load_state_dict(data_state["pipeline"])
-        batches = iter(pipe)
-        first_np = next(batches)
-    elif step0:
-        # synthetic batches are indexed by UPDATE count (the loop below)
-        first_np = synthetic_text_batch(cfg, step0 // max(1, cfg.macro_batching))
 
     _dump_run_artifacts(cfg, trainer, state.params)
-    writer = MetricWriter(cfg.model_path)
+    # deferred metrics drain: debug_train_step keeps the reference's
+    # synchronous per-step prints, so it forces the window to 0
+    window = 0 if cfg.debug_train_step else cfg.async_inflight_steps
+    writer = AsyncMetricWriter(MetricWriter(cfg.model_path), window=window)
     run_log = RunLog(cfg.model_path)
     # train_steps (and the step counter) count macro slices, reference
     # run.py:155,249: one optimizer update advances the counter by
@@ -139,63 +146,98 @@ def train(cfg, args) -> None:
     ckpt_every = max(1, cfg.steps_per_checkpoint // m)
     rng = jax.random.key(cfg.data_seed)
     t0 = time.time()
-    np_batch = first_np
+    # device prefetch: the feeder's cursor snapshots ride each batch, so
+    # checkpoints record CONSUMED stream position only (DeviceFeeder doc);
+    # synthetic batches stay indexed by UPDATE count, as before
+    if pipe is not None:
+        source, state_fn = iter(pipe), pipe.state_dict
+    else:
+        source = (synthetic_text_batch(cfg, i) for i in itertools.count(u0))
+        state_fn = None
+    feeder = DeviceFeeder(source, cfg, trainer.mesh,
+                          depth=cfg.device_prefetch_depth, state_fn=state_fn)
     profile_window = range(u0 + 3, u0 + 6)  # steady state: past compile
     tracing = False
-    for u in range(u0, updates_total):
-        if args.profile and u == profile_window.start:
-            jax.profiler.start_trace(args.profile)
-            tracing = True
-        gb = to_global(np_batch, cfg, trainer.mesh)
-        state, metrics = trainer.step(state, gb, jax.random.fold_in(rng, u))
-        if tracing and u >= profile_window.stop:
-            jax.block_until_ready(metrics["loss"])
-            jax.profiler.stop_trace()
-            tracing = False
-            color_print(f"profiler trace written to {args.profile}")
-        writer.write(int(state.step) - m, metrics)
-        if cfg.debug_train_step or (u + 1) % 10 == 0:
-            # debug_train_step: per-step prints (reference run.py:252-261)
-            rate = (u + 1 - u0) / (time.time() - t0)
-            color_print(f"step {int(state.step)} "
-                        f"loss {float(metrics['loss']):.4f} "
-                        f"({rate:.2f} updates/s)")
-        if ckpt is not None and (u + 1) % ckpt_every == 0:
-            data_state = ({"pipeline": pipe.state_dict()} if pipe is not None
-                          else None)
-            ckpt.save(state, data_state, master_dtype=cfg.storage_dtype)
-        if pipe is not None:
+    u_done = u0  # updates actually dispatched (exhaustion can end early)
+    try:
+        for u in range(u0, updates_total):
             try:
-                np_batch = next(batches)
+                gb = next(feeder)
             except StopIteration:
                 # single-epoch dataset exhausted (the reference's sequential
                 # reader dies on OutOfRange here, inputs.py:540-541): stop
                 # CLEANLY — final checkpoint below, clear message, no
                 # traceback.  Set repeat_dataset=true for deterministic
                 # epoch wrap-around.
-                color_print(f"dataset exhausted after update {u + 1} "
-                            f"(step {int(state.step)}); stopping — set "
+                color_print(f"dataset exhausted after update {u} "
+                            f"(step {step0 + (u - u0) * m}); stopping — set "
                             "repeat_dataset=true for multi-epoch runs")
                 break
-        else:
-            np_batch = synthetic_text_batch(cfg, u + 1)
+            if args.profile and u == profile_window.start:
+                jax.profiler.start_trace(args.profile)
+                tracing = True
+            state, metrics = trainer.step(state, gb,
+                                          jax.random.fold_in(rng, u))
+            host_step = step0 + (u - u0) * m  # counter BEFORE this update
+            u_done = u + 1
+            writer.write(host_step, metrics)
+            if tracing and u >= profile_window.stop:
+                # drain the whole in-flight window (blocks until every
+                # dispatched step finished) so the trace captures complete
+                # steps, then stop
+                writer.flush()
+                jax.profiler.stop_trace()
+                tracing = False
+                color_print(f"profiler trace written to {args.profile}")
+            if cfg.debug_train_step or (u + 1) % 10 == 0:
+                # debug_train_step: per-step prints (reference run.py:252-261)
+                # showing the most recent COMPLETED loss — never a blocking
+                # read of the in-flight one
+                rate = (u + 1 - u0) / (time.time() - t0)
+                loss_s = ("..." if writer.last_loss is None
+                          else f"{writer.last_loss:.4f}")
+                color_print(f"step {host_step + m} loss {loss_s} "
+                            f"({rate:.2f} updates/s)")
+            if ckpt is not None and (u + 1) % ckpt_every == 0:
+                writer.flush()  # metrics.jsonl consistent with the checkpoint
+                data_state = ({"pipeline": feeder.state_dict()}
+                              if pipe is not None else None)
+                ckpt.save(state, data_state, master_dtype=cfg.storage_dtype)
+    finally:
+        # pipe first: its close() wakes a feeder producer blocked on the
+        # host-prefetch queue, so the feeder join below cannot stall
+        if pipe is not None and hasattr(pipe, "close"):
+            pipe.close()
+        feeder.close()
+        try:
+            # an exception exit (OOM, NaN guard, Ctrl-C) must still persist
+            # the in-flight window's COMPLETED updates — those are exactly
+            # the losses a post-mortem needs
+            writer.flush()
+        except Exception:
+            pass  # the failing step's own metrics may be unmaterializable
     if tracing:  # run ended inside the profile window
-        jax.block_until_ready(metrics["loss"])
+        writer.flush()
         jax.profiler.stop_trace()
         color_print(f"profiler trace written to {args.profile}")
     if ckpt is not None:
-        ckpt.save(state, {"pipeline": pipe.state_dict()} if pipe else None,
+        ckpt.save(state, {"pipeline": feeder.state_dict()} if pipe else None,
                   master_dtype=cfg.storage_dtype)
         ckpt.wait()
     # rows consumed per update = batch * macro_batching (grad_accumulation
-    # only splits the delivered batch, it does not consume more data)
-    run_log.append(steps=updates_total - u0, batch_size=cfg.train_batch_size,
+    # only splits the delivered batch, it does not consume more data);
+    # record DISPATCHED updates so exhaustion-shortened runs replay right
+    run_log.append(steps=u_done - u0, batch_size=cfg.train_batch_size,
                    slice_count=slice_count, ctx=cfg.sequence_length,
                    grad_accumulation=cfg.macro_batching,
                    interleave_size=cfg.interleaved_datasets,
                    token_patch_size=cfg.token_patch_size)
     run_log.save()
-    writer.close()
+    writer.close()  # drains any remaining window entries first
+    if u_done > u0:
+        color_print(f"trained {u_done - u0} updates; host blocked "
+                    f"{writer.host_blocked_s:.2f}s in metric drains "
+                    f"(window {window})")
 
 
 def _params_for_serving(cfg):

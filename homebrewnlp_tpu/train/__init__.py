@@ -7,4 +7,4 @@ outside-compilation summaries become ordinary step outputs (metrics.py).
 """
 from .state import Trainer, TrainState  # noqa: F401
 from .checkpoint import Checkpointer, current_step  # noqa: F401
-from .metrics import MetricWriter, color_print  # noqa: F401
+from .metrics import AsyncMetricWriter, MetricWriter, color_print  # noqa: F401

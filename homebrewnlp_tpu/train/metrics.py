@@ -10,6 +10,7 @@ else JSONL only (works everywhere, greppable, and what bench.py parses).
 """
 from __future__ import annotations
 
+import collections
 import datetime
 import json
 import os
@@ -45,8 +46,12 @@ class MetricWriter:
         except Exception:
             pass
 
-    def write(self, step: int, metrics: typing.Dict[str, typing.Any]) -> None:
-        now = time.time()
+    def write(self, step: int, metrics: typing.Dict[str, typing.Any],
+              wall_time: typing.Optional[float] = None) -> None:
+        """``wall_time``: when the step was DISPATCHED (the deferred drain
+        below writes entries later; step_seconds must reflect the training
+        cadence, not the drain cadence)."""
+        now = time.time() if wall_time is None else wall_time
         scalars = {}
         hists = {}
         for k, v in metrics.items():
@@ -88,7 +93,71 @@ class MetricWriter:
                     bucket_limits=limits.tolist(),
                     bucket_counts=counts.tolist(), global_step=step)
 
+    def flush(self) -> None:
+        self._f.flush()
+
     def close(self) -> None:
         self._f.close()
         if self._tb is not None:
             self._tb.close()
+
+
+class AsyncMetricWriter:
+    """Deferred metrics drain for the async-dispatch step loop (main.py,
+    docs/performance.md).
+
+    ``write`` only enqueues the step's still-on-device metrics; entries are
+    materialized (the blocking device->host transfer) when they fall out of
+    the bounded ``window`` — so the loop never synchronizes on the step it
+    just dispatched, and up to ``window`` updates stay in flight.
+    ``window=0`` drains every step immediately (the synchronous parity
+    path).
+
+    - ``last_loss``: loss of the most recent COMPLETED (drained) step — what
+      progress prints show, never blocking on in-flight work.
+    - ``host_blocked_s``: accumulated wall time inside the blocking
+      device->host conversions (main.py prints it in the end-of-run
+      summary; bench.py reports its own per-window figure).
+    - ``flush()``: drain everything — called at checkpoints, before
+      ``jax.profiler.stop_trace`` (so traces capture whole steps), and on
+      exit.  Because draining the newest entry blocks until its metrics are
+      ready, a returned ``flush()`` implies every dispatched step finished.
+    """
+
+    def __init__(self, writer: MetricWriter, window: int = 2):
+        self.writer = writer
+        self.window = max(0, int(window))
+        self._pending: typing.Deque[typing.Tuple[int, float, dict]] = \
+            collections.deque()
+        self.last_loss: typing.Optional[float] = None
+        self.host_blocked_s = 0.0
+
+    def write(self, step: int, metrics: typing.Dict[str, typing.Any]) -> None:
+        self._pending.append((step, time.time(), metrics))
+        while len(self._pending) > self.window:
+            self._drain_one()
+
+    def _drain_one(self) -> None:
+        step, wall, metrics = self._pending.popleft()
+        t0 = time.perf_counter()
+        host = {}
+        for k, v in metrics.items():
+            try:
+                host[k] = np.asarray(v)  # blocks until the step completed
+            except Exception:
+                host[k] = v
+        self.host_blocked_s += time.perf_counter() - t0
+        loss = host.get("loss")
+        if loss is not None and getattr(loss, "size", 0) == 1:
+            self.last_loss = float(loss)
+        self.writer.write(step, host, wall_time=wall)
+
+    def flush(self) -> None:
+        while self._pending:
+            self._drain_one()
+        self.writer.flush()
+
+    def close(self) -> None:
+        while self._pending:
+            self._drain_one()
+        self.writer.close()

@@ -213,11 +213,14 @@ class Trainer:
                 return s
 
             gnorm = jnp.sqrt(sum(norm_sq(k, g) for k, g in grads.items()))
+            # no "step" entry: the loop computes step indices on host
+            # (main.py async dispatch) — shipping the device counter back
+            # every update is a needless D2H scalar the metric writer would
+            # overwrite anyway
             metrics.update({
                 "loss": loss,
                 "learning_rate": lr,
                 "grad_norm": gnorm,
-                "step": state.step,
             })
             if cfg.debug_gradients:
                 # per-variable gradient norms + log2-magnitude histograms

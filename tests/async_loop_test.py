@@ -1,0 +1,179 @@
+"""Async-dispatch training loop (ISSUE 2): the CPU smoke test the CI step
+runs (5 synthetic updates through the real CLI train path, guarding thread
+shutdown and exit paths), sync-vs-async loss-sequence parity, the deferred
+metric drain's window semantics, the profiler's drain-before-stop_trace
+interaction, and checkpoint resume under device prefetch."""
+import argparse
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from homebrewnlp_tpu import main as cli
+from homebrewnlp_tpu.data.synthetic import write_text_tfrecords
+
+from .backend import tiny_config
+
+
+def _args(steps, profile=""):
+    return argparse.Namespace(steps=steps, profile=profile, workers=None)
+
+
+def _metric_rows(model_path):
+    with open(os.path.join(model_path, "metrics.jsonl")) as f:
+        return [json.loads(line) for line in f]
+
+
+def _feeder_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "device-feeder" and t.is_alive()]
+
+
+def test_async_train_smoke_synthetic(tmp_path, eight_devices):
+    """The CI smoke: 5 synthetic-data updates through the async loop —
+    host-computed step indices land in metrics.jsonl, losses are finite,
+    and the feeder thread is joined on exit."""
+    cfg = tiny_config(model_path=str(tmp_path), async_inflight_steps=2,
+                      device_prefetch_depth=1)
+    cli.train(cfg, _args(5))
+    rows = _metric_rows(str(tmp_path))
+    assert [r["step"] for r in rows] == [0, 1, 2, 3, 4]
+    assert all(np.isfinite(r["loss"]) for r in rows)
+    assert not _feeder_threads()
+
+
+def test_async_loss_sequence_matches_sync(tmp_path, eight_devices):
+    """Acceptance: prefetch + async dispatch must produce the IDENTICAL loss
+    sequence (same values, same order) as the synchronous path."""
+    sync_cfg = tiny_config(model_path=str(tmp_path / "sync"),
+                           async_inflight_steps=0, device_prefetch_depth=0)
+    cli.train(sync_cfg, _args(8))
+    async_cfg = tiny_config(model_path=str(tmp_path / "async"),
+                            async_inflight_steps=3, device_prefetch_depth=2)
+    cli.train(async_cfg, _args(8))
+    sync_rows = _metric_rows(str(tmp_path / "sync"))
+    async_rows = _metric_rows(str(tmp_path / "async"))
+    assert [r["step"] for r in sync_rows] == [r["step"] for r in async_rows]
+    assert [r["loss"] for r in sync_rows] == [r["loss"] for r in async_rows]
+
+
+@pytest.mark.slow
+def test_async_loss_parity_300_steps(tmp_path, eight_devices):
+    """Full acceptance length: 300 synthetic updates, identical loss
+    sequence with prefetch + async enabled vs. the synchronous path."""
+    sync_cfg = tiny_config(model_path=str(tmp_path / "sync"),
+                           async_inflight_steps=0, device_prefetch_depth=0)
+    cli.train(sync_cfg, _args(300))
+    async_cfg = tiny_config(model_path=str(tmp_path / "async"),
+                            async_inflight_steps=2, device_prefetch_depth=1)
+    cli.train(async_cfg, _args(300))
+    sync_rows = _metric_rows(str(tmp_path / "sync"))
+    async_rows = _metric_rows(str(tmp_path / "async"))
+    assert len(sync_rows) == len(async_rows) == 300
+    assert [r["loss"] for r in sync_rows] == [r["loss"] for r in async_rows]
+
+
+def test_profile_drains_inflight_window(tmp_path, eight_devices):
+    """--profile under async dispatch: the in-flight window drains before
+    stop_trace (whole steps in the trace) and the run completes with every
+    step's metrics written."""
+    trace_dir = str(tmp_path / "trace")
+    cfg = tiny_config(model_path=str(tmp_path / "run"),
+                      async_inflight_steps=4, device_prefetch_depth=1)
+    cli.train(cfg, _args(8, profile=trace_dir))
+    assert os.path.isdir(trace_dir)
+    assert any(files for _, _, files in os.walk(trace_dir))
+    assert [r["step"] for r in _metric_rows(str(tmp_path / "run"))] == \
+        list(range(8))
+    assert not _feeder_threads()
+
+
+def test_dataset_exhaustion_stops_cleanly(tmp_path, eight_devices, capsys):
+    """StopIteration propagates through feeder + loop: the exhaustion
+    message fires, metrics cover exactly the completed updates, no feeder
+    thread survives."""
+    paths_dir = tmp_path / "data"
+    # 1 file x 1 record x 70 tokens, window 17/shift 16 -> 4 windows -> two
+    # 2-row batches before exhaustion
+    write_text_tfrecords(str(paths_dir), n_files=1, records_per_file=1,
+                         tokens_per_record=70, seed=3)
+    cfg = tiny_config(model_path=str(tmp_path / "run"), vocab_size=256,
+                      interleaved_datasets=1, async_inflight_steps=2,
+                      device_prefetch_depth=2, dataset_configs=[
+                          {"type": "text",
+                           "path": str(paths_dir / "*.tfrecord")}])
+    cli.train(cfg, _args(10))
+    out = capsys.readouterr().out
+    assert "dataset exhausted" in out
+    assert [r["step"] for r in _metric_rows(str(tmp_path / "run"))] == [0, 1]
+    assert not _feeder_threads()
+
+
+def test_checkpoint_resume_under_prefetch(tmp_path, eight_devices):
+    """Save/restore round-trip under device prefetch depth 2: the cursor
+    records CONSUMED batches only, so the resumed run's losses equal the
+    uninterrupted run's — model state AND data stream both land exactly."""
+    paths_dir = tmp_path / "data"
+    write_text_tfrecords(str(paths_dir), n_files=2, records_per_file=2,
+                         tokens_per_record=200, seed=7)
+    dsets = [{"type": "text", "path": str(paths_dir / "*.tfrecord")}]
+
+    def run(model_path, steps):
+        cfg = tiny_config(model_path=model_path, dataset_configs=dsets,
+                          vocab_size=256, interleaved_datasets=2,
+                          use_checkpointing=True, steps_per_checkpoint=3,
+                          async_inflight_steps=2, device_prefetch_depth=2)
+        cli.train(cfg, _args(steps))
+
+    run(str(tmp_path / "a"), 6)          # uninterrupted reference
+    run(str(tmp_path / "b"), 3)          # train 3, checkpoint
+    run(str(tmp_path / "b"), 6)          # resume at step 3, finish
+    ref = {r["step"]: r["loss"] for r in _metric_rows(str(tmp_path / "a"))}
+    resumed = {r["step"]: r["loss"]
+               for r in _metric_rows(str(tmp_path / "b"))}
+    assert set(ref) == set(resumed) == set(range(6))
+    assert all(np.isfinite(v) for v in ref.values())
+    for s in range(6):
+        assert ref[s] == resumed[s], f"loss diverged at step {s}"
+
+
+def test_deferred_writer_window_flush_and_blocked_time(tmp_path):
+    from homebrewnlp_tpu.train.metrics import AsyncMetricWriter, MetricWriter
+    w = AsyncMetricWriter(MetricWriter(str(tmp_path)), window=2)
+    w.write(0, {"loss": np.float32(1.0)})
+    w.write(1, {"loss": np.float32(2.0)})
+    assert w.last_loss is None          # both still inside the window
+    assert _metric_rows(str(tmp_path)) == []
+    w.write(2, {"loss": np.float32(3.0)})
+    assert w.last_loss == 1.0           # oldest fell out and drained
+    w.flush()
+    assert w.last_loss == 3.0
+    rows = _metric_rows(str(tmp_path))
+    assert [r["step"] for r in rows] == [0, 1, 2]
+    assert [r["loss"] for r in rows] == [1.0, 2.0, 3.0]
+    assert w.host_blocked_s >= 0.0
+    w.close()
+    # window=0: every write drains immediately (the synchronous parity path)
+    w0 = AsyncMetricWriter(MetricWriter(str(tmp_path / "sync")), window=0)
+    w0.write(0, {"loss": np.float32(5.0)})
+    assert w0.last_loss == 5.0
+    w0.close()
+
+
+def test_deferred_writer_step_seconds_reflect_dispatch(tmp_path):
+    """step_seconds must come from dispatch wall times, not drain times —
+    a flush() draining 3 entries at once still reports per-step gaps."""
+    import time
+    from homebrewnlp_tpu.train.metrics import AsyncMetricWriter, MetricWriter
+    w = AsyncMetricWriter(MetricWriter(str(tmp_path)), window=8)
+    for i in range(3):
+        w.write(i, {"loss": np.float32(i)})
+        time.sleep(0.02)
+    w.flush()
+    rows = _metric_rows(str(tmp_path))
+    # the gap between writes (>= 20ms) survives the batched drain
+    assert rows[1]["wall_time"] - rows[0]["wall_time"] >= 0.01
+    assert rows[1]["step_seconds"] >= 0.01
+    w.close()

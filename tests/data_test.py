@@ -287,6 +287,107 @@ def test_prefetcher_passthrough_and_resume(tmp_path):
     np.testing.assert_array_equal(next(it2)["token_x"], want[5]["token_x"])
 
 
+def test_device_feeder_matches_sync_order(tmp_path, eight_devices):
+    """Background-thread device prefetch delivers the exact batch sequence
+    of the synchronous (depth=0) path — ordering is a correctness invariant
+    (ISSUE 2 prefetcher coverage)."""
+    from homebrewnlp_tpu.data.feed import DeviceFeeder
+    from homebrewnlp_tpu.parallel import make_mesh
+    cfg = mixer_config(interleaved_datasets=2)
+    paths = write_text_tfrecords(str(tmp_path), 3, 2, 100, seed=5)
+    mesh = make_mesh(cfg)
+    sync = DeviceFeeder(iter(GptPipeline(cfg, 2, paths=paths)), cfg, mesh,
+                        depth=0)
+    want = [np.asarray(next(sync)["token_x"].x).copy() for _ in range(5)]
+    feeder = DeviceFeeder(iter(GptPipeline(cfg, 2, paths=paths)), cfg, mesh,
+                          depth=2)
+    got = [np.asarray(next(feeder)["token_x"].x).copy() for _ in range(5)]
+    feeder.close()
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_device_feeder_stopiteration_and_shutdown(tmp_path, eight_devices):
+    """Exhaustion propagates as StopIteration (after every real batch was
+    delivered) and close() leaves no live producer thread."""
+    import threading
+    from homebrewnlp_tpu.data.feed import DeviceFeeder
+    from homebrewnlp_tpu.parallel import make_mesh
+    cfg = mixer_config(interleaved_datasets=1)
+    # 1 file x 1 record x 70 tokens -> 4 windows -> two 2-row batches
+    paths = write_text_tfrecords(str(tmp_path), 1, 1, 70, seed=3)
+    mesh = make_mesh(cfg)
+    feeder = DeviceFeeder(iter(GptPipeline(cfg, 2, paths=paths)), cfg, mesh,
+                          depth=2)
+    batches = []
+    with pytest.raises(StopIteration):
+        for _ in range(10):
+            batches.append(next(feeder))
+    assert len(batches) == 2
+    feeder.close()
+    assert not any(t.name == "device-feeder" and t.is_alive()
+                   for t in threading.enumerate())
+    # a producer-side error (not exhaustion) surfaces to the consumer too
+    def boom():
+        yield {"token_x": np.zeros((2, 16, 1), np.int32),
+               "token_y": np.zeros((2, 16, 1), np.int32)}
+        raise RuntimeError("decode failed")
+    f2 = DeviceFeeder(boom(), cfg, mesh, depth=1)
+    next(f2)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(f2)
+    f2.close()
+
+
+def test_device_feeder_resume_cursor_consumed_only(tmp_path, eight_devices):
+    """state_dict under prefetch depth 2 reflects CONSUMED batches only:
+    resuming from it continues with exactly the next undelivered batch,
+    even though the producer ran ahead."""
+    from homebrewnlp_tpu.data.feed import DeviceFeeder
+    from homebrewnlp_tpu.parallel import make_mesh
+    cfg = mixer_config(interleaved_datasets=2)
+    paths = write_text_tfrecords(str(tmp_path), 3, 2, 120, seed=9)
+    mesh = make_mesh(cfg)
+    want = [b["token_x"].copy()
+            for _, b in zip(range(6), GptPipeline(cfg, 2, paths=paths))]
+
+    pipe = GptPipeline(cfg, 2, paths=paths)
+    feeder = DeviceFeeder(iter(pipe), cfg, mesh, depth=2,
+                          state_fn=pipe.state_dict)
+    for i in range(3):
+        np.testing.assert_array_equal(
+            np.asarray(next(feeder)["token_x"].x), want[i])
+    state = feeder.state_dict()
+    feeder.close()
+
+    pipe2 = GptPipeline(cfg, 2, paths=paths)
+    pipe2.load_state_dict(state)
+    feeder2 = DeviceFeeder(iter(pipe2), cfg, mesh, depth=2,
+                           state_fn=pipe2.state_dict)
+    for i in (3, 4, 5):
+        np.testing.assert_array_equal(
+            np.asarray(next(feeder2)["token_x"].x), want[i])
+    feeder2.close()
+
+
+def test_prefetcher_close_joins_blocked_producer(tmp_path):
+    """Prefetcher.close() unjams a producer parked on a full queue and
+    wakes a consumer parked on an empty one (the async loop's shutdown
+    path)."""
+    import threading
+    from homebrewnlp_tpu.data.pipeline import Prefetcher
+    paths = write_text_tfrecords(str(tmp_path), 3, 4, 64, seed=5)
+    cfg = mixer_config(sequence_length=16)
+    before = {id(t) for t in threading.enumerate()}
+    pre = Prefetcher(GptPipeline(cfg, sub_batch_size=2, paths=paths), depth=1)
+    it = iter(pre)
+    next(it)  # starts the producer; queue depth 1 fills, producer parks
+    pre.close()
+    leaked = [t for t in threading.enumerate()
+              if id(t) not in before and t.is_alive()]
+    assert not leaked
+
+
 def test_remote_fs_tfrecord_roundtrip():
     """TFRecord write/read/glob through a remote (memory://) filesystem —
     the gs:// path type-checks through the same fsspec route."""

@@ -296,6 +296,67 @@ def test_ast_x_escape_ratchet(tmp_path, monkeypatch):
     assert len(findings) == 1 and findings[0].severity == "error"
 
 
+_HOST_SYNC_TRAIN = (
+    "def train(cfg, args):\n"
+    "    total = float(cfg.learning_rate)\n"      # outside the loop: free
+    "    for u in range(10):\n"
+    "        state, metrics = step(state, u)\n"
+    "        print(float(metrics['loss']))\n"     # seeded regression
+    "        s = int(state.step)\n"
+    "        metrics['loss'].block_until_ready()\n"
+    "    return total\n")
+
+
+def test_ast_host_sync_seeded_regression_caught(tmp_path, monkeypatch):
+    """ISSUE acceptance: a seeded float(loss) (plus int(step) and
+    block_until_ready) inside train()'s step loop fails the host-sync
+    ratchet; host code outside the loop does not count."""
+    root = _mini_tree(tmp_path)
+    (tmp_path / "homebrewnlp_tpu/main.py").write_text(_HOST_SYNC_TRAIN)
+    golden = tmp_path / "goldens" / "ast_host_sync.json"
+    golden.parent.mkdir(parents=True, exist_ok=True)
+    golden.write_text("{}")
+    monkeypatch.setattr(ast_rules, "host_sync_golden_path",
+                        lambda: str(golden))
+    assert ast_rules.host_sync_counts(root) == {"homebrewnlp_tpu/main.py": 3}
+    findings = ast_rules.check_host_sync(root)
+    assert len(findings) == 1 and findings[0].severity == "error"
+    assert "device->host" in findings[0].message
+    # deliberate syncs ratchet: re-record, then clean; removing one is info
+    ast_rules.check_host_sync(root, update_goldens=True)
+    assert ast_rules.check_host_sync(root) == []
+    (tmp_path / "homebrewnlp_tpu/main.py").write_text(
+        _HOST_SYNC_TRAIN.replace("        s = int(state.step)\n", ""))
+    improved = ast_rules.check_host_sync(root)
+    assert len(improved) == 1 and improved[0].severity == "info"
+
+
+def test_ast_host_sync_suppression_and_scope(tmp_path, monkeypatch):
+    root = _mini_tree(tmp_path)
+    (tmp_path / "homebrewnlp_tpu/main.py").write_text(
+        "def train(cfg, args):\n"
+        "    for u in range(10):\n"
+        "        s = int(u)  # graftcheck: disable=host-sync\n"
+        "    return s\n"
+        "def sample(cfg, args):\n"
+        "    for i in range(3):\n"
+        "        print(float(i))\n")  # not train(): out of scope
+    golden = tmp_path / "goldens" / "ast_host_sync.json"
+    golden.parent.mkdir(parents=True, exist_ok=True)
+    golden.write_text("{}")
+    monkeypatch.setattr(ast_rules, "host_sync_golden_path",
+                        lambda: str(golden))
+    assert ast_rules.host_sync_counts(root) == {}
+    assert ast_rules.check_host_sync(root) == []
+
+
+def test_ast_host_sync_repo_loop_is_clean():
+    """The shipped async train loop carries ZERO host syncs — the ratchet
+    golden pins the empty count, so any reintroduced device read fails."""
+    assert ast_rules.host_sync_counts(REPO) == {}
+    assert json.load(open(ast_rules.host_sync_golden_path())) == {}
+
+
 def test_ast_rules_clean_on_repo():
     """The committed tree carries no AST-lint errors (ratchet is current)."""
     findings = ast_rules.run_ast_rules(REPO)
