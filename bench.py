@@ -131,15 +131,21 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
     ``probe_loss`` pins the fixed-seed 33-step comparison loss (the
     flagship's round-over-round numerics probe; schedule-rounding-sensitive,
     see BASELINE.md — the real guard is ``numerics_guard``)."""
+    from homebrewnlp_tpu.obs.spans import SpanTracer
     from homebrewnlp_tpu.train import Trainer
     from homebrewnlp_tpu.utils import load_config, random_text_batch
 
+    # local span tracer (NOT the process-ambient one): the per-phase wall
+    # breakdown rides the JSON line as ``phases_s``
+    tracer = SpanTracer(mirror_jax=False)
     t0_all = time.perf_counter()
     cache_prewarmed = _cache_prewarmed()  # probe BEFORE any compile
-    cfg = load_config(f"configs/{name}.json", **_COMMON, **WORKLOADS[name])
-    trainer = Trainer(cfg)
-    batch = random_text_batch(cfg)
-    state = trainer.init(batch)
+    with tracer.span("init"):
+        cfg = load_config(f"configs/{name}.json", **_COMMON,
+                          **WORKLOADS[name])
+        trainer = Trainer(cfg)
+        batch = random_text_batch(cfg)
+        state = trainer.init(batch)
     rng = jax.random.key(1)
 
     # compile + XLA cost analysis of the exact step being timed (EXECUTED
@@ -149,7 +155,8 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
     # cache_prewarmed (probed above) keeps the hit flag from reading a
     # fast "cold" compile as a cache miss
     t_cold = time.perf_counter()
-    cost = trainer.step_cost_analysis(state, batch)
+    with tracer.span("compile"):
+        cost = trainer.step_cost_analysis(state, batch)
     cold_compile_s = time.perf_counter() - t_cold
     flops_exec = float(cost.get("flops", 0.0))
 
@@ -194,8 +201,9 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
         return state, metrics
 
     # warmup: compile + let the device path reach steady state
-    state, metrics = run_steps(3, state)
-    float(metrics["loss"])
+    with tracer.span("warmup"):
+        state, metrics = run_steps(3, state)
+        float(metrics["loss"])
     compile_and_warmup_s = time.perf_counter() - t0_all
 
     # 5 windows of 10 steps.  Each window ends with a HOST PULL of the loss
@@ -214,18 +222,19 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
     pin_step = step_i + 3 * n_steps
     for _ in range(5):
         t0 = time.perf_counter()
-        state, metrics = run_steps(n_steps, state)
-        # host_blocked_s: wall time the host spends BLOCKED on the
-        # device->host pull that ends the window — the async train loop
-        # hides exactly this class of sync behind its in-flight window
-        # (docs/performance.md), so the bench line makes it visible.
-        # block_until_ready first: it waits for the window's remaining
-        # DEVICE compute (which belongs to the window, not to host
-        # blocking), so t_sync..t_end times only the transfer/sync
-        jax.block_until_ready(state)
-        t_sync = time.perf_counter()
-        window_loss = float(metrics["loss"])
-        t_end = time.perf_counter()
+        with tracer.span("window"):
+            state, metrics = run_steps(n_steps, state)
+            # host_blocked_s: wall time the host spends BLOCKED on the
+            # device->host pull that ends the window — the async train loop
+            # hides exactly this class of sync behind its in-flight window
+            # (docs/performance.md), so the bench line makes it visible.
+            # block_until_ready first: it waits for the window's remaining
+            # DEVICE compute (which belongs to the window, not to host
+            # blocking), so t_sync..t_end times only the transfer/sync
+            jax.block_until_ready(state)
+            t_sync = time.perf_counter()
+            window_loss = float(metrics["loss"])
+            t_end = time.perf_counter()
         host_blocked.append(t_end - t_sync)
         window_dts.append(t_end - t0)
         if step_i == pin_step or loss_after is None and step_i >= pin_step:
@@ -250,6 +259,11 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
         # window); the rest of the window is async-dispatched device work
         "host_blocked_s": round(sorted(host_blocked)[len(host_blocked) // 2],
                                 4),
+        # per-phase wall breakdown from the span tracer ("window" totals all
+        # 5 timed windows; "init"/"compile"/"warmup" decompose the startup
+        # envelope compile_and_warmup_s summarizes)
+        "phases_s": {k: round(v, 3) for k, v in
+                     tracer.phase_totals().items()},
     }
     if peak and flops_exec:
         # a fused pallas kernel hides its in-kernel flops from XLA cost
@@ -343,10 +357,8 @@ def numerics_guard(n_steps: int = 300) -> dict:
         t0 = time.perf_counter()
         cli.train(cfg, args)
         wall = time.perf_counter() - t0
-        rows = []
-        with open(os.path.join(tmp, "metrics.jsonl")) as f:
-            for line in f:
-                rows.append(json.loads(line))
+        from homebrewnlp_tpu.train.metrics import read_metric_rows
+        rows = read_metric_rows(tmp)
     result = evaluate_guard(rows, n_steps)
     result["wall_s"] = round(wall, 1)
     result["config"] = "configs/32ctx_accept_10k.json"
@@ -360,6 +372,14 @@ def evaluate_guard(rows, n_steps: int) -> dict:
     docs/perf/32ctx_10k_run.md); shorter development runs
     (HBNLP_BENCH_GUARD_STEPS < 120/300) only assert the checkpoints they
     actually reach, plus strict decrease."""
+    # tolerate raw rows: run-start boundary markers carry no loss and only
+    # metric rows participate in the trajectory check (read_metric_rows
+    # already filters when the rows come from it)
+    rows = [r for r in rows if "loss" in r]
+    if not rows:
+        return {"pass": False,
+                "error": "no metric rows (marker-only metrics.jsonl — the "
+                         "run died before its first metric drain)"}
     by_step = {r["step"]: r["loss"] for r in rows}
     first = rows[0]["loss"]
     final = rows[-1]["loss"]
@@ -444,6 +464,7 @@ def main() -> None:
         "n_steps_total": flag.get("n_steps_total"),
         "compile_and_warmup_s": flag.get("compile_and_warmup_s"),
         "host_blocked_s": flag.get("host_blocked_s"),
+        "phases_s": flag.get("phases_s"),
         "compile_cache_hit": flag.get("compile_cache_hit"),
         "device": device_kind,
         "n_chips": n_chips,

@@ -16,8 +16,10 @@ them BEFORE compilation, on CPU, in seconds:
 - :mod:`~homebrewnlp_tpu.analysis.ast_rules` lints the source tree for the
   ``NT`` named-axis discipline: axis literals against the nd registry,
   ``.x`` escape ratchet, Python-side RNG/time in traced code,
-  ``PartitionSpec`` literals naming unknown mesh axes, and the host-sync
-  ratchet (no blocking device->host reads inside the async train loop).
+  ``PartitionSpec`` literals naming unknown mesh axes, the host-sync
+  ratchet (no blocking device->host reads inside the async train loop),
+  and the obs-in-trace ratchet (no span/registry observability calls
+  inside jit-traced code).
 
 Entry point: ``python tools/graftcheck.py --all-configs`` (see
 docs/static_analysis.md).
@@ -31,5 +33,5 @@ GRAPH_RULES = ("collective-census", "dtype-promotion", "donation",
                "sharding-spec", "constant-bloat")
 # "dtype-promotion" appears in both: the AST pass carries its static twin
 AST_RULES = ("axis-literal", "x-escape", "traced-rng", "partitionspec-axis",
-             "dtype-promotion", "host-sync")
+             "dtype-promotion", "host-sync", "obs-in-trace")
 ALL_RULES = tuple(dict.fromkeys(GRAPH_RULES + AST_RULES))

@@ -19,12 +19,20 @@ Static source rules (no tracing, no jax beyond the axis registry import):
   that exist (parallel/mesh.py MESH_AXES); an unknown axis is silently
   treated as replicated by the sharding machinery.
 - ``host-sync``: no blocking device->host reads (``int()``/``float()``/
-  ``.item()``/``block_until_ready``) inside the step loop of ``train()`` —
-  the async-dispatch loop (main.py, docs/performance.md) computes step
-  indices on host and drains metrics through a deferred window; one stray
-  ``float(loss)`` re-serializes every step.  Ratcheted like ``x-escape``:
-  per-file counts pinned in ``goldens/ast_host_sync.json`` may only go
-  down.
+  ``.item()``/``block_until_ready``) inside the step loop of ``train()`` /
+  ``_train_loop()`` — the async-dispatch loop (main.py,
+  docs/performance.md) computes step indices on host and drains metrics
+  through a deferred window; one stray ``float(loss)`` re-serializes every
+  step.  Ratcheted like ``x-escape``: per-file counts pinned in
+  ``goldens/ast_host_sync.json`` may only go down.
+- ``obs-in-trace``: no observability calls (anything imported from the
+  ``obs`` package — span tracer, metrics registry, exporter) inside
+  jit-traced code (models/, ops/, infer/, optim/).  A host-side span or
+  counter update in traced code either bakes a trace-time no-op into the
+  graph or, worse, forces a host callback; instrumentation belongs in the
+  host loop layers (main.py, data/feed.py, train/metrics.py, serve/).
+  Ratcheted: per-file counts pinned in ``goldens/ast_obs_in_trace.json``
+  (committed empty) may only go down.
 
 Suppression: append ``# graftcheck: disable=<rule>`` (or a bare
 ``# graftcheck: disable``) to the offending line.
@@ -246,8 +254,11 @@ def check_x_escapes(root: str, update_goldens: bool = False
         over_hint="keep model code in the named-axis algebra")
 
 
-#: files whose ``train()`` step loop the host-sync rule audits
+#: files whose train step loop the host-sync rule audits
 HOST_SYNC_SCOPE = ("homebrewnlp_tpu/main.py",)
+#: function names holding the audited step loop (train() wraps the obs
+#: lifecycle; _train_loop() carries the actual loop since the obs PR)
+HOST_SYNC_FUNCS = frozenset({"train", "_train_loop"})
 #: builtins whose call on a device value forces a D2H sync
 HOST_SYNC_CALLS = frozenset({"int", "float", "bool"})
 #: method names that force a D2H sync (or a full-device barrier)
@@ -256,11 +267,11 @@ HOST_SYNC_METHODS = frozenset({"item", "block_until_ready"})
 
 def host_sync_counts(root: str) -> typing.Dict[str, int]:
     """Per-file counts of potentially-blocking host reads inside loop bodies
-    of functions named ``train``.  Purely syntactic (no type inference): any
-    ``int(...)``/``float(...)``/``bool(...)`` call or ``.item()``/
-    ``.block_until_ready()`` method call in the loop counts — host-only
-    arithmetic belongs outside the loop or behind a suppression, which is
-    exactly the ratchet discipline."""
+    of the step-loop functions (``HOST_SYNC_FUNCS``).  Purely syntactic (no
+    type inference): any ``int(...)``/``float(...)``/``bool(...)`` call or
+    ``.item()``/``.block_until_ready()`` method call in the loop counts —
+    host-only arithmetic belongs outside the loop or behind a suppression,
+    which is exactly the ratchet discipline."""
     counts: typing.Dict[str, int] = {}
     for path, rel in _iter_py_files(root, HOST_SYNC_SCOPE):
         src = open(path).read()
@@ -270,7 +281,7 @@ def host_sync_counts(root: str) -> typing.Dict[str, int]:
         for fn in ast.walk(tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            if fn.name != "train":
+            if fn.name not in HOST_SYNC_FUNCS:
                 continue
             for loop in ast.walk(fn):
                 if not isinstance(loop, (ast.For, ast.While)):
@@ -305,6 +316,100 @@ def check_host_sync(root: str, update_goldens: bool = False
                   "the async-dispatch loop (docs/performance.md); compute "
                   "step indices on host and route metrics through the "
                   "deferred drain")
+
+
+#: jit-traced scopes the obs-in-trace rule forbids span/registry calls in
+OBS_IN_TRACE_SCOPE = ("homebrewnlp_tpu/models", "homebrewnlp_tpu/ops",
+                      "homebrewnlp_tpu/infer", "homebrewnlp_tpu/optim")
+
+
+def _obs_aliases(tree: ast.Module
+                 ) -> typing.Tuple[typing.Set[str], typing.Set[str]]:
+    """(direct aliases, dotted roots) bound to the ``obs`` package.
+
+    Direct aliases name an obs object outright: ``from ..obs import
+    spans``, ``from homebrewnlp_tpu.obs.spans import span``, ``import
+    homebrewnlp_tpu.obs.registry as reg``, ``from .. import obs``.  Dotted
+    roots come from a bare ``import homebrewnlp_tpu.obs.spans``: only the
+    TOP-LEVEL name is bound, so a call through it counts only when its
+    attribute chain passes through ``obs`` (otherwise ``homebrewnlp_tpu.nd
+    .register_axis(...)`` in the same file would be miscounted)."""
+    aliases: typing.Set[str] = set()
+    dotted_roots: typing.Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if "obs" in mod.split("."):
+                for a in node.names:
+                    aliases.add(a.asname or a.name)
+            else:  # the package imported as a name: `from .. import obs`
+                for a in node.names:
+                    if a.name == "obs":
+                        aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                parts = a.name.split(".")
+                if "obs" not in parts:
+                    continue
+                if a.asname is not None or parts[0] == "obs":
+                    aliases.add(a.asname or parts[0])
+                else:
+                    dotted_roots.add(parts[0])
+    return aliases, dotted_roots
+
+
+def obs_in_trace_counts(root: str) -> typing.Dict[str, int]:
+    """Per-file counts of calls rooted at an obs-package alias inside the
+    traced scopes.  Purely syntactic: every Call node whose chain roots at
+    an obs alias counts, so ``span(...)`` and ``spans.span(...)`` count 1
+    and a chained ``obs.REGISTRY.counter(...).inc()`` counts 2 (the
+    ``.counter`` call and the ``.inc`` call) — the ratchet unit is 'obs
+    call sites', not statements."""
+    counts: typing.Dict[str, int] = {}
+    for path, rel in _iter_py_files(root, OBS_IN_TRACE_SCOPE):
+        src = open(path).read()
+        if "obs" not in src:
+            continue
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        aliases, dotted_roots = _obs_aliases(tree)
+        if not aliases and not dotted_roots:
+            continue
+        n = 0
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain: typing.List[str] = []
+            cur: ast.expr = node.func
+            while isinstance(cur, (ast.Attribute, ast.Call)):
+                if isinstance(cur, ast.Attribute):
+                    chain.append(cur.attr)
+                cur = cur.func if isinstance(cur, ast.Call) else cur.value
+            if not isinstance(cur, ast.Name):
+                continue
+            hit = cur.id in aliases or (cur.id in dotted_roots
+                                        and "obs" in chain)
+            if hit and not _suppressed(lines, node.lineno, "obs-in-trace"):
+                n += 1
+        if n:
+            counts[rel.replace(os.sep, "/")] = n
+    return counts
+
+
+def obs_in_trace_golden_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "goldens", "ast_obs_in_trace.json")
+
+
+def check_obs_in_trace(root: str, update_goldens: bool = False
+                       ) -> typing.List[Finding]:
+    return _check_ratchet(
+        "obs-in-trace", obs_in_trace_counts(root), obs_in_trace_golden_path(),
+        update_goldens,
+        unit="obs span/registry call(s) in jit-traced code",
+        over_hint="host observability inside traced code bakes a no-op into "
+                  "the graph (or forces a host callback); instrument the "
+                  "host loop layers instead (docs/observability.md)")
 
 
 def check_traced_rng(root: str) -> typing.List[Finding]:
@@ -440,6 +545,7 @@ def run_ast_rules(root: str, update_goldens: bool = False,
         # cannot carry real f64 avals, so the request itself is linted)
         "dtype-promotion": lambda: check_f64_literals(root),
         "host-sync": lambda: check_host_sync(root, update_goldens),
+        "obs-in-trace": lambda: check_obs_in_trace(root, update_goldens),
     }
     findings: typing.List[Finding] = []
     for name, fn in table.items():

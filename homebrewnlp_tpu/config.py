@@ -188,6 +188,31 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # thread assembles + H2D-transfers up to N upcoming global batches while
     # the current step runs.  0 assembles inline on the critical path.
     device_prefetch_depth=1,
+    # observability (docs/observability.md).  All default-off: disabled runs
+    # pay a single ambient-tracer load per instrumented site and the
+    # synchronous parity path stays bit-identical.
+    # obs_port: >0 serves /metrics (Prometheus text) + /healthz (JSON
+    # liveness) on 127.0.0.1:<port> for the run's duration
+    obs_port=0,
+    # obs_spans: record host spans (step/feed/drain/checkpoint/serve) and
+    # export model_path/trace.json (Chrome trace-event JSON, Perfetto-
+    # loadable); each span also mirrors into jax.profiler.TraceAnnotation
+    obs_spans=False,
+    # watchdog_factor: N>0 arms the hang watchdog — when no step completes
+    # within N x the EMA step time, thread stacks + device memory stats are
+    # dumped to model_path/diagnostics/ (once per stall; never kills the
+    # run).  0 disables.
+    watchdog_factor=0.0,
+    # absolute stall bound BEFORE any step cadence exists (compile /
+    # restore / first step): raise it for configs whose cold compile
+    # legitimately exceeds 10 minutes, or a /healthz-driven restart loops
+    # the compile forever; 0 disables the startup bound entirely
+    watchdog_startup_s=600.0,
+    # --profile window (main.py): start the jax.profiler trace at update
+    # u0+profile_start (must be >= 1: update u0 pays the compile, which
+    # would drown steady-state timing) and capture profile_steps updates
+    profile_start=3,
+    profile_steps=3,
     current_step=0,
     steps_per_checkpoint=100_000,
     use_checkpointing=False,
@@ -290,6 +315,20 @@ class Config:
         if self.device_prefetch_depth < 0:
             raise ValueError("device_prefetch_depth must be >= 0 "
                              "(0 = inline batch assembly)")
+        if int(self.obs_port) < 0:
+            raise ValueError("obs_port must be >= 0 (0 = exporter disabled)")
+        if self.watchdog_factor < 0:
+            raise ValueError("watchdog_factor must be >= 0 "
+                             "(0 = watchdog disabled)")
+        if self.watchdog_startup_s < 0:
+            raise ValueError("watchdog_startup_s must be >= 0 "
+                             "(0 = no startup stall bound)")
+        if self.profile_start < 1:
+            raise ValueError(
+                "profile_start must be >= 1: update 0 pays the XLA compile, "
+                "so a window starting there would not capture steady state")
+        if self.profile_steps < 1:
+            raise ValueError("profile_steps must be >= 1")
 
         for attr in ("position_embedding", "token_embedding", "output_embedding",
                      "empty_frame_embedding"):

@@ -12,6 +12,7 @@ from __future__ import annotations
 import functools
 import queue as queuelib
 import threading
+import time
 import typing
 
 import jax
@@ -20,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding
 
 from ..config import Config
 from ..nd import NT
+from ..obs import spans
 from ..parallel.sharding import spec_for
 
 # input name -> logical axis names (the input_pipeline_shape of the reference,
@@ -162,14 +164,25 @@ class DeviceFeeder:
 
     def __init__(self, source: typing.Iterable, cfg: Config, mesh: Mesh,
                  depth: int = 1,
-                 state_fn: typing.Optional[typing.Callable[[], dict]] = None):
+                 state_fn: typing.Optional[typing.Callable[[], dict]] = None,
+                 registry=None):
         self.source = iter(source)
         self.cfg = cfg
         self.mesh = mesh
         self.depth = int(depth)
         self.state_fn = state_fn
+        # obs wiring (docs/observability.md): H2D assembly seconds histogram;
+        # None (the default) records nothing
+        self._h2d_hist = None if registry is None else registry.histogram(
+            "hbnlp_feeder_h2d_seconds",
+            "host batch assembly + host->device transfer seconds")
         self._state: dict = state_fn() if state_fn is not None else {}
         self._err: typing.List[BaseException] = []
+        self._finished = False  # DONE sentinel consumed: every later
+        #                         __next__ must re-raise, never re-get()
+        self._producer_done = False  # producer exited through its normal
+        #                              tail (exhaustion), not a crash
+        self._closed = False
         self._thread: typing.Optional[threading.Thread] = None
         self._queue: typing.Optional[queuelib.Queue] = None
         self._stop = threading.Event()
@@ -192,16 +205,28 @@ class DeviceFeeder:
         try:
             while not self._stop.is_set():
                 try:
-                    np_batch = next(self.source)
+                    with spans.span("feed/source"):
+                        np_batch = next(self.source)
                 except StopIteration:
                     break
                 snap = self.state_fn() if self.state_fn is not None else None
-                gb = to_global(np_batch, self.cfg, self.mesh)
+                gb = self._assemble(np_batch)
                 if not self._put((gb, snap)):
                     return
         except BaseException as e:  # surfaced on the consumer side
             self._err.append(e)
         self._put((self._DONE, None))
+        self._producer_done = True
+
+    def _assemble(self, np_batch):
+        """``to_global`` (host assembly + H2D transfer) under a span + the
+        transfer-seconds histogram."""
+        t0 = time.perf_counter()
+        with spans.span("feed/assemble"):
+            gb = to_global(np_batch, self.cfg, self.mesh)
+        if self._h2d_hist is not None:
+            self._h2d_hist.observe(time.perf_counter() - t0)
+        return gb
 
     def __iter__(self) -> "DeviceFeeder":
         return self
@@ -210,12 +235,20 @@ class DeviceFeeder:
         if self._queue is None:  # depth 0: inline, synchronous
             np_batch = next(self.source)  # StopIteration propagates
             snap = self.state_fn() if self.state_fn is not None else None
-            gb = to_global(np_batch, self.cfg, self.mesh)
+            gb = self._assemble(np_batch)
             if snap is not None:
                 self._state = snap
             return gb
+        if self._finished:
+            # iterator contract: keep raising after exhaustion — the single
+            # DONE sentinel was already consumed, so another get() on the
+            # empty queue (dead producer) would deadlock the consumer
+            if self._err:
+                raise self._err[0]
+            raise StopIteration
         item, snap = self._queue.get()
         if item is self._DONE:
+            self._finished = True
             if self._err:
                 raise self._err[0]
             raise StopIteration
@@ -227,6 +260,22 @@ class DeviceFeeder:
         """Cursor of the last CONSUMED batch (see class docstring)."""
         return dict(self._state)
 
+    def qsize(self) -> int:
+        """Prefetched device batches currently parked (0 when inline)."""
+        return 0 if self._queue is None else self._queue.qsize()
+
+    def alive(self) -> bool:
+        """Producer liveness for /healthz: healthy means running OR
+        finished for a benign reason.  A producer that exited through its
+        normal tail (dataset exhaustion, or an error the consumer will be
+        HANDED on its next read) is not a crash — only a thread that died
+        without parking its sentinel reads as dead."""
+        if self.depth == 0 or self._closed:
+            return True  # inline path / run over: nothing to die separately
+        if self._thread is not None and self._thread.is_alive():
+            return True
+        return self._producer_done
+
     def close(self, timeout: float = 5.0) -> None:
         """Stop the producer and join it; safe to call repeatedly.
 
@@ -235,6 +284,7 @@ class DeviceFeeder:
         on ``get()`` while it runs.  A producer blocked on the SOURCE
         (e.g. the host-prefetch queue) is woken by closing the source
         first — main.py closes the pipe before the feeder."""
+        self._closed = True
         if self._thread is None:
             return
         self._stop.set()

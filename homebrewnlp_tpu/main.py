@@ -77,6 +77,24 @@ def _dump_run_artifacts(cfg, trainer, params) -> None:
 
 
 def train(cfg, args) -> None:
+    """Observability lifecycle wrapper around the step loop: builds the
+    per-run ``Obs`` bundle (span tracer, /metrics + /healthz exporter, hang
+    watchdog — docs/observability.md; all knobs default-off and inert),
+    guarantees ``trace.json`` export + thread shutdown on ANY exit, and
+    delegates to ``_train_loop``."""
+    from .obs import Obs
+    obs = Obs.from_config(cfg)
+    try:
+        # start() inside the try: a partial start (e.g. obs_port already
+        # bound) must still unwind through close(), or the ambient tracer
+        # would leak into every later run in this process
+        obs.start()
+        _train_loop(cfg, args, obs)
+    finally:
+        obs.close()
+
+
+def _train_loop(cfg, args, obs) -> None:
     """Async-dispatch step loop (docs/performance.md): step indices are
     computed ON HOST (``step0 + (u - u0) * m`` — no device value is read on
     the hot path; graftcheck's ``host-sync`` rule pins this), batches are
@@ -89,7 +107,9 @@ def train(cfg, args) -> None:
     from .data import RunLog, dataset, to_global
     from .data.feed import DeviceFeeder
     from .data.synthetic import synthetic_text_batch
+    from .obs import spans
     from .train import AsyncMetricWriter, MetricWriter, color_print
+    from .train.metrics import config_hash
 
     have_data = _have_dataset_files(cfg)
     from .parallel import make_mesh
@@ -134,7 +154,12 @@ def train(cfg, args) -> None:
     # deferred metrics drain: debug_train_step keeps the reference's
     # synchronous per-step prints, so it forces the window to 0
     window = 0 if cfg.debug_train_step else cfg.async_inflight_steps
-    writer = AsyncMetricWriter(MetricWriter(cfg.model_path), window=window)
+    writer = AsyncMetricWriter(MetricWriter(cfg.model_path), window=window,
+                               health=obs.health if obs.enabled else None,
+                               registry=obs.registry if obs.enabled else None)
+    # run boundary marker: restarts append to metrics.jsonl, so bench /
+    # post-mortem tooling splits runs on these records
+    writer.write_run_start(step0, config_hash(cfg))
     run_log = RunLog(cfg.model_path)
     # train_steps (and the step counter) count macro slices, reference
     # run.py:155,249: one optimizer update advances the counter by
@@ -155,14 +180,31 @@ def train(cfg, args) -> None:
         source = (synthetic_text_batch(cfg, i) for i in itertools.count(u0))
         state_fn = None
     feeder = DeviceFeeder(source, cfg, trainer.mesh,
-                          depth=cfg.device_prefetch_depth, state_fn=state_fn)
-    profile_window = range(u0 + 3, u0 + 6)  # steady state: past compile
+                          depth=cfg.device_prefetch_depth, state_fn=state_fn,
+                          registry=obs.registry if obs.enabled else None)
     tracing = False
     u_done = u0  # updates actually dispatched (exhaustion can end early)
+    # the try owns cleanup from the moment producer threads exist: an
+    # exception anywhere below (obs wiring, window validation) must still
+    # join the feeder + prefetcher, or they keep pinning device batches
     try:
+        if obs.enabled:
+            obs.watch_feeder(feeder)
+        # steady state: cfg.profile_start >= 1 keeps the window past the
+        # compile update (validated in config.py)
+        profile_window = range(u0 + cfg.profile_start,
+                               u0 + cfg.profile_start + cfg.profile_steps)
+        if args.profile and profile_window.start >= updates_total:
+            color_print(f"WARNING: --profile window starts at update "
+                        f"{profile_window.start} but the run only "
+                        f"dispatches updates [{u0}, {updates_total}); no "
+                        f"trace will be captured — lower profile_start or "
+                        f"raise --steps")
+        tokens_per_update = cfg.train_batch_size * m * cfg.sequence_length
         for u in range(u0, updates_total):
             try:
-                gb = next(feeder)
+                with spans.span("feed", update=u):
+                    gb = next(feeder)
             except StopIteration:
                 # single-epoch dataset exhausted (the reference's sequential
                 # reader dies on OutOfRange here, inputs.py:540-541): stop
@@ -176,15 +218,19 @@ def train(cfg, args) -> None:
             if args.profile and u == profile_window.start:
                 jax.profiler.start_trace(args.profile)
                 tracing = True
-            state, metrics = trainer.step(state, gb,
-                                          jax.random.fold_in(rng, u))
+            with spans.span("step", update=u):
+                state, metrics = trainer.step(state, gb,
+                                              jax.random.fold_in(rng, u))
             host_step = step0 + (u - u0) * m  # counter BEFORE this update
             u_done = u + 1
             writer.write(host_step, metrics)
-            if tracing and u >= profile_window.stop:
-                # drain the whole in-flight window (blocks until every
-                # dispatched step finished) so the trace captures complete
-                # steps, then stop
+            if obs.enabled:
+                obs.step_dispatched(tokens_per_update)
+            if tracing and u + 1 >= profile_window.stop:
+                # the window's last update just dispatched (exactly
+                # profile_steps captured): drain the whole in-flight window
+                # (blocks until every dispatched step finished) so the
+                # trace captures complete steps, then stop
                 writer.flush()
                 jax.profiler.stop_trace()
                 tracing = False
@@ -202,7 +248,16 @@ def train(cfg, args) -> None:
                 writer.flush()  # metrics.jsonl consistent with the checkpoint
                 data_state = ({"pipeline": feeder.state_dict()}
                               if pipe is not None else None)
-                ckpt.save(state, data_state, master_dtype=cfg.storage_dtype)
+                # declared pause: a multi-second save must not read as a
+                # stall on /healthz or trip the watchdog
+                with spans.span("checkpoint", step=host_step + m), \
+                        obs.pause("checkpoint"):
+                    ckpt.save(state, data_state,
+                              master_dtype=cfg.storage_dtype)
+                if obs.enabled:
+                    # memory_stats() can sync the device, so it samples at
+                    # the checkpoint cadence, never per step
+                    obs.sample_device_memory()
     finally:
         # pipe first: its close() wakes a feeder producer blocked on the
         # host-prefetch queue, so the feeder join below cannot stall
@@ -221,9 +276,14 @@ def train(cfg, args) -> None:
         jax.profiler.stop_trace()
         color_print(f"profiler trace written to {args.profile}")
     if ckpt is not None:
-        ckpt.save(state, {"pipeline": feeder.state_dict()} if pipe else None,
-                  master_dtype=cfg.storage_dtype)
-        ckpt.wait()
+        with spans.span("checkpoint", step=step0 + (u_done - u0) * m), \
+                obs.pause("checkpoint"):
+            ckpt.save(state,
+                      {"pipeline": feeder.state_dict()} if pipe else None,
+                      master_dtype=cfg.storage_dtype)
+            ckpt.wait()
+        if obs.enabled:
+            obs.sample_device_memory()
     # rows consumed per update = batch * macro_batching (grad_accumulation
     # only splits the delivered batch, it does not consume more data);
     # record DISPATCHED updates so exhaustion-shortened runs replay right

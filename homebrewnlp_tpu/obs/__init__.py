@@ -1,0 +1,199 @@
+"""Unified observability: span tracing, metrics registry, health + watchdog.
+
+The three pieces (docs/observability.md):
+
+- :mod:`~homebrewnlp_tpu.obs.spans` — thread-aware host span tracer
+  exporting Chrome trace-event JSON (Perfetto-loadable) and mirroring every
+  span into ``jax.profiler.TraceAnnotation`` so ``--profile`` captures show
+  host and device activity on one timeline.
+- :mod:`~homebrewnlp_tpu.obs.registry` — central counters/gauges/histograms
+  with Prometheus text rendering (process-default ``REGISTRY``).
+- :mod:`~homebrewnlp_tpu.obs.exporter` — background ``/metrics`` +
+  ``/healthz`` HTTP server, and the hang watchdog that dumps thread stacks
+  + device memory stats to ``<model_path>/diagnostics/`` before a wedged
+  run dies opaque.
+
+``Obs.from_config(cfg)`` bundles them per run, gated by the config knobs
+``obs_port`` / ``obs_spans`` / ``watchdog_factor`` — all default-off, and
+every instrumentation site degrades to a shared no-op, so disabled runs pay
+nothing and the synchronous parity path stays bit-identical.
+"""
+from __future__ import annotations
+
+import os
+import typing
+
+from .registry import REGISTRY, MetricsRegistry  # noqa: F401
+from .exporter import (Health, Watchdog, device_memory_stats,  # noqa: F401
+                       dump_diagnostics, start_server, stop_server)
+from .spans import (NULL_SPAN, SpanTracer, get_tracer,  # noqa: F401
+                    set_tracer, span, traced)
+
+
+class _HealthPause:
+    __slots__ = ("_health", "_reason")
+
+    def __init__(self, health: Health, reason: str):
+        self._health = health
+        self._reason = reason
+
+    def __enter__(self) -> "_HealthPause":
+        self._health.begin_pause(self._reason)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._health.end_pause()
+        return False
+
+
+class Obs:
+    """Per-run observability bundle with an explicit start/close lifecycle.
+
+    ``start()`` installs the ambient span tracer and launches the exporter
+    + watchdog threads; ``close()`` exports ``<model_path>/trace.json``,
+    stops the threads, and restores the previous ambient tracer.  A fully
+    disabled Obs (all knobs at their defaults) is inert: ``enabled`` is
+    False and start/close are no-ops."""
+
+    def __init__(self, model_path: str, port: int = 0, spans: bool = False,
+                 watchdog_factor: float = 0.0,
+                 startup_stall_s: float = 600.0,
+                 registry: typing.Optional[MetricsRegistry] = None):
+        self.model_path = model_path
+        self.port = int(port)
+        self.spans_enabled = bool(spans)
+        self.watchdog_factor = float(watchdog_factor)
+        self.enabled = bool(self.port or self.spans_enabled
+                            or self.watchdog_factor)
+        self.registry = registry if registry is not None else REGISTRY
+        self.health = Health(stall_factor=self.watchdog_factor or 10.0,
+                             startup_stall_s=startup_stall_s) \
+            if self.enabled else None
+        self.tracer: typing.Optional[SpanTracer] = None
+        self.server = None
+        self.watchdog: typing.Optional[Watchdog] = None
+        self._prev_tracer: typing.Optional[SpanTracer] = None
+        self._started = False
+        self._steps = None
+        self._tokens = None
+
+    @classmethod
+    def from_config(cls, cfg) -> "Obs":
+        return cls(model_path=cfg.model_path,
+                   port=getattr(cfg, "obs_port", 0),
+                   spans=getattr(cfg, "obs_spans", False),
+                   watchdog_factor=getattr(cfg, "watchdog_factor", 0.0),
+                   startup_stall_s=getattr(cfg, "watchdog_startup_s",
+                                           600.0))
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Obs":
+        if not self.enabled or self._started:
+            return self
+        self._started = True
+        if self.spans_enabled:
+            self.tracer = SpanTracer()
+            self._prev_tracer = set_tracer(self.tracer)
+        self._steps = self.registry.counter(
+            "hbnlp_train_steps_total", "optimizer updates dispatched")
+        self._tokens = self.registry.counter(
+            "hbnlp_train_tokens_total", "tokens consumed by dispatched "
+            "updates")
+        h = self.health
+        self.registry.gauge(
+            "hbnlp_last_completed_step",
+            "last step whose metrics materialized (drained)",
+            fn=lambda: (-1 if h.last_step() is None else h.last_step()))
+        self.registry.gauge(
+            "hbnlp_step_seconds_ema", "EMA of completed-step wall spacing",
+            fn=lambda: h.ema_step_seconds() or 0.0)
+        if self.port:
+            self.server = start_server(self.port, registry=self.registry,
+                                       health=self.health)
+        if self.watchdog_factor:
+            self.watchdog = Watchdog(self.health, self.model_path,
+                                     factor=self.watchdog_factor)
+            self.watchdog.start()
+        return self
+
+    def close(self) -> None:
+        """Teardown is best-effort per stage: close() runs inside train()'s
+        ``finally``, so a failing stage (broken exporter socket, full disk)
+        is logged, never raised — raising would mask the exception that
+        ended training — and must not skip the later stages (ambient-tracer
+        restore and gauge freeze are the process-hygiene guarantees)."""
+        if not self._started:
+            return
+        self._started = False
+        import logging
+        log = logging.getLogger("homebrewnlp_tpu.obs")
+        if self.health is not None:
+            self.health.mark_done()
+        if self.watchdog is not None:
+            try:
+                self.watchdog.stop()
+            except Exception as e:
+                log.warning("watchdog stop failed: %s", e)
+            self.watchdog = None
+        if self.server is not None:
+            try:
+                stop_server(self.server)
+            except Exception as e:
+                log.warning("exporter stop failed: %s", e)
+            self.server = None
+        if self.tracer is not None:
+            set_tracer(self._prev_tracer)
+            try:
+                self.tracer.export(
+                    os.path.join(self.model_path, "trace.json"))
+            except Exception as e:
+                log.warning("trace.json export failed: %s", e)
+            self.tracer = None
+        self._freeze_gauges()
+
+    def _freeze_gauges(self) -> None:
+        """Re-point the run's callback gauges at frozen final values: the
+        registry is process-global, so leaving closures over this run's
+        Health/DeviceFeeder would keep them (and any device batches still
+        parked in the feeder queue) alive for the process lifetime, and a
+        later scrape (e.g. web_api's exporter) would render dead-run state
+        as live."""
+        last = self.health.last_step()
+        ema = self.health.ema_step_seconds() or 0.0
+        self.registry.gauge("hbnlp_last_completed_step",
+                            fn=lambda: -1 if last is None else last)
+        self.registry.gauge("hbnlp_step_seconds_ema", fn=lambda: ema)
+        depth = self.registry.get("hbnlp_feeder_queue_depth")
+        if depth is not None:  # only train runs register the feeder probe
+            depth.set_function(lambda: 0)
+
+    def pause(self, reason: str):
+        """Context manager declaring an expected no-steps window (checkpoint
+        save): /healthz stays healthy and the watchdog holds fire for its
+        duration.  No-op when obs is disabled."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _HealthPause(self.health, reason)
+
+    # -- hot-path hooks (all guarded by ``enabled`` at the call site) --------
+    def step_dispatched(self, tokens: int) -> None:
+        self._steps.inc()
+        self._tokens.inc(tokens)
+
+    def watch_feeder(self, feeder) -> None:
+        """Register feeder liveness + queue-depth probes (render-time
+        callbacks: nothing runs between scrapes)."""
+        self.health.set_feeder_probe(feeder.alive)
+        self.registry.gauge(
+            "hbnlp_feeder_queue_depth",
+            "device batches parked in the feeder queue", fn=feeder.qsize)
+
+    def sample_device_memory(self) -> None:
+        """Refresh per-device memory gauges (called each checkpoint window;
+        ``memory_stats()`` can sync, so it stays off the per-step path)."""
+        g = self.registry.gauge(
+            "hbnlp_device_memory_bytes", "device memory_stats() sampled at "
+            "checkpoint windows", labelnames=("device", "stat"))
+        for dev, stats in device_memory_stats().items():
+            for stat, v in stats.items():
+                g.labels(device=dev, stat=stat).set(v)

@@ -1,0 +1,392 @@
+"""Live health surface: /metrics + /healthz HTTP server and hang watchdog.
+
+``start_server`` runs a stdlib ``ThreadingHTTPServer`` on a daemon thread
+(zero deps — same choice as ``serve/rest.py``) exposing:
+
+- ``GET /metrics``  — the registry in Prometheus text format (0.0.4)
+- ``GET /healthz``  — JSON: last-completed-step, EMA step time, seconds
+  since the last step, feeder liveness; HTTP 200 while healthy, 503 once
+  the run looks stalled (so a k8s-style probe can act on it)
+
+``Watchdog`` is the opaque-death insurance: a daemon thread that, when no
+step completes within ``factor`` x the EMA step time, dumps every Python
+thread's stack plus per-device ``memory_stats()`` to
+``<model_path>/diagnostics/hang_*.txt`` — the two artifacts a post-mortem
+of a wedged run actually needs (which actor is blocked, and whether HBM
+crept).  It fires once per stall and re-arms when steps resume; it never
+kills the run.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+import typing
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .registry import REGISTRY, MetricsRegistry
+
+LOG = logging.getLogger("homebrewnlp_tpu.obs")
+
+
+class Health:
+    """Thread-safe record of run liveness, shared by /healthz + watchdog.
+
+    ``step_completed`` is called from the metric drain (a step counts as
+    completed when its metrics materialized — the async loop's definition
+    of done); the EMA step time smooths over checkpoint pauses."""
+
+    def __init__(self, stall_factor: float = 10.0, ema_alpha: float = 0.2,
+                 min_stall_s: float = 5.0, max_pause_s: float = 600.0,
+                 startup_stall_s: float = 600.0):
+        """``min_stall_s`` floors the stall threshold: sub-millisecond CPU
+        steps must not flip /healthz to 503.  ``max_pause_s`` bounds a
+        declared pause — a checkpoint save hung past it reads as stalled
+        again (and the watchdog dumps), otherwise a wedged save would hide
+        behind its own pause forever.  ``startup_stall_s`` is the generous
+        absolute bound used BEFORE a step cadence exists (compiling /
+        restoring / first step): a run wedged in startup — the classic
+        opaque death — still reads as stalled after it.  Health owns the
+        threshold (``stall_threshold``); /healthz and the Watchdog both
+        consult it, so the two consumers of the liveness signal cannot
+        disagree."""
+        self._lock = threading.Lock()
+        self.stall_factor = float(stall_factor) if stall_factor else 10.0
+        self.ema_alpha = ema_alpha
+        self.min_stall_s = float(min_stall_s)
+        self.max_pause_s = float(max_pause_s)
+        self.startup_stall_s = float(startup_stall_s)
+        self.started = time.time()
+        self._last_step: typing.Optional[int] = None
+        self._last_wall: typing.Optional[float] = None
+        self._last_dispatch: typing.Optional[float] = None
+        self._ema_step_s: typing.Optional[float] = None
+        self._done = False
+        self._paused_for: typing.Optional[str] = None
+        self._pause_wall = 0.0
+        self._feeder_probe: typing.Optional[typing.Callable[[], bool]] = None
+
+    def step_completed(self, step: int,
+                       dispatch_wall: typing.Optional[float] = None) -> None:
+        """``dispatch_wall``: when the step was DISPATCHED.  The EMA must
+        measure the training cadence from dispatch spacing — a checkpoint
+        or profiler ``flush()`` drains the whole in-flight window
+        back-to-back, and those near-zero drain gaps would collapse the
+        EMA (and with it the stall threshold) if completion times were
+        used.  Stall detection itself keys on real completion time."""
+        now = time.time()
+        t = dispatch_wall if dispatch_wall is not None else now
+        with self._lock:
+            if self._last_dispatch is not None:
+                dt = t - self._last_dispatch
+                if dt > 0:
+                    self._ema_step_s = (
+                        dt if self._ema_step_s is None else
+                        self.ema_alpha * dt
+                        + (1 - self.ema_alpha) * self._ema_step_s)
+            self._last_dispatch = t
+            self._last_step = int(step)
+            self._last_wall = now
+
+    def set_feeder_probe(self, fn: typing.Callable[[], bool]) -> None:
+        with self._lock:
+            self._feeder_probe = fn
+
+    def begin_pause(self, reason: str) -> None:
+        """Declare an expected no-steps window (checkpoint save): /healthz
+        stays healthy and the watchdog holds fire until ``end_pause`` —
+        bounded by ``max_pause_s`` (a save hung past it is a stall)."""
+        with self._lock:
+            self._paused_for = reason
+            self._pause_wall = time.time()
+
+    def end_pause(self) -> None:
+        """End the declared pause and restart the stall clock — the paused
+        interval must not count toward the next stall measurement, NOR
+        toward the next dispatch-spacing EMA sample (shifting
+        ``_last_dispatch`` forward by the pause excludes it, so a 60s save
+        cannot inflate the stall threshold)."""
+        with self._lock:
+            pause_dur = (time.time() - self._pause_wall
+                         if self._paused_for is not None else 0.0)
+            self._paused_for = None
+            if self._last_wall is not None:
+                self._last_wall = time.time()
+            if self._last_dispatch is not None:
+                self._last_dispatch += pause_dur
+
+    def paused_for(self) -> typing.Optional[str]:
+        with self._lock:
+            return self._paused_for
+
+    def paused_seconds(self) -> typing.Optional[float]:
+        with self._lock:
+            if self._paused_for is None:
+                return None
+            return time.time() - self._pause_wall
+
+    def stall_threshold(self) -> typing.Optional[float]:
+        """Seconds without a completed step that count as a stall; None
+        before any step spacing is known.  The ONE definition both
+        /healthz and the Watchdog use."""
+        ema = self.ema_step_seconds()
+        if ema is None or ema <= 0:
+            return None
+        return max(self.stall_factor * ema, self.min_stall_s)
+
+    def stalled(self) -> bool:
+        """True when the run looks wedged: past the stall threshold with no
+        declared pause, inside a pause that exceeded ``max_pause_s``, or —
+        before any cadence exists — past the absolute ``startup_stall_s``
+        bound (so a compile/restore/first-step hang is not invisible)."""
+        paused_s = self.paused_seconds()
+        if paused_s is not None:
+            return paused_s > self.max_pause_s
+        t = self.stall_threshold()
+        since = self.seconds_since_last_step()
+        if t is not None and since is not None:
+            return since > t
+        if self.startup_stall_s <= 0:
+            return False  # startup bound disabled (cfg.watchdog_startup_s=0)
+        anchor = since if since is not None else time.time() - self.started
+        return anchor > self.startup_stall_s
+
+    def mark_done(self) -> None:
+        with self._lock:
+            self._done = True
+
+    # -- reads ---------------------------------------------------------------
+    def last_step(self) -> typing.Optional[int]:
+        with self._lock:
+            return self._last_step
+
+    def ema_step_seconds(self) -> typing.Optional[float]:
+        with self._lock:
+            return self._ema_step_s
+
+    def seconds_since_last_step(self) -> typing.Optional[float]:
+        with self._lock:
+            if self._last_wall is None:
+                return None
+            return time.time() - self._last_wall
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            last_step, last_wall = self._last_step, self._last_wall
+            ema, done, probe = self._ema_step_s, self._done, self._feeder_probe
+            paused = self._paused_for
+        since = None if last_wall is None else time.time() - last_wall
+        feeder_alive = None
+        if probe is not None:
+            try:
+                feeder_alive = bool(probe())
+            except Exception:
+                feeder_alive = False
+        if done:
+            status = "done"
+        elif self.stalled():  # checked FIRST: a wedged startup is a stall
+            status = "stalled"
+        elif last_step is None:
+            status = "starting"  # compiling / restoring: no step yet
+        else:
+            status = "ok"  # includes a declared pause within max_pause_s
+        paused_s = self.paused_seconds()
+        return {"status": status,
+                "last_completed_step": last_step,
+                "ema_step_seconds": None if ema is None else round(ema, 6),
+                "seconds_since_last_step": (None if since is None
+                                            else round(since, 3)),
+                "paused_for": paused,
+                "paused_seconds": (None if paused_s is None
+                                   else round(paused_s, 3)),
+                "feeder_alive": feeder_alive,
+                "uptime_seconds": round(time.time() - self.started, 3),
+                "stall_factor": self.stall_factor}
+
+
+def device_memory_stats() -> typing.Dict[str, dict]:
+    """Per-device ``memory_stats()`` (bytes in use / limit / peak where the
+    backend reports them); {} on backends without stats (CPU) or before jax
+    imported."""
+    out: typing.Dict[str, dict] = {}
+    try:
+        import jax
+        for i, d in enumerate(jax.devices()):
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                out[str(i)] = {k: int(v) for k, v in stats.items()
+                               if isinstance(v, (int, float))}
+    except Exception:
+        pass
+    return out
+
+
+# -- HTTP server -------------------------------------------------------------
+
+class _ObsServer(ThreadingHTTPServer):
+    daemon_threads = True
+    registry: MetricsRegistry
+    health: typing.Optional[Health]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def _send(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            body = self.server.registry.render().encode()
+            self._send(200, body, "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            health = self.server.health
+            # no Health wired (serve-mode exporter): report only what this
+            # endpoint can attest to — a probe must not read "ok" as
+            # "the engine is alive"
+            snap = health.snapshot() if health is not None else \
+                {"status": "metrics-only", "last_completed_step": None}
+            status = 503 if snap["status"] == "stalled" else 200
+            self._send(status, json.dumps(snap).encode(), "application/json")
+        else:
+            self.send_error(404)
+
+    def log_message(self, fmt, *args):  # quiet on stdout; debug-level only
+        LOG.debug("obs %s %s", self.address_string(), fmt % args)
+
+
+def start_server(port: int, registry: typing.Optional[MetricsRegistry] = None,
+                 health: typing.Optional[Health] = None,
+                 host: str = "127.0.0.1") -> _ObsServer:
+    """Start the exporter on a daemon thread; ``port=0`` binds an ephemeral
+    port (read it back from ``server.server_address[1]``)."""
+    server = _ObsServer((host, port), _Handler)
+    server.registry = registry if registry is not None else REGISTRY
+    server.health = health
+    thread = threading.Thread(target=server.serve_forever,
+                              name="obs-exporter", daemon=True)
+    server._thread = thread
+    thread.start()
+    return server
+
+
+def stop_server(server: _ObsServer) -> None:
+    server.shutdown()
+    server.server_close()
+    server._thread.join(timeout=5.0)
+
+
+# -- diagnostics dump + watchdog ---------------------------------------------
+
+_DUMP_SEQ = [0]
+_DUMP_LOCK = threading.Lock()
+
+
+def dump_diagnostics(model_path: str, health: typing.Optional[Health] = None,
+                     reason: str = "manual") -> str:
+    """Write thread stacks + device memory stats + health snapshot to
+    ``<model_path>/diagnostics/hang_<ts>_<n>.txt``; returns the path."""
+    outdir = os.path.join(model_path, "diagnostics")
+    os.makedirs(outdir, exist_ok=True)
+    with _DUMP_LOCK:
+        _DUMP_SEQ[0] += 1
+        seq = _DUMP_SEQ[0]
+    path = os.path.join(
+        outdir, time.strftime(f"hang_%Y%m%d_%H%M%S_{seq}.txt"))
+    lines = [f"reason: {reason}",
+             f"time: {time.strftime('%Y-%m-%d %H:%M:%S')}",
+             f"pid: {os.getpid()}"]
+    if health is not None:
+        lines.append("health: " + json.dumps(health.snapshot()))
+    mem = device_memory_stats()
+    lines.append("device_memory_stats: "
+                 + (json.dumps(mem, indent=1) if mem else "(unavailable)"))
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines.append("")
+    for ident, frame in sorted(sys._current_frames().items()):
+        lines.append(f"--- thread {names.get(ident, '?')} (ident {ident}) "
+                     f"---")
+        lines.extend(l.rstrip("\n") for l in traceback.format_stack(frame))
+        lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    LOG.warning("diagnostics dumped to %s (%s)", path, reason)
+    return path
+
+
+class Watchdog(threading.Thread):
+    """Dump diagnostics when ``Health.stalled()`` trips — no step within
+    ``stall_factor`` x the EMA step time (floored at ``min_stall_s``), or a
+    declared pause exceeding ``max_pause_s`` (a hung checkpoint save must
+    not hide behind its own pause).  One dump per stall; re-arms when steps
+    resume.  ``factor``/``min_stall_s``/``max_pause_s``, when given, are
+    written INTO the shared Health so /healthz and the watchdog can never
+    disagree about what counts as stalled."""
+
+    _ARMED = object()
+
+    def __init__(self, health: Health, model_path: str,
+                 factor: typing.Optional[float] = None, poll_s: float = 1.0,
+                 min_stall_s: typing.Optional[float] = None,
+                 max_pause_s: typing.Optional[float] = None):
+        super().__init__(name="obs-watchdog", daemon=True)
+        self.health = health
+        self.model_path = model_path
+        if factor is not None:
+            health.stall_factor = float(factor)
+        if min_stall_s is not None:
+            health.min_stall_s = float(min_stall_s)
+        if max_pause_s is not None:
+            health.max_pause_s = float(max_pause_s)
+        self.poll_s = poll_s
+        self.dumps: typing.List[str] = []
+        self._stop_evt = threading.Event()  # NOT _stop: Thread uses that name
+        # armed-state sentinel: must be distinct from step values INCLUDING
+        # None (a startup stall has last_step None)
+        self._fired_at_step: typing.Any = self._ARMED
+
+    def run(self) -> None:
+        while not self._stop_evt.wait(self.poll_s):
+            self._check()
+
+    def _check(self) -> None:
+        h = self.health
+        step = h.last_step()
+        if not h.stalled():
+            self._fired_at_step = self._ARMED  # steps flowing / benign
+            return                             # pause: re-arm
+        if (self._fired_at_step is not self._ARMED
+                and self._fired_at_step == step):
+            return  # already dumped for this stall
+        self._fired_at_step = step
+        paused_s = h.paused_seconds()
+        threshold = h.stall_threshold()
+        if paused_s is not None:
+            why = (f"declared pause {h.paused_for()!r} exceeded "
+                   f"max_pause_s ({paused_s:.1f}s > {h.max_pause_s}s)")
+        elif threshold is None:
+            why = (f"no step cadence established within startup_stall_s "
+                   f"({h.startup_stall_s}s) — wedged in compile/restore/"
+                   f"first step")
+        else:
+            why = (f"no step completed in "
+                   f"{h.seconds_since_last_step():.2f}s (threshold "
+                   f"{threshold:.2f}s = max({h.stall_factor} x "
+                   f"EMA {h.ema_step_seconds():.4f}s, {h.min_stall_s}s))")
+        self.dumps.append(dump_diagnostics(
+            self.model_path, h,
+            reason=f"watchdog: {why}, last step {step}"))
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop_evt.set()
+        self.join(timeout)

@@ -1,0 +1,306 @@
+"""Central counters/gauges/histograms registry + Prometheus text rendering.
+
+One process-wide ``REGISTRY`` collects everything the framework measures —
+step/token counters from the train loop, feeder queue depth and H2D transfer
+seconds from ``data/feed.py``, metric-drain latency from
+``train/metrics.py``, per-request latency/status from ``serve/rest.py``,
+and device ``memory_stats()`` gauges sampled each checkpoint window.  The
+exporter (``obs/exporter.py``) renders it at ``/metrics`` in the Prometheus
+text exposition format (version 0.0.4), so a stock Prometheus scrape — or a
+``curl`` — sees the run the way fleet tooling expects.
+
+Design notes:
+- thread-safe (one registry lock + per-metric locks are overkill at this
+  update rate; a single registry-level lock covers both).
+- idempotent registration: ``registry.counter(name, ...)`` returns the
+  existing metric when already registered (train() can run repeatedly in
+  one process — tests, notebooks — without double-registration errors).
+- gauges accept a ``fn`` callback evaluated at render time, so liveness
+  probes (queue depth, EMA step time) cost nothing between scrapes.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+import typing
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# latency-oriented default buckets (seconds), Prometheus-conventional
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _label_str(names: typing.Tuple[str, ...],
+               values: typing.Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(
+        '%s="%s"' % (n, str(v).replace("\\", r"\\").replace('"', r'\"')
+                     .replace("\n", r"\n"))
+        for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, registry: "MetricsRegistry", name: str, help_text: str,
+                 labelnames: typing.Tuple[str, ...]):
+        self._registry = registry
+        self.name = name
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._children: typing.Dict[tuple, typing.Any] = {}
+
+    def labels(self, **kw) -> "_Metric":
+        if set(kw) != set(self.labelnames):
+            raise ValueError(f"{self.name}: expected labels "
+                             f"{self.labelnames}, got {sorted(kw)}")
+        values = tuple(str(kw[n]) for n in self.labelnames)
+        with self._registry._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._make_child()
+                self._children[values] = child
+        return _Bound(self, values, child)
+
+    def _default_child(self):
+        # unlabelled metrics use the single ()-keyed child
+        with self._registry._lock:
+            child = self._children.get(())
+            if child is None:
+                child = self._make_child()
+                self._children[()] = child
+        return child
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def _render_child(self, values: tuple, child) -> typing.List[str]:
+        raise NotImplementedError
+
+    def render(self) -> typing.List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._registry._lock:
+            items = sorted(self._children.items())
+        for values, child in items:
+            lines.extend(self._render_child(values, child))
+        return lines
+
+
+class _Bound:
+    """A metric bound to one label-value combination."""
+
+    __slots__ = ("_metric", "_values", "_child")
+
+    def __init__(self, metric: _Metric, values: tuple, child):
+        self._metric = metric
+        self._values = values
+        self._child = child
+
+    def inc(self, n: float = 1.0) -> None:
+        self._metric._inc(self._child, n)
+
+    def set(self, v: float) -> None:
+        self._metric._set(self._child, v)
+
+    def observe(self, v: float) -> None:
+        self._metric._observe(self._child, v)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _make_child(self):
+        return [0.0]
+
+    def inc(self, n: float = 1.0) -> None:
+        self._inc(self._default_child(), n)
+
+    def _inc(self, child, n: float) -> None:
+        if n < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        with self._registry._lock:
+            child[0] += n
+
+    def value(self, **labels) -> float:
+        key = tuple(str(labels[n]) for n in self.labelnames) if labels else ()
+        with self._registry._lock:
+            child = self._children.get(key)
+            return child[0] if child else 0.0
+
+    def _render_child(self, values, child):
+        return [f"{self.name}{_label_str(self.labelnames, values)} "
+                f"{_fmt(child[0])}"]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, registry, name, help_text, labelnames,
+                 fn: typing.Optional[typing.Callable[[], float]] = None):
+        super().__init__(registry, name, help_text, labelnames)
+        self._fn = fn
+
+    def set_function(self, fn: typing.Callable[[], float]) -> None:
+        """Render-time callback (only valid unlabelled)."""
+        if self.labelnames:
+            raise ValueError(f"{self.name}: callback gauges cannot be "
+                             "labelled")
+        self._fn = fn
+
+    def _make_child(self):
+        return [0.0]
+
+    def set(self, v: float) -> None:
+        self._set(self._default_child(), v)
+
+    def _set(self, child, v: float) -> None:
+        with self._registry._lock:
+            child[0] = float(v)
+
+    def value(self, **labels) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        key = tuple(str(labels[n]) for n in self.labelnames) if labels else ()
+        with self._registry._lock:
+            child = self._children.get(key)
+            return child[0] if child else 0.0
+
+    def render(self) -> typing.List[str]:
+        if self._fn is not None:
+            try:
+                v = float(self._fn())
+            except Exception:
+                v = math.nan
+            return [f"# HELP {self.name} {self.help}",
+                    f"# TYPE {self.name} gauge",
+                    f"{self.name} {_fmt(v) if v == v else 'NaN'}"]
+        return super().render()
+
+    def _render_child(self, values, child):
+        return [f"{self.name}{_label_str(self.labelnames, values)} "
+                f"{_fmt(child[0])}"]
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help_text, labelnames,
+                 buckets: typing.Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(registry, name, help_text, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def _make_child(self):
+        # per-bucket counts (non-cumulative) + [sum, count]
+        return {"counts": [0] * (len(self.buckets) + 1), "sum": 0.0,
+                "count": 0}
+
+    def observe(self, v: float) -> None:
+        self._observe(self._default_child(), v)
+
+    def _observe(self, child, v: float) -> None:
+        v = float(v)
+        i = len(self.buckets)
+        for j, b in enumerate(self.buckets):
+            if v <= b:
+                i = j
+                break
+        with self._registry._lock:
+            child["counts"][i] += 1
+            child["sum"] += v
+            child["count"] += 1
+
+    def count(self, **labels) -> int:
+        key = tuple(str(labels[n]) for n in self.labelnames) if labels else ()
+        with self._registry._lock:
+            child = self._children.get(key)
+            return child["count"] if child else 0
+
+    def _render_child(self, values, child):
+        lines = []
+        cum = 0
+        for b, c in zip(self.buckets, child["counts"]):
+            cum += c
+            labels = _label_str(self.labelnames + ("le",),
+                                values + (_fmt(b),))
+            lines.append(f"{self.name}_bucket{labels} {cum}")
+        cum += child["counts"][-1]
+        labels = _label_str(self.labelnames + ("le",), values + ("+Inf",))
+        lines.append(f"{self.name}_bucket{labels} {cum}")
+        base = _label_str(self.labelnames, values)
+        lines.append(f"{self.name}_sum{base} {_fmt(child['sum'])}")
+        lines.append(f"{self.name}_count{base} {child['count']}")
+        return lines
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: typing.Dict[str, _Metric] = {}
+
+    def _get_or_make(self, cls, name: str, help_text: str,
+                     labelnames: typing.Tuple[str, ...], **kw) -> _Metric:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(f"{name} already registered as "
+                                     f"{m.kind}, not {cls.kind}")
+                return m
+            m = cls(self, name, help_text, tuple(labelnames), **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_text: str = "",
+                labelnames: typing.Sequence[str] = ()) -> Counter:
+        return self._get_or_make(Counter, name, help_text, tuple(labelnames))
+
+    def gauge(self, name: str, help_text: str = "",
+              labelnames: typing.Sequence[str] = (),
+              fn: typing.Optional[typing.Callable[[], float]] = None
+              ) -> Gauge:
+        g = self._get_or_make(Gauge, name, help_text, tuple(labelnames))
+        if fn is not None:
+            g.set_function(fn)
+        return g
+
+    def histogram(self, name: str, help_text: str = "",
+                  labelnames: typing.Sequence[str] = (),
+                  buckets: typing.Sequence[float] = DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_make(Histogram, name, help_text,
+                                 tuple(labelnames), buckets=buckets)
+
+    def get(self, name: str) -> typing.Optional[_Metric]:
+        """The registered metric, or None — lets callers reset a callback
+        gauge only if it exists (Obs.close)."""
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition (0.0.4): HELP/TYPE headers + samples,
+        trailing newline."""
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        lines: typing.List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+#: process-default registry: the train loop, feeder, metric drain, and REST
+#: handler all record here unless handed an explicit registry
+REGISTRY = MetricsRegistry()

@@ -11,14 +11,35 @@ Manager-queue bridge.
 from __future__ import annotations
 
 import json
+import logging
 import threading
+import time
 import typing
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from ..config import Config
+from ..obs import exporter as obs_exporter
+from ..obs import spans
+from ..obs.registry import REGISTRY
 from .interface import CompletionEngine, InterfaceWrapper
+
+LOG = logging.getLogger("homebrewnlp_tpu.serve.rest")
+
+
+def request_metrics(registry=None):
+    """(counter, histogram) for REST request records, resolved ONCE per
+    server (docs/observability.md) — the per-request path only pays the
+    labels lookup + update.  Label values must be a MATCHED endpoint (or
+    the fixed ``other`` bucket for unmatched requests): labelling with the
+    raw request path would let a scanner grow the label set, and the
+    registry, without bound."""
+    reg = registry if registry is not None else REGISTRY
+    return (reg.counter("hbnlp_serve_requests_total", "REST requests "
+                        "served", labelnames=("method", "path", "status")),
+            reg.histogram("hbnlp_serve_request_seconds",
+                          "REST request latency", labelnames=("path",)))
 
 
 def _sanitize_tokens(tokens: typing.Sequence[int], vocab: int) -> typing.List[int]:
@@ -80,35 +101,94 @@ class RestAPI:
                  "completion")
 
 
+class _ApiServer(ThreadingHTTPServer):
+    """REST server owning an optional obs exporter: any teardown path —
+    ``shutdown()``, ``server_close()``, or the context-manager exit (which
+    calls ``server_close``) — also stops the exporter, exactly once."""
+
+    _obs_server = None
+
+    def shutdown(self):
+        super().shutdown()
+        self._stop_obs()
+
+    def server_close(self):
+        super().server_close()
+        self._stop_obs()
+
+    def _stop_obs(self):
+        obs, self._obs_server = self._obs_server, None
+        if obs is not None:
+            obs_exporter.stop_server(obs)
+
+
 def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
-          port: int = 8000, background: bool = False):
-    api = RestAPI(cfg, params)
+          port: int = 8000, background: bool = False, api=None,
+          registry=None):
+    """``api`` (tests) substitutes a prebuilt endpoint object; ``registry``
+    overrides the process-default obs registry the request log records to.
+    When ``cfg.obs_port`` is set, a /metrics + /healthz exporter runs
+    alongside and is torn down with the returned server (docs/
+    observability.md)."""
+    api = api if api is not None else RestAPI(cfg, params)
+    endpoints = getattr(api, "ENDPOINTS", RestAPI.ENDPOINTS)
+    req_count, req_latency = request_metrics(registry)
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
+            t0 = time.perf_counter()
             name = self.path.strip("/")
-            if name not in RestAPI.ENDPOINTS:
-                self.send_error(404)
-                return
+            status = 500
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                body = json.loads(self.rfile.read(length) or b"{}")
-                result = getattr(api, name)(body)
-                payload = json.dumps(result).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-            except Exception as e:
-                self.send_error(500, str(e))
+                if name not in endpoints:
+                    status = 404
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    with spans.span(f"serve/{name}"):
+                        result = getattr(api, name)(body)
+                    payload = json.dumps(result).encode()
+                    status = 200
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except Exception as e:
+                    status = 500
+                    self.send_error(500, str(e))
+            finally:
+                # structured per-request record: registry metrics + a
+                # debug-level log line, quiet on stdout by default
+                label = f"/{name}" if name in endpoints else "other"
+                dt = time.perf_counter() - t0
+                req_count.labels(method="POST", path=label,
+                                 status=str(status)).inc()
+                req_latency.labels(path=label).observe(dt)
+                LOG.debug("request method=POST path=%s status=%d "
+                          "latency_ms=%.1f", label, status, dt * 1e3)
 
-        def log_message(self, fmt, *args):  # quiet
-            pass
+        def log_message(self, fmt, *args):
+            # per-request records go through the registry metrics; raw
+            # http.server chatter stays at debug level, off stdout
+            LOG.debug("%s %s", self.address_string(), fmt % args)
 
-    server = ThreadingHTTPServer((host, port), Handler)
+    server = _ApiServer((host, port), Handler)
+    if cfg is not None and getattr(cfg, "obs_port", 0):
+        try:
+            server._obs_server = obs_exporter.start_server(
+                cfg.obs_port, registry=registry if registry is not None
+                else REGISTRY)
+        except OSError:
+            server.server_close()  # don't leak the bound REST socket
+            raise
     if background:
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
         return server
-    server.serve_forever()
+    try:
+        server.serve_forever()
+    finally:
+        server._stop_obs()

@@ -12,12 +12,15 @@ from __future__ import annotations
 
 import collections
 import datetime
+import hashlib
 import json
 import os
 import time
 import typing
 
 import numpy as np
+
+from ..obs import spans
 
 # log2-|grad| histogram bucket edges shared between the train step (which
 # bins on-device, train/state.py) and the TensorBoard rendering below
@@ -28,6 +31,27 @@ GRAD_HIST_PREFIX = "grad_hist/"
 def color_print(*args, color: str = "\x1b[32;1m") -> None:
     now = datetime.datetime.now().strftime("%H:%M:%S.%f")[:-3]
     print(f"{color}[{now}]\x1b[0m", *args, flush=True)
+
+
+def read_metric_rows(path: str) -> typing.List[dict]:
+    """Rows of a ``metrics.jsonl`` that carry step metrics — run-start
+    boundary markers (``write_run_start``) and any future marker records
+    are skipped.  ``path`` is the file or its containing model dir.  THE
+    reader every metrics.jsonl consumer should use (bench.py's guard and
+    the test helpers do) so no consumer crashes on a marker row."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    with open(path) as f:
+        return [r for r in (json.loads(line) for line in f) if "loss" in r]
+
+
+def config_hash(cfg) -> str:
+    """Stable short hash of the full (derived) config — the run-start
+    marker's identity, so post-mortem tooling can tell a resume from a
+    hyperparameter change."""
+    doc = json.dumps({k: str(v) for k, v in cfg.dict().items()},
+                     sort_keys=True)
+    return hashlib.sha256(doc.encode()).hexdigest()[:12]
 
 
 class MetricWriter:
@@ -45,6 +69,17 @@ class MetricWriter:
             self._tb = SummaryWriter(os.path.join(model_path, "tb"))
         except Exception:
             pass
+
+    def write_run_start(self, resume_step: int, cfg_hash: str) -> None:
+        """Run boundary marker: ``metrics.jsonl`` appends across restarts, so
+        every run begins with ``{"run_start": true, resume_step,
+        config_hash, wall_time}`` — consumers that read metric rows must
+        skip records without a ``"loss"``/``"step"`` key (bench.py's guard
+        and the test helpers do)."""
+        self._f.write(json.dumps({
+            "run_start": True, "resume_step": int(resume_step),
+            "config_hash": cfg_hash, "wall_time": time.time()}) + "\n")
+        self._f.flush()
 
     def write(self, step: int, metrics: typing.Dict[str, typing.Any],
               wall_time: typing.Optional[float] = None) -> None:
@@ -124,13 +159,25 @@ class AsyncMetricWriter:
       ready, a returned ``flush()`` implies every dispatched step finished.
     """
 
-    def __init__(self, writer: MetricWriter, window: int = 2):
+    def __init__(self, writer: MetricWriter, window: int = 2,
+                 health=None, registry=None):
+        """``health``/``registry`` (optional, docs/observability.md): each
+        drained step reports to ``Health.step_completed`` (the /healthz +
+        watchdog notion of progress — a step counts once its metrics
+        materialized) and a drain-latency histogram."""
         self.writer = writer
         self.window = max(0, int(window))
         self._pending: typing.Deque[typing.Tuple[int, float, dict]] = \
             collections.deque()
         self.last_loss: typing.Optional[float] = None
         self.host_blocked_s = 0.0
+        self._health = health
+        self._drain_hist = None if registry is None else registry.histogram(
+            "hbnlp_metric_drain_seconds",
+            "wall seconds blocked in the device->host metric pull per step")
+
+    def write_run_start(self, resume_step: int, cfg_hash: str) -> None:
+        self.writer.write_run_start(resume_step, cfg_hash)
 
     def write(self, step: int, metrics: typing.Dict[str, typing.Any]) -> None:
         self._pending.append((step, time.time(), metrics))
@@ -141,12 +188,20 @@ class AsyncMetricWriter:
         step, wall, metrics = self._pending.popleft()
         t0 = time.perf_counter()
         host = {}
-        for k, v in metrics.items():
-            try:
-                host[k] = np.asarray(v)  # blocks until the step completed
-            except Exception:
-                host[k] = v
-        self.host_blocked_s += time.perf_counter() - t0
+        with spans.span("drain", step=step):
+            for k, v in metrics.items():
+                try:
+                    host[k] = np.asarray(v)  # blocks until step completed
+                except Exception:
+                    host[k] = v
+        blocked = time.perf_counter() - t0
+        self.host_blocked_s += blocked
+        if self._drain_hist is not None:
+            self._drain_hist.observe(blocked)
+        if self._health is not None:
+            # dispatch wall, not drain wall: a flush() draining the whole
+            # window back-to-back must not collapse the health EMA
+            self._health.step_completed(step, dispatch_wall=wall)
         loss = host.get("loss")
         if loss is not None and getattr(loss, "size", 0) == 1:
             self.last_loss = float(loss)

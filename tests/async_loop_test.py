@@ -22,8 +22,9 @@ def _args(steps, profile=""):
 
 
 def _metric_rows(model_path):
-    with open(os.path.join(model_path, "metrics.jsonl")) as f:
-        return [json.loads(line) for line in f]
+    """Metric rows only — the shared reader skips run-start markers."""
+    from homebrewnlp_tpu.train.metrics import read_metric_rows
+    return read_metric_rows(model_path)
 
 
 def _feeder_threads():

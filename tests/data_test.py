@@ -324,6 +324,11 @@ def test_device_feeder_stopiteration_and_shutdown(tmp_path, eight_devices):
         for _ in range(10):
             batches.append(next(feeder))
     assert len(batches) == 2
+    # iterator contract: exhaustion re-raises on EVERY later next() — the
+    # one-shot DONE sentinel must not leave a second call deadlocked on an
+    # empty queue with a dead producer
+    with pytest.raises(StopIteration):
+        next(feeder)
     feeder.close()
     assert not any(t.name == "device-feeder" and t.is_alive()
                    for t in threading.enumerate())
@@ -336,6 +341,8 @@ def test_device_feeder_stopiteration_and_shutdown(tmp_path, eight_devices):
     next(f2)
     with pytest.raises(RuntimeError, match="decode failed"):
         next(f2)
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(f2)  # errors also re-raise instead of deadlocking
     f2.close()
 
 

@@ -357,6 +357,99 @@ def test_ast_host_sync_repo_loop_is_clean():
     assert json.load(open(ast_rules.host_sync_golden_path())) == {}
 
 
+def test_ast_obs_in_trace_seeded_regression_caught(tmp_path, monkeypatch):
+    """ISSUE satellite: a span/registry call inside jit-traced code (models/,
+    ops/) fails the obs-in-trace ratchet — every obs import style roots."""
+    root = _mini_tree(tmp_path, models_src=(
+        "from ..obs.spans import span\n"
+        "from homebrewnlp_tpu.obs import REGISTRY as reg\n"
+        "def layer(x):\n"
+        "    with span('layer'):\n"                  # rooted call 1
+        "        reg.counter('bad_total').inc()\n"   # 2 rooted calls:
+        "    return x\n"), ops_src=(                 #  .counter() and .inc()
+        "import homebrewnlp_tpu.obs.spans as spans\n"
+        "def kernel(x):\n"
+        "    with spans.span('k'):\n"                # rooted call
+        "        return x\n"))
+    golden = tmp_path / "goldens" / "ast_obs_in_trace.json"
+    golden.parent.mkdir(parents=True, exist_ok=True)
+    golden.write_text("{}")
+    monkeypatch.setattr(ast_rules, "obs_in_trace_golden_path",
+                        lambda: str(golden))
+    counts = ast_rules.obs_in_trace_counts(root)
+    assert counts == {"homebrewnlp_tpu/models/m.py": 3,
+                      "homebrewnlp_tpu/ops/o.py": 1}, counts
+    findings = ast_rules.check_obs_in_trace(root)
+    assert len(findings) == 2
+    assert all(f.severity == "error" for f in findings)
+    assert "jit-traced" in findings[0].message
+    # the ratchet can pin deliberate exceptions, then only go down
+    ast_rules.check_obs_in_trace(root, update_goldens=True)
+    assert ast_rules.check_obs_in_trace(root) == []
+
+
+def test_ast_obs_in_trace_package_import_form(tmp_path, monkeypatch):
+    """`from .. import obs` (module=None carries no 'obs' component) must
+    still root: it is the most natural way to smuggle a registry call in."""
+    root = _mini_tree(tmp_path, models_src=(
+        "from .. import obs\n"
+        "def layer(x):\n"
+        "    obs.REGISTRY.counter('bad_total').inc()\n"
+        "    return x\n"), ops_src=(
+        "from homebrewnlp_tpu import obs as o\n"
+        "def kernel(x):\n"
+        "    with o.span('k'):\n"
+        "        return x\n"))
+    counts = ast_rules.obs_in_trace_counts(root)
+    assert counts == {"homebrewnlp_tpu/models/m.py": 2,
+                      "homebrewnlp_tpu/ops/o.py": 1}, counts
+
+
+def test_ast_obs_in_trace_bare_dotted_import_precise(tmp_path):
+    """A bare `import homebrewnlp_tpu.obs.spans` binds only the top-level
+    name: calls through it count ONLY when the chain passes through obs —
+    an unrelated `homebrewnlp_tpu.nd.*` call in the same file must not."""
+    root = _mini_tree(tmp_path, models_src=(
+        "import homebrewnlp_tpu.obs.spans\n"
+        "import homebrewnlp_tpu.nd\n"
+        "def layer(x):\n"
+        "    homebrewnlp_tpu.nd.register_axis('rows')\n"   # NOT obs: clean
+        "    with homebrewnlp_tpu.obs.spans.span('bad'):\n"  # obs: counts
+        "        return x\n"))
+    counts = ast_rules.obs_in_trace_counts(root)
+    assert counts == {"homebrewnlp_tpu/models/m.py": 1}, counts
+
+
+def test_ast_obs_in_trace_suppression_and_host_code_free(tmp_path,
+                                                         monkeypatch):
+    root = _mini_tree(tmp_path, models_src=(
+        "from ..obs.spans import span\n"
+        "def layer(x):\n"
+        "    with span('ok'):  # graftcheck: disable=obs-in-trace\n"
+        "        return x\n"))
+    # host-layer code (data/, train/, serve/, main) is OUT of scope: the
+    # same import + call in data/ must not count
+    p = tmp_path / "homebrewnlp_tpu/data/feedish.py"
+    p.write_text("from ..obs.spans import span\n"
+                 "def feed(x):\n"
+                 "    with span('feed'):\n"
+                 "        return x\n")
+    golden = tmp_path / "goldens" / "ast_obs_in_trace.json"
+    golden.parent.mkdir(parents=True, exist_ok=True)
+    golden.write_text("{}")
+    monkeypatch.setattr(ast_rules, "obs_in_trace_golden_path",
+                        lambda: str(golden))
+    assert ast_rules.obs_in_trace_counts(root) == {}
+    assert ast_rules.check_obs_in_trace(root) == []
+
+
+def test_ast_obs_in_trace_repo_is_clean():
+    """The shipped traced code (models/ops/infer/optim) carries ZERO obs
+    calls; the committed golden pins the empty count."""
+    assert ast_rules.obs_in_trace_counts(REPO) == {}
+    assert json.load(open(ast_rules.obs_in_trace_golden_path())) == {}
+
+
 def test_ast_rules_clean_on_repo():
     """The committed tree carries no AST-lint errors (ratchet is current)."""
     findings = ast_rules.run_ast_rules(REPO)

@@ -159,6 +159,10 @@ def test_cli_train_synthetic(tmp_path, capsys):
     lines = [json.loads(l) for l in
              (tmp_path / "run" / "metrics.jsonl").read_text().splitlines()]
     assert lines[-1]["step"] == 7
+    # run-start boundary markers: one per invocation, resume step recorded
+    markers = [l for l in lines if l.get("run_start")]
+    assert [m["resume_step"] for m in markers] == [0, 6]
+    assert markers[0]["config_hash"] == markers[1]["config_hash"]
 
 
 def test_cli_sample_video_writes_avi(tmp_path):

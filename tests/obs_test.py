@@ -1,0 +1,595 @@
+"""Observability subsystem (ISSUE 3): span tracer (Chrome trace-event JSON,
+nesting, thread names, jax mirror), metrics registry (Prometheus text),
+exporter endpoints (/metrics parses, /healthz reflects step progress), hang
+watchdog (simulated stall -> diagnostics dump), REST request logging, the
+run-start metrics marker, the profile-window knobs, and the live-during-
+training acceptance run."""
+import argparse
+import json
+import os
+import re
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from homebrewnlp_tpu import main as cli
+from homebrewnlp_tpu.obs import (Health, MetricsRegistry, Obs, Watchdog,
+                                 dump_diagnostics, start_server, stop_server)
+from homebrewnlp_tpu.obs import spans as spans_mod
+from homebrewnlp_tpu.obs.spans import NULL_SPAN, SpanTracer, set_tracer, span
+
+from .backend import tiny_config
+
+
+def _args(steps, profile=""):
+    return argparse.Namespace(steps=steps, profile=profile, workers=None)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# one sample line: name{labels} value  (value may be int/float/+Inf)
+_PROM_SAMPLE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? (-?[0-9.e+-]+|\+Inf|NaN)$")
+
+
+def _assert_prometheus_text(text):
+    """Every non-empty line is a HELP/TYPE comment or a well-formed sample."""
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if not line or line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert _PROM_SAMPLE.match(line), f"bad prometheus line: {line!r}"
+
+
+# -- span tracer -------------------------------------------------------------
+
+def test_span_tracer_chrome_json_nesting_and_threads(tmp_path):
+    tracer = SpanTracer(mirror_jax=False)
+    with tracer.span("outer", update=3):
+        with tracer.span("inner"):
+            time.sleep(0.005)
+
+    def worker():
+        with tracer.span("worker-span"):
+            pass
+
+    t = threading.Thread(target=worker, name="feeder-like")
+    t.start()
+    t.join()
+    path = tracer.export(str(tmp_path / "trace.json"))
+    doc = json.load(open(path))
+    assert isinstance(doc["traceEvents"], list)
+    xs = {e["name"]: e for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert set(xs) == {"outer", "inner", "worker-span"}
+    for e in xs.values():  # complete events carry the required fields
+        assert {"ts", "dur", "pid", "tid"} <= set(e)
+    # nesting: inner lies within outer's interval, on the same thread
+    out, inn = xs["outer"], xs["inner"]
+    assert out["tid"] == inn["tid"]
+    assert out["ts"] <= inn["ts"]
+    assert inn["ts"] + inn["dur"] <= out["ts"] + out["dur"] + 1e-3
+    assert out["args"]["update"] == "3"
+    # thread-name metadata rows label each track
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert "feeder-like" in names and "MainThread" in names
+    totals = tracer.phase_totals()
+    assert totals["outer"] >= totals["inner"] >= 0.005
+
+
+def test_span_tracer_mirrors_into_jax_annotation():
+    """mirror_jax=True wraps spans in jax.profiler.TraceAnnotation (free
+    without an active capture — this pins that the wiring doesn't raise)."""
+    tracer = SpanTracer(mirror_jax=True)
+    assert tracer._mirror is not None
+    with tracer.span("mirrored"):
+        pass
+    assert [n for n, *_ in tracer._events] == ["mirrored"]
+
+
+def test_ambient_span_is_noop_when_disabled():
+    assert spans_mod.get_tracer() is None
+    assert span("anything") is NULL_SPAN  # shared no-op object, no alloc
+    with span("anything"):
+        pass
+
+    @spans_mod.traced("fn")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2  # decorator resolves the (absent) tracer per call
+    tracer = SpanTracer(mirror_jax=False)
+    prev = set_tracer(tracer)
+    try:
+        assert f(2) == 3
+        with span("live"):
+            pass
+    finally:
+        set_tracer(prev)
+    assert {n for n, *_ in tracer._events} == {"fn", "live"}
+
+
+def test_span_tracer_ring_bounds_memory():
+    """max_events is a ring keeping the MOST RECENT spans; phase totals stay
+    exact and the export records the drop count."""
+    tracer = SpanTracer(mirror_jax=False, max_events=3)
+    for i in range(10):
+        with tracer.span(f"s{i}"):
+            pass
+    assert [e[0] for e in tracer._events] == ["s7", "s8", "s9"]
+    assert len(tracer.phase_totals()) == 10  # totals survive the ring
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        doc = json.load(open(tracer.export(os.path.join(d, "t.json"))))
+    assert doc["otherData"]["dropped_events"] == 7
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_prometheus_rendering():
+    reg = MetricsRegistry()
+    reg.counter("steps_total", "steps").inc(5)
+    reg.gauge("depth", "queue depth").set(2)
+    reg.gauge("cb", "callback gauge", fn=lambda: 7.5)
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(30.0)
+    lab = reg.counter("req_total", "requests", labelnames=("path", "status"))
+    lab.labels(path="/x", status=200).inc()
+    lab.labels(path="/x", status=500).inc(2)
+    text = reg.render()
+    _assert_prometheus_text(text)
+    assert "steps_total 5" in text
+    assert "depth 2" in text
+    assert "cb 7.5" in text
+    # histogram: cumulative buckets, +Inf == count, sum accumulates
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+    assert 'req_total{path="/x",status="200"} 1' in text
+    assert 'req_total{path="/x",status="500"} 2' in text
+    # idempotent re-registration returns the same metric; kind clash raises
+    assert reg.counter("steps_total") is not None
+    reg.counter("steps_total").inc()
+    assert reg.counter("steps_total").value() == 6
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("steps_total")
+
+
+# -- exporter ----------------------------------------------------------------
+
+def test_exporter_metrics_and_healthz_reflect_progress():
+    reg = MetricsRegistry()
+    reg.counter("c_total", "c").inc(3)
+    health = Health()
+    server = start_server(0, registry=reg, health=health)
+    try:
+        port = server.server_address[1]
+        status, body = _get(f"http://127.0.0.1:{port}/metrics")
+        assert status == 200
+        _assert_prometheus_text(body.decode())
+        assert "c_total 3" in body.decode()
+        status, body = _get(f"http://127.0.0.1:{port}/healthz")
+        h = json.loads(body)
+        assert status == 200 and h["status"] == "starting"
+        assert h["last_completed_step"] is None
+        health.step_completed(4)
+        health.step_completed(5)
+        status, body = _get(f"http://127.0.0.1:{port}/healthz")
+        h = json.loads(body)
+        assert status == 200 and h["status"] == "ok"
+        assert h["last_completed_step"] == 5
+        assert h["ema_step_seconds"] is not None
+        with pytest.raises(urllib.error.HTTPError):
+            _get(f"http://127.0.0.1:{port}/nope")
+    finally:
+        stop_server(server)
+
+
+def test_burst_drain_does_not_collapse_ema():
+    """A checkpoint/profiler flush() drains the whole in-flight window
+    back-to-back; the EMA must track DISPATCH spacing, or the near-zero
+    drain gaps would shrink the stall threshold and 503 a healthy run."""
+    health = Health()
+    t0 = time.time()
+    health.step_completed(0, dispatch_wall=t0)
+    health.step_completed(1, dispatch_wall=t0 + 2.0)
+    # burst-drained window: steps dispatched 2s apart, drained same instant
+    health.step_completed(2, dispatch_wall=t0 + 4.0)
+    health.step_completed(3, dispatch_wall=t0 + 6.0)
+    assert health.ema_step_seconds() == pytest.approx(2.0)
+
+
+def test_pause_excluded_from_dispatch_ema():
+    """A declared checkpoint pause between dispatches must not inflate the
+    EMA (and with it the stall threshold) when steps resume."""
+    health = Health()
+    t0 = time.time()
+    health.step_completed(0, dispatch_wall=t0 - 4.0)
+    health.step_completed(1, dispatch_wall=t0 - 2.0)  # cadence 2s
+    health.begin_pause("checkpoint")
+    health._pause_wall = t0 - 2.0  # simulate: the save took ~2s
+    health.end_pause()
+    health.step_completed(2, dispatch_wall=t0 + 2.0)  # 4s gap incl. pause
+    # the ~2s pause is excluded: EMA stays at the 2s cadence (not 0.2*4+..)
+    assert health.ema_step_seconds() == pytest.approx(2.0, rel=0.2)
+
+
+def test_startup_bound_disabled_with_zero():
+    health = Health(startup_stall_s=0.0)
+    health.started -= 10_000  # ancient start, still no steps
+    assert health.stalled() is False
+    assert health.snapshot()["status"] == "starting"
+
+
+def test_healthz_reports_stalled_as_503():
+    health = Health(stall_factor=1.0)
+    health.step_completed(0)
+    health._last_wall -= 100.0  # simulate: last step 100s ago
+    health._ema_step_s = 0.01
+    # the stall threshold shares the watchdog's 5s floor: a 2s checkpoint
+    # pause on a fast-step run must NOT flip /healthz to 503
+    assert health.min_stall_s == 5.0
+    fast = Health(stall_factor=10.0)
+    fast.step_completed(0)
+    fast._last_wall -= 2.0
+    fast._ema_step_s = 0.05
+    assert fast.snapshot()["status"] == "ok"
+    server = start_server(0, registry=MetricsRegistry(), health=health)
+    try:
+        port = server.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{port}/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "stalled"
+    finally:
+        stop_server(server)
+
+
+# -- watchdog ----------------------------------------------------------------
+
+def test_watchdog_stall_dumps_diagnostics_once(tmp_path):
+    health = Health(stall_factor=2.0)
+    health.step_completed(0)
+    time.sleep(0.02)
+    health.step_completed(1)  # EMA ~20ms
+    wd = Watchdog(health, str(tmp_path), factor=2.0, poll_s=0.02,
+                  min_stall_s=0.05)
+    wd.start()
+    time.sleep(0.5)  # no further steps: a stall
+    wd.stop()
+    files = sorted((tmp_path / "diagnostics").glob("hang_*.txt"))
+    assert len(files) == 1, "one dump per stall, not one per poll"
+    content = files[0].read_text()
+    assert "reason: watchdog" in content
+    assert "MainThread" in content            # thread stacks present
+    assert "device_memory_stats" in content   # memory section present
+    assert "last step 1" in content
+
+
+def test_watchdog_rearms_after_steps_resume(tmp_path):
+    health = Health(stall_factor=2.0)
+    health.step_completed(0)
+    time.sleep(0.02)
+    health.step_completed(1)
+    wd = Watchdog(health, str(tmp_path), factor=2.0, poll_s=0.02,
+                  min_stall_s=0.05)
+    wd.start()
+    time.sleep(0.3)           # first stall -> dump 1
+    health.step_completed(2)  # resume re-arms
+    time.sleep(0.3)           # second stall -> dump 2
+    wd.stop()
+    assert len(list((tmp_path / "diagnostics").glob("hang_*.txt"))) == 2
+
+
+def test_declared_pause_suppresses_stall_and_watchdog(tmp_path):
+    """A declared pause (checkpoint save) keeps /healthz 'ok' and holds the
+    watchdog's fire; end_pause restarts the stall clock."""
+    health = Health(stall_factor=2.0)
+    health.step_completed(0)
+    time.sleep(0.02)
+    health.step_completed(1)
+    wd = Watchdog(health, str(tmp_path), factor=2.0, poll_s=0.02,
+                  min_stall_s=0.05)
+    wd.start()
+    health.begin_pause("checkpoint")
+    time.sleep(0.3)  # would be a stall without the pause
+    assert health.snapshot()["status"] == "ok"
+    assert health.snapshot()["paused_for"] == "checkpoint"
+    assert not (tmp_path / "diagnostics").exists()
+    health.end_pause()
+    # the paused interval does not count toward the next stall window
+    assert health.seconds_since_last_step() < 0.05
+    time.sleep(0.3)  # a REAL stall after the pause still fires
+    wd.stop()
+    assert len(list((tmp_path / "diagnostics").glob("hang_*.txt"))) == 1
+
+
+def test_hung_pause_exceeding_bound_fires_watchdog(tmp_path):
+    """A checkpoint save hung past max_pause_s must NOT hide behind its own
+    declared pause: /healthz flips to stalled and the watchdog dumps."""
+    health = Health(stall_factor=2.0)
+    health.step_completed(0)
+    time.sleep(0.02)
+    health.step_completed(1)
+    wd = Watchdog(health, str(tmp_path), factor=2.0, poll_s=0.02,
+                  min_stall_s=0.05, max_pause_s=0.1)
+    wd.start()
+    health.begin_pause("checkpoint")
+    time.sleep(0.4)  # never ends: a wedged save
+    assert health.snapshot()["status"] == "stalled"
+    wd.stop()
+    files = list((tmp_path / "diagnostics").glob("hang_*.txt"))
+    assert len(files) == 1
+    assert "exceeded" in files[0].read_text()
+
+
+def test_watchdog_quiet_before_first_step(tmp_path):
+    """No EMA yet (still compiling): the watchdog holds fire until the
+    generous absolute startup bound."""
+    wd = Watchdog(Health(), str(tmp_path), factor=2.0, poll_s=0.02,
+                  min_stall_s=0.01)
+    wd.start()
+    time.sleep(0.2)
+    wd.stop()
+    assert not (tmp_path / "diagnostics").exists()
+
+
+def test_watchdog_startup_hang_fires_after_absolute_bound(tmp_path):
+    """A run wedged BEFORE any step cadence exists (deadlocked compile /
+    restore / first step) must still dump once the startup bound passes —
+    the opaque startup death is exactly what the watchdog insures."""
+    health = Health(startup_stall_s=0.1)
+    wd = Watchdog(health, str(tmp_path), factor=2.0, poll_s=0.02,
+                  min_stall_s=0.01)
+    wd.start()
+    time.sleep(0.4)
+    assert health.snapshot()["status"] == "stalled"
+    wd.stop()
+    files = list((tmp_path / "diagnostics").glob("hang_*.txt"))
+    assert len(files) == 1, "one dump, deduped across polls"
+    assert "startup" in files[0].read_text()
+
+
+def test_dump_diagnostics_direct(tmp_path):
+    p = dump_diagnostics(str(tmp_path), Health(), reason="unit test")
+    content = open(p).read()
+    assert "reason: unit test" in content and "pid:" in content
+
+
+# -- REST request logging ----------------------------------------------------
+
+def test_rest_request_logging_counts_and_latency():
+    from homebrewnlp_tpu.serve import rest
+
+    class StubAPI:
+        ENDPOINTS = ("encode", "boom")
+
+        def encode(self, body):
+            return {"tokens": [1, 2]}
+
+        def boom(self, body):
+            raise RuntimeError("kaput")
+
+    reg = MetricsRegistry()
+    server = rest.serve(None, None, port=0, background=True, api=StubAPI(),
+                        registry=reg)
+    try:
+        port = server.server_address[1]
+
+        def post(path):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/{path}", data=b"{}",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        assert post("encode") == 200
+        assert post("encode") == 200
+        assert post("boom") == 500
+        assert post("missing") == 404
+        c = reg.counter("hbnlp_serve_requests_total")
+        assert c.value(method="POST", path="/encode", status="200") == 2
+        assert c.value(method="POST", path="/boom", status="500") == 1
+        # unmatched paths fold into the fixed "other" bucket — a scanner
+        # must not be able to grow the label set without bound
+        assert c.value(method="POST", path="other", status="404") == 1
+        assert c.value(method="POST", path="/missing", status="404") == 0
+        h = reg.histogram("hbnlp_serve_request_seconds")
+        assert h.count(path="/encode") == 2
+        _assert_prometheus_text(reg.render())
+    finally:
+        server.shutdown()
+
+
+def test_rest_background_obs_exporter_stops_with_server():
+    """serve(background=True) with cfg.obs_port: the exporter serves while
+    the API runs and the caller's shutdown() stops BOTH (no leaked thread /
+    bound port)."""
+    from homebrewnlp_tpu.serve import rest
+
+    class StubAPI:
+        ENDPOINTS = ("encode",)
+
+        def encode(self, body):
+            return {"tokens": []}
+
+    obs_port = _free_port()
+    cfg = tiny_config(obs_port=obs_port)
+    reg = MetricsRegistry()
+    reg.counter("alive_total", "x").inc()
+    server = rest.serve(cfg, None, port=0, background=True, api=StubAPI(),
+                        registry=reg)
+    try:
+        status, body = _get(f"http://127.0.0.1:{obs_port}/metrics")
+        assert status == 200 and "alive_total 1" in body.decode()
+        # no Health is wired in serve mode: /healthz must say so instead of
+        # claiming "ok" (a liveness probe must not be misled)
+        _, body = _get(f"http://127.0.0.1:{obs_port}/healthz")
+        assert json.loads(body)["status"] == "metrics-only"
+    finally:
+        server.shutdown()
+        server.server_close()
+    with pytest.raises((urllib.error.URLError, OSError)):
+        _get(f"http://127.0.0.1:{obs_port}/metrics", timeout=2)
+
+
+# -- config knobs ------------------------------------------------------------
+
+def test_obs_config_validation():
+    with pytest.raises(ValueError, match="obs_port"):
+        tiny_config(obs_port=-1)
+    with pytest.raises(ValueError, match="watchdog_factor"):
+        tiny_config(watchdog_factor=-0.5)
+    with pytest.raises(ValueError, match="profile_start"):
+        tiny_config(profile_start=0)
+    with pytest.raises(ValueError, match="profile_steps"):
+        tiny_config(profile_steps=0)
+    cfg = tiny_config()
+    assert cfg.obs_port == 0 and not cfg.obs_spans
+    assert cfg.watchdog_factor == 0.0
+    assert Obs.from_config(cfg).enabled is False
+
+
+def test_obs_start_failure_unwinds_ambient_tracer(tmp_path, eight_devices):
+    """A partial Obs.start (obs_port already bound) must not leak the
+    ambient span tracer into later runs in the same process."""
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    try:
+        cfg = tiny_config(model_path=str(tmp_path),
+                          obs_port=blocker.getsockname()[1], obs_spans=True)
+        with pytest.raises(OSError):
+            cli.train(cfg, _args(2))
+    finally:
+        blocker.close()
+    assert spans_mod.get_tracer() is None
+
+
+def test_disabled_obs_is_inert(tmp_path):
+    obs = Obs.from_config(tiny_config(model_path=str(tmp_path)))
+    obs.start()
+    obs.close()
+    assert spans_mod.get_tracer() is None
+    assert not (tmp_path / "trace.json").exists()
+
+
+def test_profile_window_knobs_drive_profiler(tmp_path, eight_devices):
+    """profile_start/profile_steps replace the hardcoded u0+3..u0+6 window;
+    a window starting at update 1 works on short runs."""
+    trace_dir = str(tmp_path / "trace")
+    cfg = tiny_config(model_path=str(tmp_path / "run"), profile_start=1,
+                      profile_steps=2)
+    cli.train(cfg, _args(5, profile=trace_dir))
+    assert os.path.isdir(trace_dir)
+    assert any(files for _, _, files in os.walk(trace_dir))
+
+
+# -- acceptance: live obs during a training run ------------------------------
+
+def test_train_serves_live_obs_and_exports_trace(tmp_path, eight_devices):
+    """A synthetic run with obs_port set serves /healthz + /metrics WHILE
+    stepping, and on exit writes a Perfetto-loadable trace.json covering
+    the step/feed/drain/checkpoint phases."""
+    port = _free_port()
+    cfg = tiny_config(model_path=str(tmp_path), obs_port=port, obs_spans=True,
+                      watchdog_factor=100.0, use_checkpointing=True,
+                      steps_per_checkpoint=50, async_inflight_steps=2,
+                      device_prefetch_depth=1)
+    done = threading.Event()
+    errs = []
+
+    def run():
+        try:
+            cli.train(cfg, _args(120))
+        except BaseException as e:  # surfaced below
+            errs.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name="train-under-test")
+    t.start()
+    live_health = live_metrics = None
+    deadline = time.time() + 300
+    while time.time() < deadline and not done.is_set():
+        try:
+            _, body = _get(f"http://127.0.0.1:{port}/healthz", timeout=5)
+            h = json.loads(body)
+            if h.get("last_completed_step") is not None and not done.is_set():
+                live_health = h
+                _, mbody = _get(f"http://127.0.0.1:{port}/metrics", timeout=5)
+                live_metrics = mbody.decode()
+                break
+        except (urllib.error.URLError, OSError):
+            pass  # server not up yet
+        time.sleep(0.02)
+    t.join(600)
+    assert not errs, errs
+    assert live_health is not None, "never saw a completed step while live"
+    assert live_health["status"] in ("ok", "starting")
+    assert live_health["feeder_alive"] is True
+    _assert_prometheus_text(live_metrics)
+    for metric in ("hbnlp_train_steps_total", "hbnlp_train_tokens_total",
+                   "hbnlp_feeder_queue_depth", "hbnlp_last_completed_step",
+                   "hbnlp_metric_drain_seconds_count",
+                   "hbnlp_feeder_h2d_seconds_count"):
+        assert metric in live_metrics, metric
+    # exporter is gone after the run, tracer restored
+    assert spans_mod.get_tracer() is None
+    with pytest.raises((urllib.error.URLError, OSError)):
+        _get(f"http://127.0.0.1:{port}/healthz", timeout=2)
+    # Obs.close froze the callback gauges: the process-global registry no
+    # longer references the run's feeder/health (no leak into later scrapes)
+    from homebrewnlp_tpu.obs.registry import REGISTRY
+    assert REGISTRY.get("hbnlp_feeder_queue_depth").value() == 0
+    assert REGISTRY.get("hbnlp_last_completed_step").value() >= 0
+    # trace.json: valid Chrome trace covering the required phases
+    doc = json.load(open(tmp_path / "trace.json"))
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert {"step", "feed", "drain", "checkpoint"} <= names, names
+    threads = {e["args"]["name"] for e in doc["traceEvents"]
+               if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert any("device-feeder" in n for n in threads), threads
+    # the metrics file carries finite losses for every step
+    rows = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert all(np.isfinite(r["loss"]) for r in rows if "loss" in r)
+
+
+def test_obs_off_loss_sequence_matches_obs_on(tmp_path, eight_devices):
+    """Observability must not perturb training: the loss sequence with
+    spans + registry + watchdog armed equals the all-off sequence."""
+    base = dict(async_inflight_steps=0, device_prefetch_depth=0)
+    cfg_off = tiny_config(model_path=str(tmp_path / "off"), **base)
+    cli.train(cfg_off, _args(8))
+    cfg_on = tiny_config(model_path=str(tmp_path / "on"), obs_spans=True,
+                         watchdog_factor=100.0, **base)
+    cli.train(cfg_on, _args(8))
+
+    from homebrewnlp_tpu.train.metrics import read_metric_rows
+
+    def losses(p):
+        return [r["loss"] for r in read_metric_rows(str(p))]
+
+    assert losses(tmp_path / "off") == losses(tmp_path / "on")

@@ -1034,14 +1034,15 @@ def test_cli_train_1f1b_checkpoint_resume(eight_devices, tmp_path):
 
     cli_main(["--model", str(cfg_path), "--run_mode", "train",
               "--steps", "6"])
+    from homebrewnlp_tpu.train.metrics import read_metric_rows
     metrics_file = tmp_path / "run" / "metrics.jsonl"
-    rows = [json.loads(l) for l in metrics_file.read_text().splitlines()]
+    rows = read_metric_rows(str(metrics_file))
     assert rows[-1]["step"] == 5
     assert "accuracy" in rows[-1] and "token_loss" in rows[-1]
 
     cli_main(["--model", str(cfg_path), "--run_mode", "train",
               "--steps", "9"])
-    rows = [json.loads(l) for l in metrics_file.read_text().splitlines()]
+    rows = read_metric_rows(str(metrics_file))
     # restore picked up the step-4+ checkpoint and continued to 9
     assert rows[-1]["step"] == 8
     assert all(np.isfinite(r["loss"]) for r in rows)

@@ -6,8 +6,8 @@ Abstractly traces the train / eval / decode steps of each config on CPU
 passes over the jaxprs (collective census vs goldens, dtype promotion,
 donation, sharding specs, constant bloat), plus AST lint of the source tree
 (axis-literal registry, .x escape ratchet, traced RNG/time, PartitionSpec
-axes).  See docs/static_analysis.md for the rule catalogue, golden update
-workflow, and suppression syntax.
+axes, host-sync ratchet, obs-in-trace ratchet).  See docs/static_analysis.md
+for the rule catalogue, golden update workflow, and suppression syntax.
 
 Usage:
   python tools/graftcheck.py --all-configs            # the CI gate
