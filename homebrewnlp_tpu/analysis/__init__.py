@@ -18,8 +18,10 @@ them BEFORE compilation, on CPU, in seconds:
   ``.x`` escape ratchet, Python-side RNG/time in traced code,
   ``PartitionSpec`` literals naming unknown mesh axes, the host-sync
   ratchet (no blocking device->host reads inside the async train loop),
-  and the obs-in-trace ratchet (no span/registry observability calls
-  inside jit-traced code).
+  the obs-in-trace ratchet (no span/registry observability calls inside
+  jit-traced code), and the bare-io ratchet (no unwrapped open()/orbax
+  storage calls in the train/data hot paths — everything routes through
+  the reliability retry layer).
 
 Entry point: ``python tools/graftcheck.py --all-configs`` (see
 docs/static_analysis.md).
@@ -33,5 +35,5 @@ GRAPH_RULES = ("collective-census", "dtype-promotion", "donation",
                "sharding-spec", "constant-bloat")
 # "dtype-promotion" appears in both: the AST pass carries its static twin
 AST_RULES = ("axis-literal", "x-escape", "traced-rng", "partitionspec-axis",
-             "dtype-promotion", "host-sync", "obs-in-trace")
+             "dtype-promotion", "host-sync", "obs-in-trace", "bare-io")
 ALL_RULES = tuple(dict.fromkeys(GRAPH_RULES + AST_RULES))

@@ -33,6 +33,16 @@ Static source rules (no tracing, no jax beyond the axis registry import):
   host loop layers (main.py, data/feed.py, train/metrics.py, serve/).
   Ratcheted: per-file counts pinned in ``goldens/ast_obs_in_trace.json``
   (committed empty) may only go down.
+- ``bare-io``: no unwrapped I/O in the ``train/`` and ``data/`` hot paths
+  (docs/reliability.md) — builtin ``open()`` calls, orbax
+  ``CheckpointManager`` construction, and ``manager.save/restore/
+  wait_until_finished`` calls must route through the reliability retry
+  layer (``reliability.retry``) or ``data/fs.py``; a call-site the retry
+  wrapper itself invokes carries a ``graftcheck: disable=bare-io``
+  suppression marking it as wrapped.  Ratcheted at ZERO: the committed
+  golden ``goldens/ast_bare_io.json`` is empty, so any new bare call is an
+  error.  (``data/fs.py`` — the I/O switch-point — and ``data/synthetic.py``
+  — test-fixture generation — are exempt.)
 
 Suppression: append ``# graftcheck: disable=<rule>`` (or a bare
 ``# graftcheck: disable``) to the offending line.
@@ -412,6 +422,102 @@ def check_obs_in_trace(root: str, update_goldens: bool = False
                   "host loop layers instead (docs/observability.md)")
 
 
+#: hot paths the bare-io rule audits: every I/O call here must go through
+#: the reliability retry layer (or fs.py) so a transient storage error
+#: cannot kill a run
+BARE_IO_SCOPE = ("homebrewnlp_tpu/train", "homebrewnlp_tpu/data")
+#: fs.py IS the I/O layer; synthetic.py writes test fixtures only
+BARE_IO_EXEMPT = ("homebrewnlp_tpu/data/fs.py",
+                  "homebrewnlp_tpu/data/synthetic.py")
+#: orbax CheckpointManager method calls that hit storage
+BARE_IO_MANAGER_METHODS = frozenset({"save", "restore",
+                                     "wait_until_finished"})
+
+
+def _orbax_aliases(tree: ast.Module
+                   ) -> typing.Tuple[typing.Set[str], typing.Set[str]]:
+    """(orbax module aliases, CheckpointManager-constructor aliases).
+
+    ``import orbax.checkpoint as ocp`` -> ({"ocp"}, {}); ``from
+    orbax.checkpoint import CheckpointManager as CM`` -> ({"CM"}, {"CM"})
+    — tracking the imported TARGET name means an alias cannot slip the
+    constructor past the ratchet."""
+    aliases: typing.Set[str] = set()
+    ctor_aliases: typing.Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[0] == "orbax":
+                    aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] == "orbax":
+                for a in node.names:
+                    local = a.asname or a.name
+                    aliases.add(local)
+                    if a.name == "CheckpointManager":
+                        ctor_aliases.add(local)
+    return aliases, ctor_aliases
+
+
+def bare_io_counts(root: str) -> typing.Dict[str, int]:
+    """Per-file counts of unwrapped I/O calls in the hot paths: builtin
+    ``open(...)``, orbax ``CheckpointManager(...)`` construction (rooted at
+    an orbax alias), and ``<...>.manager.save/restore/wait_until_finished``
+    calls.  Purely syntactic; a site invoked THROUGH the retry layer is
+    marked with ``# graftcheck: disable=bare-io`` on its line."""
+    counts: typing.Dict[str, int] = {}
+    for path, rel in _iter_py_files(root, BARE_IO_SCOPE):
+        norm = rel.replace(os.sep, "/")
+        if any(norm == e or norm.startswith(e + "/") for e in BARE_IO_EXEMPT):
+            continue
+        src = open(path).read()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        orbax_aliases, ctor_aliases = _orbax_aliases(tree)
+        n = 0
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = False
+            if isinstance(node.func, ast.Name):
+                # builtin open, or a from-imported (possibly aliased)
+                # orbax CheckpointManager
+                hit = node.func.id == "open" or node.func.id in ctor_aliases
+            elif isinstance(node.func, ast.Attribute):
+                # chain outward-in: self.manager.save -> ["save", "manager"]
+                chain: typing.List[str] = []
+                cur: ast.expr = node.func
+                while isinstance(cur, ast.Attribute):
+                    chain.append(cur.attr)
+                    cur = cur.value
+                rooted_orbax = (isinstance(cur, ast.Name)
+                                and cur.id in orbax_aliases)
+                hit = ((rooted_orbax and chain[0] == "CheckpointManager")
+                       or (chain[0] in BARE_IO_MANAGER_METHODS
+                           and "manager" in chain[1:]))
+            if hit and not _suppressed(lines, node.lineno, "bare-io"):
+                n += 1
+        if n:
+            counts[norm] = n
+    return counts
+
+
+def bare_io_golden_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "goldens", "ast_bare_io.json")
+
+
+def check_bare_io(root: str, update_goldens: bool = False
+                  ) -> typing.List[Finding]:
+    return _check_ratchet(
+        "bare-io", bare_io_counts(root), bare_io_golden_path(),
+        update_goldens,
+        unit="unwrapped open()/orbax call(s) in train/data hot paths",
+        over_hint="route storage I/O through reliability.retry (or "
+                  "data/fs.py) so transient errors back off instead of "
+                  "killing the run (docs/reliability.md)")
+
+
 def check_traced_rng(root: str) -> typing.List[Finding]:
     findings: typing.List[Finding] = []
     for path, rel in _iter_py_files(root, TRACED_RNG_SCOPE):
@@ -546,6 +652,7 @@ def run_ast_rules(root: str, update_goldens: bool = False,
         "dtype-promotion": lambda: check_f64_literals(root),
         "host-sync": lambda: check_host_sync(root, update_goldens),
         "obs-in-trace": lambda: check_obs_in_trace(root, update_goldens),
+        "bare-io": lambda: check_bare_io(root, update_goldens),
     }
     findings: typing.List[Finding] = []
     for name, fn in table.items():

@@ -213,6 +213,21 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # would drown steady-state timing) and capture profile_steps updates
     profile_start=3,
     profile_steps=3,
+    # fault tolerance (docs/reliability.md).
+    # grace_deadline_s: wall budget for the SIGTERM/SIGINT grace shutdown
+    # (drain the async loop + cut a final checkpoint); exceeded -> forced
+    # exit EXIT_GRACE_TIMEOUT.  0 disables the forced deadline.
+    grace_deadline_s=30.0,
+    # ckpt_retries: storage retries (exponential backoff) around each
+    # checkpoint save/restore/sidecar/manifest operation
+    ckpt_retries=2,
+    # corrupt_record_budget: >0 skips (and logs + counts) up to N unreadable
+    # data records/shards per pipeline instead of dying; 0 = strict fail-fast
+    corrupt_record_budget=0,
+    # fault_plan: fault-injection spec for chaos tests, e.g.
+    # "ckpt_write:fail@2;feeder:die@step10;sigterm@step25"
+    # (grammar in reliability/faults.py; HBNLP_FAULT_PLAN env var when empty)
+    fault_plan="",
     current_step=0,
     steps_per_checkpoint=100_000,
     use_checkpointing=False,
@@ -329,6 +344,19 @@ class Config:
                 "so a window starting there would not capture steady state")
         if self.profile_steps < 1:
             raise ValueError("profile_steps must be >= 1")
+        if self.grace_deadline_s < 0:
+            raise ValueError("grace_deadline_s must be >= 0 "
+                             "(0 = no forced deadline on grace shutdown)")
+        if self.ckpt_retries < 0:
+            raise ValueError("ckpt_retries must be >= 0 (0 = single attempt)")
+        if self.corrupt_record_budget < 0:
+            raise ValueError("corrupt_record_budget must be >= 0 "
+                             "(0 = fail fast on any unreadable record)")
+        if self.fault_plan:
+            # surface a typoed plan at config load, not mid-run; parse_plan
+            # raises ValueError naming the bad entry
+            from .reliability.faults import parse_plan
+            parse_plan(self.fault_plan)
 
         for attr in ("position_embedding", "token_embedding", "output_embedding",
                      "empty_frame_embedding"):

@@ -23,6 +23,7 @@ from ..config import Config
 from ..nd import NT
 from ..obs import spans
 from ..parallel.sharding import spec_for
+from ..reliability import faults
 
 # input name -> logical axis names (the input_pipeline_shape of the reference,
 # dataclass.py:310-337)
@@ -204,6 +205,11 @@ class DeviceFeeder:
     def _produce(self) -> None:
         try:
             while not self._stop.is_set():
+                # fault-injection site: "feeder:die@N" kills this producer
+                # exactly like a real bug would — the error parks, the
+                # consumer re-raises it, and the run exits nonzero for the
+                # supervisor to relaunch (docs/reliability.md)
+                faults.hit("feeder")
                 try:
                     with spans.span("feed/source"):
                         np_batch = next(self.source)

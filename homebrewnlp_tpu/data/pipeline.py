@@ -33,6 +33,7 @@ import typing
 import numpy as np
 
 from ..config import Config
+from ..reliability import CorruptRecordBudget, faults
 from .tfrecord import decode_example, read_records
 
 
@@ -77,22 +78,49 @@ class _FileWindows:
     """Windows of ``window`` tokens, shift ``shift``, per record of one file.
     ``skip_tokens`` drops leading tokens of the file's concatenated stream
     (for run-log resume); ``skip_windows`` drops emitted windows (for direct
-    cursor resume)."""
+    cursor resume).  ``budget`` (a reliability.CorruptRecordBudget) turns an
+    unreadable record into skip-and-log instead of run death: a bad decode
+    skips the record, a failed read abandons the rest of the shard (the
+    reader position is unknown after a framing error); None keeps the
+    strict fail-fast behavior.  NOTE a skipped record shifts this file's
+    window numbering, so a cursor checkpointed across a skip replays
+    identically only while the corruption persists — the skip is logged
+    loudly for exactly that reason."""
 
     def __init__(self, path: str, window: int, shift: int,
-                 skip_tokens: int = 0, skip_windows: int = 0):
+                 skip_tokens: int = 0, skip_windows: int = 0,
+                 budget: typing.Optional[CorruptRecordBudget] = None):
         self.path = path
         self.window = window
         self.shift = shift
         self.skip_tokens = skip_tokens
         self.emitted = 0
         self._skip_windows = skip_windows
+        self.budget = budget
 
     def __iter__(self) -> typing.Iterator[np.ndarray]:
         decode = decoder_for(self.path)
         remaining_skip = self.skip_tokens
-        for payload in read_records(self.path):
-            tokens = decode(payload)
+        records = read_records(self.path)
+        while True:
+            try:
+                # fault site "data_read:fail@N" exercises the budget path
+                faults.hit("data_read")
+                payload = next(records)
+            except StopIteration:
+                return
+            except Exception as e:
+                if self.budget is None:
+                    raise
+                self.budget.spend(self.path, e)  # raises when over budget
+                return  # framing broken: reader position unknown past here
+            try:
+                tokens = decode(payload)
+            except Exception as e:
+                if self.budget is None:
+                    raise
+                self.budget.spend(self.path, e)
+                continue  # one bad record: the framing still holds
             if remaining_skip:
                 take = min(remaining_skip, len(tokens))
                 tokens = tokens[take:]
@@ -114,13 +142,15 @@ class _Interleave:
     counts for the open slots plus the next file index."""
 
     def __init__(self, files: typing.Sequence[str], skips: typing.Sequence[int],
-                 window: int, shift: int, cycle: int, repeat: bool):
+                 window: int, shift: int, cycle: int, repeat: bool,
+                 budget: typing.Optional[CorruptRecordBudget] = None):
         self.files = list(files)
         self.skips = list(skips)
         self.window = window
         self.shift = shift
         self.cycle = max(1, cycle)
         self.repeat = repeat
+        self.budget = budget
         self.next_file = 0
         self._pos = 0
         self._slots: typing.List[typing.Tuple[int, _FileWindows, typing.Iterator]] = []
@@ -130,7 +160,7 @@ class _Interleave:
         src = _FileWindows(self.files[file_idx % len(self.files)],
                            self.window, self.shift,
                            skip_tokens=self.skips[file_idx % len(self.files)],
-                           skip_windows=skip_windows)
+                           skip_windows=skip_windows, budget=self.budget)
         return file_idx, src, iter(src)
 
     def _fill(self) -> None:
@@ -244,9 +274,13 @@ class GptPipeline:
         # resume cursor survive the epoch boundary)
         repeat = (cfg.use_random_dataloader if cfg.repeat_dataset is None
                   else bool(cfg.repeat_dataset))
+        # corrupt_record_budget > 0: unreadable records/shards are skipped
+        # (logged + counted) up to the budget instead of killing the run
+        budget = (CorruptRecordBudget(cfg.corrupt_record_budget)
+                  if cfg.corrupt_record_budget > 0 else None)
         self.interleave = _Interleave(
             files, file_skips, window, cfg.sequence_length,
-            cfg.interleaved_datasets, repeat=repeat)
+            cfg.interleaved_datasets, repeat=repeat, budget=budget)
         self.stream: typing.Iterable = self.interleave
         if cfg.use_random_dataloader and cfg.shuffle_buffer > 1:
             self.stream = _ShuffleBuffer(self.interleave, cfg.shuffle_buffer,
@@ -305,8 +339,11 @@ class JannetTextPipeline:
                                    cfg.data_seed * int(cfg.shuffle_input_filenames))
         per_frame = cfg.language_token_per_frame - 1
         window = (cfg.time_patch_size + 1) * per_frame
+        budget = (CorruptRecordBudget(cfg.corrupt_record_budget)
+                  if cfg.corrupt_record_budget > 0 else None)
         self.interleave = _Interleave(files, skips, window, window,
-                                      cfg.interleaved_datasets, repeat=True)
+                                      cfg.interleaved_datasets, repeat=True,
+                                      budget=budget)
         self.stream: typing.Iterable = _ShuffleBuffer(
             self.interleave, cfg.shuffle_buffer, cfg.data_seed)
 

@@ -27,11 +27,14 @@ class RunLog:
     """The DataLog artifact: one entry per completed run."""
 
     def __init__(self, model_path: str):
+        from ..reliability import retry_call
         self.path = os.path.join(model_path, "data_log.json")
         self.runs: typing.List[dict] = []
         if os.path.exists(self.path):
-            with open(self.path) as f:
-                self.runs = json.load(f)
+            def _read() -> str:
+                with open(self.path) as f:  # graftcheck: disable=bare-io
+                    return f.read()
+            self.runs = json.loads(retry_call(_read, site="runlog"))
 
     def append(self, *, steps: int, batch_size: int, slice_count: int,
                ctx: int, grad_accumulation: int = 1, interleave_size: int = 1,
@@ -44,9 +47,14 @@ class RunLog:
                               timestamp=time.time()))
 
     def save(self) -> None:
+        from ..reliability import retry_call
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        with open(self.path, "w") as f:
-            json.dump(self.runs, f)
+
+        def _write() -> None:
+            with open(self.path, "w") as f:  # graftcheck: disable=bare-io
+                json.dump(self.runs, f)
+
+        retry_call(_write, site="runlog")
 
 
 def tokens_from_filename(path: str) -> int:

@@ -206,9 +206,13 @@ class RecordWriter:
 def read_records(path: str, verify: bool = False,
                  skip: int = 0) -> typing.Iterator[bytes]:
     """Yield raw record payloads; ``skip`` fast-forwards without CRC work.
-    ``path`` may be a remote URL (gs://...) — see data/fs.py."""
+    ``path`` may be a remote URL (gs://...) — see data/fs.py.  The open is
+    retried with backoff (reliability.retry): a transient storage hiccup at
+    shard-open must not kill a multi-day run."""
     from . import fs
-    with fs.open_stream(path, "rb") as f:
+    from ..reliability import retry_call
+    with retry_call(lambda: fs.open_stream(path, "rb"),
+                    site="data_open") as f:
         index = 0
         while True:
             header = f.read(8)

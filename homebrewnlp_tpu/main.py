@@ -60,7 +60,8 @@ def _build_state(cfg, batch, mesh=None):
     data_state = None
     if cfg.use_checkpointing:
         ckpt = Checkpointer(os.path.join(cfg.model_path, "ckpt"),
-                            cfg.max_checkpoints_keep)
+                            cfg.max_checkpoints_keep,
+                            retries=cfg.ckpt_retries)
         state, data_state = ckpt.restore(state, cfg)
         color_print(f"restored step {int(state.step)} from checkpoints"
                     if int(state.step) else "fresh initialization")
@@ -77,30 +78,48 @@ def _dump_run_artifacts(cfg, trainer, params) -> None:
 
 
 def train(cfg, args) -> None:
-    """Observability lifecycle wrapper around the step loop: builds the
-    per-run ``Obs`` bundle (span tracer, /metrics + /healthz exporter, hang
-    watchdog — docs/observability.md; all knobs default-off and inert),
-    guarantees ``trace.json`` export + thread shutdown on ANY exit, and
-    delegates to ``_train_loop``."""
+    """Observability + fault-tolerance lifecycle wrapper around the step
+    loop: builds the per-run ``Obs`` bundle (span tracer, /metrics +
+    /healthz exporter, hang watchdog — docs/observability.md; all knobs
+    default-off and inert), arms the fault-injection plan, installs the
+    SIGTERM/SIGINT grace handlers (docs/reliability.md), guarantees
+    ``trace.json`` export + thread shutdown on ANY exit, and delegates to
+    ``_train_loop``.  A signal-triggered exit drains the async loop, cuts a
+    grace checkpoint inside ``cfg.grace_deadline_s``, and exits with
+    ``EXIT_PREEMPTED`` so a supervisor (tools/supervise.py) can tell
+    preemption from crash."""
     from .obs import Obs
+    from .reliability import EXIT_PREEMPTED, GraceController, faults
+    from .train import color_print
+    # installed (or cleared) EVERY run: a plan must never leak across runs
+    faults.install(cfg.fault_plan or None)
     obs = Obs.from_config(cfg)
+    grace = GraceController(cfg.grace_deadline_s)
     try:
         # start() inside the try: a partial start (e.g. obs_port already
         # bound) must still unwind through close(), or the ambient tracer
         # would leak into every later run in this process
         obs.start()
-        _train_loop(cfg, args, obs)
+        grace.install()
+        _train_loop(cfg, args, obs, grace)
     finally:
+        grace.uninstall()
         obs.close()
+    if grace.triggered:
+        color_print(f"{grace.signame} handled: grace checkpoint cut; "
+                    f"exiting with preemption code {EXIT_PREEMPTED}")
+        raise SystemExit(EXIT_PREEMPTED)
 
 
-def _train_loop(cfg, args, obs) -> None:
+def _train_loop(cfg, args, obs, grace) -> None:
     """Async-dispatch step loop (docs/performance.md): step indices are
     computed ON HOST (``step0 + (u - u0) * m`` — no device value is read on
     the hot path; graftcheck's ``host-sync`` rule pins this), batches are
     assembled + transferred by a background ``DeviceFeeder`` thread, and
     metrics drain through a bounded ``AsyncMetricWriter`` window so up to
-    ``cfg.async_inflight_steps`` updates stay dispatched-but-undrained."""
+    ``cfg.async_inflight_steps`` updates stay dispatched-but-undrained.
+    ``grace.triggered`` (SIGTERM/SIGINT) breaks the loop before the next
+    dispatch; the normal tail then cuts the grace checkpoint."""
     import itertools
 
     import jax
@@ -108,6 +127,7 @@ def _train_loop(cfg, args, obs) -> None:
     from .data.feed import DeviceFeeder
     from .data.synthetic import synthetic_text_batch
     from .obs import spans
+    from .reliability import faults
     from .train import AsyncMetricWriter, MetricWriter, color_print
     from .train.metrics import config_hash
 
@@ -142,6 +162,11 @@ def _train_loop(cfg, args, obs) -> None:
         import jax.numpy as jnp
         state = state._replace(step=jnp.asarray(cfg.current_step, jnp.int32))
     step0 = int(state.step)
+    if step0 > 0:
+        # a resumed (or step-forced) run must not refire step-site fault
+        # rules at or behind its starting position — a sigterm@stepN plan
+        # inherited by every supervisor relaunch would livelock otherwise
+        faults.disarm_until("step", step0)
     pipe = None
     if have_data:
         # the real (prefetched) pipeline, with the checkpointed cursor
@@ -159,7 +184,8 @@ def _train_loop(cfg, args, obs) -> None:
                                registry=obs.registry if obs.enabled else None)
     # run boundary marker: restarts append to metrics.jsonl, so bench /
     # post-mortem tooling splits runs on these records
-    writer.write_run_start(step0, config_hash(cfg))
+    cfg_hash = config_hash(cfg)
+    writer.write_run_start(step0, cfg_hash)
     run_log = RunLog(cfg.model_path)
     # train_steps (and the step counter) count macro slices, reference
     # run.py:155,249: one optimizer update advances the counter by
@@ -202,6 +228,18 @@ def _train_loop(cfg, args, obs) -> None:
                         f"raise --steps")
         tokens_per_update = cfg.train_batch_size * m * cfg.sequence_length
         for u in range(u0, updates_total):
+            # fault-injection site "step" keys on the GLOBAL counter so
+            # e.g. sigterm@step25 survives a resume; inert without a plan
+            faults.hit("step", value=step0 + (u - u0) * m)
+            if grace.triggered:
+                # preemption: stop BEFORE dispatching another update — the
+                # loop tail below cuts the grace checkpoint at the last
+                # completed step and the process exits EXIT_PREEMPTED
+                color_print(f"{grace.signame or 'signal'} received: "
+                            f"stopping at update {u} "
+                            f"(step {step0 + (u - u0) * m}) for the grace "
+                            "checkpoint")
+                break
             try:
                 with spans.span("feed", update=u):
                     gb = next(feeder)
@@ -253,7 +291,8 @@ def _train_loop(cfg, args, obs) -> None:
                 with spans.span("checkpoint", step=host_step + m), \
                         obs.pause("checkpoint"):
                     ckpt.save(state, data_state,
-                              master_dtype=cfg.storage_dtype)
+                              master_dtype=cfg.storage_dtype,
+                              config_hash=cfg_hash)
                 if obs.enabled:
                     # memory_stats() can sync the device, so it samples at
                     # the checkpoint cadence, never per step
@@ -276,12 +315,15 @@ def _train_loop(cfg, args, obs) -> None:
         jax.profiler.stop_trace()
         color_print(f"profiler trace written to {args.profile}")
     if ckpt is not None:
+        # on a grace exit this IS the grace checkpoint (save() waits on the
+        # orbax barrier before writing sidecar + manifest, so returning
+        # means durable — within GraceController's deadline timer)
         with spans.span("checkpoint", step=step0 + (u_done - u0) * m), \
                 obs.pause("checkpoint"):
             ckpt.save(state,
                       {"pipeline": feeder.state_dict()} if pipe else None,
-                      master_dtype=cfg.storage_dtype)
-            ckpt.wait()
+                      master_dtype=cfg.storage_dtype,
+                      config_hash=cfg_hash)
         if obs.enabled:
             obs.sample_device_memory()
     # rows consumed per update = batch * macro_batching (grad_accumulation

@@ -112,7 +112,8 @@ class Obs:
                                        health=self.health)
         if self.watchdog_factor:
             self.watchdog = Watchdog(self.health, self.model_path,
-                                     factor=self.watchdog_factor)
+                                     factor=self.watchdog_factor,
+                                     registry=self.registry)
             self.watchdog.start()
         return self
 

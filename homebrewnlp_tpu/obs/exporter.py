@@ -338,10 +338,17 @@ class Watchdog(threading.Thread):
     def __init__(self, health: Health, model_path: str,
                  factor: typing.Optional[float] = None, poll_s: float = 1.0,
                  min_stall_s: typing.Optional[float] = None,
-                 max_pause_s: typing.Optional[float] = None):
+                 max_pause_s: typing.Optional[float] = None,
+                 registry: typing.Optional[MetricsRegistry] = None):
         super().__init__(name="obs-watchdog", daemon=True)
         self.health = health
         self.model_path = model_path
+        # stall visibility beyond the diagnostics dir: the supervisor and
+        # alerting watch this counter on /metrics instead of scraping files
+        reg = registry if registry is not None else REGISTRY
+        self._stalls = reg.counter(
+            "hbnlp_watchdog_stalls_total",
+            "hang-watchdog stall dumps fired (one per distinct stall)")
         if factor is not None:
             health.stall_factor = float(factor)
         if min_stall_s is not None:
@@ -369,6 +376,7 @@ class Watchdog(threading.Thread):
                 and self._fired_at_step == step):
             return  # already dumped for this stall
         self._fired_at_step = step
+        self._stalls.inc()
         paused_s = h.paused_seconds()
         threshold = h.stall_threshold()
         if paused_s is not None:

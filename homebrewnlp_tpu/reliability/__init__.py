@@ -1,0 +1,145 @@
+"""Fault tolerance: retry/backoff, fault injection, grace shutdown, budgets.
+
+The reference was built for preemptible TPU pods — it recovers the step from
+the checkpoint dir and replays the data stream from run logs — but treated
+each failure as an operator problem.  This layer makes failure a first-class
+input (docs/reliability.md):
+
+- :mod:`~homebrewnlp_tpu.reliability.retry` — one backoff policy + wrapper
+  for every flaky I/O call-site, with per-site obs counters.
+- :mod:`~homebrewnlp_tpu.reliability.faults` — the fault-injection plan that
+  proves each recovery path in CI (chaos job).
+- :class:`GraceController` — SIGTERM/SIGINT handlers that drain the async
+  loop and cut a grace checkpoint inside ``cfg.grace_deadline_s``, then exit
+  with :data:`EXIT_PREEMPTED` so a supervisor can tell preemption from crash.
+- :class:`CorruptRecordBudget` — skip-and-log for unreadable data records,
+  bounded so silent data loss cannot masquerade as progress.
+- ``tools/supervise.py`` consumes the exit codes to relaunch with backoff
+  and abort on crash loops.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import typing
+
+from . import faults  # noqa: F401
+from .retry import (DEFAULT_POLICY, FLUSH_POLICY, RetryPolicy,  # noqa: F401
+                    retry_call, retrying)
+
+LOG = logging.getLogger("homebrewnlp_tpu.reliability")
+
+#: SIGTERM/SIGINT handled: the step loop drained and a grace checkpoint was
+#: cut — the supervisor relaunches immediately, no backoff (preemption is
+#: not a bug)
+EXIT_PREEMPTED = 83
+#: the grace deadline expired (or a second signal arrived) before the grace
+#: checkpoint finished — work since the last periodic checkpoint is lost
+EXIT_GRACE_TIMEOUT = 84
+#: the supervisor aborted: K consecutive exits with no step progress
+EXIT_CRASH_LOOP = 85
+
+
+class GraceController:
+    """Preemption-safe shutdown: first SIGTERM/SIGINT sets ``triggered`` (the
+    step loop polls it, breaks, and the normal tail cuts the final
+    checkpoint); a daemon timer forces ``EXIT_GRACE_TIMEOUT`` if the drain
+    exceeds ``deadline_s``, and a second signal forces it immediately.
+
+    Handlers install only on the main thread (CPython restriction); a train
+    loop hosted on a worker thread (tests, notebooks) simply never sees
+    ``triggered`` and keeps today's behavior."""
+
+    def __init__(self, deadline_s: float = 30.0,
+                 exit_fn: typing.Callable[[int], None] = None):
+        self.deadline_s = float(deadline_s)
+        self.signame: typing.Optional[str] = None
+        self._event = threading.Event()
+        self._timer: typing.Optional[threading.Timer] = None
+        self._prev: typing.Dict[int, typing.Any] = {}
+        self._installed = False
+        self._exit_fn = os._exit if exit_fn is None else exit_fn
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    def install(self) -> "GraceController":
+        if threading.current_thread() is not threading.main_thread():
+            LOG.info("grace signal handlers unavailable off the main thread; "
+                     "SIGTERM keeps its default (immediate) behavior")
+            return self
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            self._prev[sig] = signal.signal(sig, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if self._installed:
+            for sig, prev in self._prev.items():
+                signal.signal(sig, prev)
+            self._prev.clear()
+            self._installed = False
+
+    def _handle(self, signum, frame) -> None:
+        if self._event.is_set():
+            LOG.error("second signal during grace shutdown; exiting "
+                      "immediately with code %d", EXIT_GRACE_TIMEOUT)
+            self._exit_fn(EXIT_GRACE_TIMEOUT)
+            return
+        self.signame = signal.Signals(signum).name
+        self._event.set()
+        if self.deadline_s > 0:
+            self._timer = threading.Timer(self.deadline_s, self._expire)
+            self._timer.daemon = True
+            self._timer.start()
+        LOG.warning("%s received: draining the step loop and cutting a "
+                    "grace checkpoint (deadline %.0fs)", self.signame,
+                    self.deadline_s)
+
+    def _expire(self) -> None:
+        LOG.error("grace deadline (%.0fs) exceeded before the grace "
+                  "checkpoint finished; forcing exit %d", self.deadline_s,
+                  EXIT_GRACE_TIMEOUT)
+        self._exit_fn(EXIT_GRACE_TIMEOUT)
+
+
+class CorruptRecordBudget:
+    """Bounded skip-and-log for unreadable records/shards.
+
+    Each ``spend`` logs the skip and increments
+    ``hbnlp_corrupt_records_total``; crossing ``limit`` re-raises — a
+    corrupt *fleet* of shards is a data problem the run must surface, not
+    paper over.  Shared across one pipeline's files (thread-safe: the
+    prefetcher thread reads through it)."""
+
+    def __init__(self, limit: int, registry=None):
+        from ..obs.registry import REGISTRY
+        self.limit = int(limit)
+        self.spent = 0
+        self._lock = threading.Lock()
+        reg = REGISTRY if registry is None else registry
+        self._counter = reg.counter(
+            "hbnlp_corrupt_records_total",
+            "unreadable data records/shards skipped under the corrupt "
+            "budget")
+
+    def spend(self, what: str, exc: BaseException) -> None:
+        """Account one unreadable record/shard; raises when over budget."""
+        with self._lock:
+            self.spent += 1
+            spent = self.spent
+        self._counter.inc()
+        if spent > self.limit:
+            LOG.error("corrupt-record budget exhausted (%d > %d) at %s: %r",
+                      spent, self.limit, what, exc)
+            raise OSError(
+                f"corrupt-record budget exhausted ({spent} > {self.limit}) "
+                f"reading {what}") from exc
+        LOG.warning("skipping unreadable data in %s (%r) — corrupt-record "
+                    "budget %d/%d", what, exc, spent, self.limit)

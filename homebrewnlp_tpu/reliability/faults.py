@@ -1,0 +1,238 @@
+"""Fault-injection harness: an env/config-driven plan of deliberate failures.
+
+The recovery paths this PR adds (manifest fallback, retry/backoff, grace
+checkpoints, supervisor relaunch) are exactly the code that never runs in a
+healthy CI — so they rot.  A *fault plan* arms deterministic failures at
+instrumented sites and the chaos suite (tests/reliability_test.py, the CI
+``chaos`` job) proves each recovery end-to-end with bit-identical losses
+after resume.
+
+Grammar (``cfg.fault_plan`` or the ``HBNLP_FAULT_PLAN`` env var)::
+
+    plan    := entry (';' entry)*
+    entry   := [site ':'] action '@' trigger
+    trigger := ['step'] integer          # "step25" == "25"
+
+An entry without a site rides the ``step`` site (so ``sigterm@step25`` reads
+naturally).  Each rule fires **once**.  Sites instrumented today:
+
+- ``step``        — per update in the train loop; trigger matches the GLOBAL
+                    step counter (survives resume), not a per-run count
+- ``ckpt_write``  — per checkpoint commit attempt (before the orbax write)
+- ``ckpt_commit`` — after a successful commit (``path`` = the step dir)
+- ``feeder``      — per batch in the DeviceFeeder producer thread
+- ``data_read``   — per record pulled from a TFRecord shard
+
+Actions:
+
+- ``fail``    — raise :class:`FaultInjectedIOError` (an ``OSError``): flows
+                through the retry layer like a real storage error
+- ``die``     — raise :class:`FaultInjectedCrash` (``RuntimeError``): NOT
+                retryable, kills the enclosing actor like a real bug
+- ``sigterm`` / ``sigint`` — deliver the signal to this process (preemption)
+- ``corrupt`` — bit-flip the largest file under the site's ``path`` kwarg
+                (``ckpt_commit:corrupt@1`` tears the freshest checkpoint)
+
+Example: ``fault_plan="ckpt_write:fail@2;feeder:die@step10;sigterm@step25"``
+fails the 2nd checkpoint write once (retried), kills the feeder thread at
+its 10th batch, and preempts the run at global step 25.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal as signal_mod
+import threading
+import typing
+
+LOG = logging.getLogger("homebrewnlp_tpu.reliability.faults")
+
+ACTIONS = ("fail", "die", "sigterm", "sigint", "corrupt")
+#: bare actions (no explicit site) ride the train-step site
+DEFAULT_SITE = "step"
+
+
+class FaultInjected(Exception):
+    """Marker mixin: every injected fault is recognizable in logs/tests."""
+
+
+class FaultInjectedIOError(FaultInjected, OSError):
+    """Retryable injected failure (flows through reliability.retry)."""
+
+
+class FaultInjectedCrash(FaultInjected, RuntimeError):
+    """Non-retryable injected failure (kills the enclosing actor)."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    site: str
+    action: str
+    at: int
+    fired: bool = False
+
+    def __str__(self) -> str:
+        return f"{self.site}:{self.action}@{self.at}"
+
+
+def parse_plan(spec: typing.Optional[str]) -> typing.List[FaultRule]:
+    """Parse the plan grammar; raises ``ValueError`` with the bad entry."""
+    rules: typing.List[FaultRule] = []
+    for entry in (spec or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "@" not in entry:
+            raise ValueError(f"fault plan entry {entry!r}: expected "
+                             "[site:]action@trigger")
+        left, trigger = entry.rsplit("@", 1)
+        trigger = trigger.strip()
+        if trigger.startswith("step"):
+            trigger = trigger[len("step"):]
+        try:
+            at = int(trigger)
+        except ValueError:
+            raise ValueError(f"fault plan entry {entry!r}: trigger must be "
+                             "an integer (optionally 'step'-prefixed)")
+        if ":" in left:
+            site, action = (p.strip() for p in left.split(":", 1))
+        else:
+            site, action = DEFAULT_SITE, left.strip()
+        if action not in ACTIONS:
+            raise ValueError(f"fault plan entry {entry!r}: unknown action "
+                             f"{action!r} (valid: {', '.join(ACTIONS)})")
+        if not site:
+            raise ValueError(f"fault plan entry {entry!r}: empty site")
+        rules.append(FaultRule(site, action, at))
+    return rules
+
+
+def corrupt_largest_file(root: str) -> str:
+    """Bit-flip the middle byte of the largest file under ``root`` (the
+    array payload of a checkpoint step dir) and return its path."""
+    largest, size = None, -1
+    for dirpath, _, files in os.walk(root):
+        for fn in files:
+            p = os.path.join(dirpath, fn)
+            s = os.path.getsize(p)
+            if s > size:
+                largest, size = p, s
+    if largest is None or size == 0:
+        raise FileNotFoundError(f"no file to corrupt under {root}")
+    with open(largest, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    LOG.warning("fault injection: corrupted %s (byte %d flipped)",
+                largest, size // 2)
+    return largest
+
+
+class FaultPlan:
+    """A set of one-shot rules plus per-site hit counters (thread-safe)."""
+
+    def __init__(self, rules: typing.Sequence[FaultRule] = ()):
+        self.rules = list(rules)
+        self._counts: typing.Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_spec(cls, spec: typing.Optional[str]) -> "FaultPlan":
+        return cls(parse_plan(spec))
+
+    def active(self) -> bool:
+        return bool(self.rules)
+
+    def hit(self, site: str, value: typing.Optional[int] = None,
+            path: typing.Optional[str] = None) -> None:
+        """Record one pass through ``site`` and execute any due rule.
+
+        ``value`` pins the trigger to an external counter (the train loop
+        passes the global step so ``sigterm@step25`` survives resume);
+        without it the site's own 1-based hit count is matched.  ``path``
+        gives ``corrupt`` rules their target."""
+        if not self.rules:
+            return
+        with self._lock:
+            if value is None:
+                value = self._counts[site] = self._counts.get(site, 0) + 1
+            due = [r for r in self.rules
+                   if r.site == site and not r.fired and r.at == value]
+            for r in due:
+                r.fired = True
+        for r in due:
+            self._execute(r, path)
+
+    def disarm_until(self, site: str, value: int) -> None:
+        """Mark ``site`` rules with triggers <= ``value`` as already fired.
+
+        The train loop calls this with the RESTORED step on resume: a
+        config/env-driven plan is re-armed by every relaunched child, and a
+        ``sigterm@stepN`` whose grace checkpoint landed exactly at step N
+        would otherwise refire on the first post-resume iteration forever —
+        a supervisor livelock ending in a spurious crash-loop abort."""
+        with self._lock:
+            for r in self.rules:
+                if r.site == site and not r.fired and r.at <= value:
+                    LOG.warning("fault rule %s disarmed: its trigger is "
+                                "already behind the resumed position (%d)",
+                                r, value)
+                    r.fired = True
+
+    def _execute(self, rule: FaultRule, path: typing.Optional[str]) -> None:
+        LOG.warning("fault injection: firing %s", rule)
+        if rule.action == "fail":
+            raise FaultInjectedIOError(f"injected storage failure ({rule})")
+        if rule.action == "die":
+            raise FaultInjectedCrash(f"injected crash ({rule})")
+        if rule.action in ("sigterm", "sigint"):
+            sig = (signal_mod.SIGTERM if rule.action == "sigterm"
+                   else signal_mod.SIGINT)
+            os.kill(os.getpid(), sig)
+            return
+        if rule.action == "corrupt":
+            if path is None:
+                LOG.error("corrupt rule %s hit a site that provides no "
+                          "path; ignored", rule)
+                return
+            corrupt_largest_file(path)
+
+
+#: process-wide plan; empty (inert) until install() arms one
+_PLAN = FaultPlan()
+
+
+def install(spec_or_plan: typing.Union[str, FaultPlan, None] = None
+            ) -> FaultPlan:
+    """Arm (or clear) the process-wide plan.  ``None`` reads the
+    ``HBNLP_FAULT_PLAN`` env var; an empty spec clears any previous plan —
+    train() installs on every run so plans never leak across runs."""
+    global _PLAN
+    if spec_or_plan is None:
+        spec_or_plan = os.environ.get("HBNLP_FAULT_PLAN", "")
+    _PLAN = (spec_or_plan if isinstance(spec_or_plan, FaultPlan)
+             else FaultPlan.from_spec(spec_or_plan))
+    if _PLAN.active():
+        LOG.warning("fault plan armed: %s",
+                    "; ".join(str(r) for r in _PLAN.rules))
+    return _PLAN
+
+
+def reset() -> None:
+    install("")
+
+
+def active() -> bool:
+    return _PLAN.active()
+
+
+def hit(site: str, value: typing.Optional[int] = None,
+        path: typing.Optional[str] = None) -> None:
+    """Module-level convenience over the installed plan (no-op when inert)."""
+    _PLAN.hit(site, value=value, path=path)
+
+
+def disarm_until(site: str, value: int) -> None:
+    _PLAN.disarm_until(site, value)

@@ -1,0 +1,110 @@
+"""Retry/backoff for every I/O path that can flake.
+
+The reference rides out GCS hiccups with ad-hoc loops (its TFRecord uploader
+retries five times with exponential sleep, scripts/text2tfrecord.py:61-89);
+here the policy is one object and one wrapper so every flaky call-site —
+checkpoint save/restore, data-state sidecars, dataset opens, metric flushes —
+shares the same semantics and the same observability:
+
+- exponential backoff with multiplicative jitter (thundering-herd hygiene on
+  a pod where every host restarts at once), capped per-try and by an optional
+  wall-clock ``deadline_s`` across attempts;
+- an explicit ``retryable`` exception tuple — a structure error or a typo
+  must fail fast, only transport-shaped errors (OSError/TimeoutError) earn a
+  retry by default;
+- per-call-site counters in the obs registry (``hbnlp_io_retries_total`` /
+  ``hbnlp_io_giveups_total``, labelled by ``site``) so /metrics shows which
+  dependency is degrading long before it kills a run.
+
+Fault-injection note: :mod:`~homebrewnlp_tpu.reliability.faults` raises
+``FaultInjectedIOError`` (an ``OSError``) at instrumented sites, so injected
+storage failures exercise exactly this retry path end-to-end.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+import random
+import time
+import typing
+
+from ..obs.registry import REGISTRY, MetricsRegistry
+
+LOG = logging.getLogger("homebrewnlp_tpu.reliability")
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How a call-site retries.  ``max_attempts`` counts the first try."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.1
+    max_delay_s: float = 30.0
+    jitter: float = 0.25          # +/- fraction applied to each delay
+    deadline_s: typing.Optional[float] = None  # wall budget across attempts
+    retryable: typing.Tuple[type, ...] = (OSError, TimeoutError)
+
+    def delay(self, attempt: int,
+              rng: typing.Callable[[], float] = random.random) -> float:
+        """Backoff before retry number ``attempt`` (0-based)."""
+        d = min(self.base_delay_s * (2.0 ** attempt), self.max_delay_s)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * rng() - 1.0)
+        return max(0.0, d)
+
+
+DEFAULT_POLICY = RetryPolicy()
+#: metric flushes: tiny budget — a wedged disk must not stall the step loop
+FLUSH_POLICY = RetryPolicy(max_attempts=3, base_delay_s=0.05, max_delay_s=0.5)
+
+
+def retry_call(fn: typing.Callable[[], typing.Any], *, site: str,
+               policy: RetryPolicy = DEFAULT_POLICY,
+               registry: typing.Optional[MetricsRegistry] = None,
+               sleep: typing.Callable[[float], None] = time.sleep
+               ) -> typing.Any:
+    """Call ``fn`` under ``policy``; re-raise the last error on give-up.
+
+    ``site`` labels the retry/give-up counters and the log lines — name the
+    dependency, not the function (``ckpt_write``, ``data_open``)."""
+    reg = REGISTRY if registry is None else registry
+    retries = reg.counter("hbnlp_io_retries_total",
+                          "I/O retries by call-site", labelnames=("site",))
+    giveups = reg.counter("hbnlp_io_giveups_total",
+                          "I/O retry budgets exhausted by call-site",
+                          labelnames=("site",))
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except policy.retryable as e:
+            attempt += 1
+            spent = time.monotonic() - start
+            exhausted = attempt >= policy.max_attempts or (
+                policy.deadline_s is not None and spent >= policy.deadline_s)
+            if exhausted:
+                giveups.labels(site=site).inc()
+                LOG.error("%s failed %d time(s) in %.1fs; giving up: %r",
+                          site, attempt, spent, e)
+                raise
+            d = policy.delay(attempt - 1)
+            if policy.deadline_s is not None:
+                d = min(d, max(0.0, policy.deadline_s - spent))
+            retries.labels(site=site).inc()
+            LOG.warning("%s failed (attempt %d/%d): %r; retrying in %.2fs",
+                        site, attempt, policy.max_attempts, e, d)
+            sleep(d)
+
+
+def retrying(site: str, policy: RetryPolicy = DEFAULT_POLICY,
+             registry: typing.Optional[MetricsRegistry] = None):
+    """Decorator form of :func:`retry_call`."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(lambda: fn(*args, **kwargs), site=site,
+                              policy=policy, registry=registry)
+        return wrapper
+    return deco

@@ -1,4 +1,4 @@
-"""Sharded checkpointing + deterministic resume metadata.
+"""Sharded checkpointing + deterministic resume metadata, preemption-safe.
 
 The reference uses TF1 ``Saver(sharded=True)`` + hooks copying mesh-sharded
 slices (/root/reference/src/run/run.py:158-176) and recovers ``current_step``
@@ -8,48 +8,249 @@ checkpoints for {params, opt_state, step}, and the data-pipeline state rides
 along as JSON next to the checkpoint — same separation of concerns, without
 the replay arithmetic fragility (the reader checkpoints its cursor
 directly; see data/resume.py which also keeps the replay option).
+
+Fault tolerance (docs/reliability.md): every save writes an **integrity
+manifest** (``manifest_<step>.json``) — tree-structure hash, per-leaf crc32
+checksums, config hash, wall time — atomically (tmp + rename) and only AFTER
+``wait_until_finished``, so the manifest is the commit marker: a checkpoint
+without one is torn.  ``restore`` walks checkpoints newest-first, verifies
+the manifest (structure + checksums + data-state sidecar crc + sidecar step
+field) and transparently falls back to the newest *verified* checkpoint when
+the latest is torn or corrupt.  All storage calls go through the retry layer
+(``cfg.ckpt_retries``) and the fault-injection sites ``ckpt_write`` /
+``ckpt_commit``.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
+import time
 import typing
+import zlib
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
 
+from ..obs.registry import REGISTRY
+from ..reliability import RetryPolicy, faults, retry_call
 from .state import TrainState
+
+LOG = logging.getLogger(__name__)
+
+MANIFEST_VERSION = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed integrity verification (torn write, bit flip,
+    stale/corrupt data-state sidecar).  Restore treats it as 'try the next
+    older checkpoint'."""
+
+
+def _leaf_entries(tree, with_checksums: bool = True
+                  ) -> typing.Dict[str, dict]:
+    """Flatten the {params, opt_state, step} tree into ``{keypath: {shape,
+    dtype[, crc32]}}``.  Checksums hash the leaf bytes exactly as saved
+    (post ``master_dtype`` cast), so a restore can re-cast and compare."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out: typing.Dict[str, dict] = {}
+    for path, leaf in flat:
+        entry: typing.Dict[str, typing.Any] = {
+            "shape": list(getattr(leaf, "shape", ())),
+            "dtype": str(getattr(leaf, "dtype", type(leaf).__name__))}
+        if with_checksums:
+            # np.asarray is the host pull: only safe when every shard is
+            # addressable from this process (the with_checksums guard)
+            arr = np.asarray(leaf)
+            entry["crc32"] = (zlib.crc32(np.ascontiguousarray(arr).tobytes())
+                              & 0xFFFFFFFF)
+        out[jax.tree_util.keystr(path)] = entry
+    return out
+
+
+def _structure_hash(leaves: typing.Dict[str, dict]) -> str:
+    doc = json.dumps([[k, leaves[k]["shape"], leaves[k]["dtype"]]
+                      for k in sorted(leaves)])
+    return hashlib.sha256(doc.encode()).hexdigest()[:16]
+
+
+def _leaf_crc(arr: np.ndarray, dtype: str) -> int:
+    """crc32 of ``arr`` in the manifest's dtype.  Restore targets may widen
+    the storage dtype (bf16 master -> f32 template); casting back is exact
+    for widenings, so save-time and restore-time hashes agree."""
+    if str(arr.dtype) != dtype:
+        # jnp handles ml_dtypes names (bfloat16) that plain numpy lacks
+        arr = np.asarray(jnp.asarray(arr).astype(dtype))
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _write_atomic(path: str, payload: str) -> None:
+    """tmp + rename in the same directory: readers never see a torn file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:  # graftcheck: disable=bare-io
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 class Checkpointer:
-    def __init__(self, path: str, max_to_keep: int = 1):
+    def __init__(self, path: str, max_to_keep: int = 1, retries: int = 2):
         self.path = os.path.abspath(os.path.expanduser(path))
         os.makedirs(self.path, exist_ok=True)
-        self.manager = ocp.CheckpointManager(
-            self.path,
-            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
-                                                 create=True))
+        self._policy = RetryPolicy(max_attempts=int(retries) + 1,
+                                   base_delay_s=0.2, max_delay_s=5.0)
+        self.manager = retry_call(
+            lambda: ocp.CheckpointManager(  # graftcheck: disable=bare-io
+                self.path,
+                options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                     create=True)),
+            site="ckpt_open", policy=self._policy)
+        self._fallbacks = REGISTRY.counter(
+            "hbnlp_ckpt_fallbacks_total",
+            "corrupt/torn checkpoints skipped during restore")
 
     # -- save ----------------------------------------------------------------
     def save(self, state: TrainState,
              data_state: typing.Optional[dict] = None,
-             master_dtype=None) -> None:
+             master_dtype=None,
+             config_hash: typing.Optional[str] = None) -> None:
         """``master_dtype`` (cfg.storage_dtype): dtype of the checkpointed
         master copy of the params — MTF's master/slice split (reference
         dataclass.py:253-255, VariableDType.master_dtype).  Optimizer slots
-        keep their own optimizer_slice_dtype."""
+        keep their own optimizer_slice_dtype.
+
+        Commit order is the crash-safety contract: (1) orbax write + barrier
+        (retried), (2) data-state sidecar (atomic), (3) manifest (atomic) —
+        so a sidecar can never point at an uncommitted checkpoint, and a
+        missing manifest marks the whole step torn.  The sidecar is stamped
+        with its ``step`` (validated on load; ``"step"`` is therefore a
+        reserved key in ``data_state``)."""
         step = int(state.step)
         params = state.params
         if master_dtype is not None:
             params = {k: v.astype(master_dtype) for k, v in params.items()}
         tree = {"params": params, "opt_state": state.opt_state,
                 "step": state.step}
-        self.manager.save(step, args=ocp.args.StandardSave(tree))
+        # per-leaf checksums need the full array on THIS host; multi-process
+        # shardings keep a structure-only manifest (still a commit marker)
+        with_checksums = jax.process_count() == 1
+        leaves = _leaf_entries(tree, with_checksums=with_checksums)
+        manifest: typing.Dict[str, typing.Any] = {
+            "version": MANIFEST_VERSION, "step": step,
+            "wall_time": time.time(), "config_hash": config_hash,
+            "process_count": jax.process_count(),
+            "structure": _structure_hash(leaves), "leaves": leaves}
+
+        def _commit() -> None:
+            faults.hit("ckpt_write")
+            try:
+                # no force: a re-save of an already-committed step (the loop
+                # tail after an on-cadence save) is silently skipped by
+                # orbax's should_save, exactly as before this layer existed
+                self.manager.save(  # graftcheck: disable=bare-io
+                    step, args=ocp.args.StandardSave(tree))
+                # the barrier: nothing below may run until the checkpoint is
+                # durable (satellite: sidecar-after-wait)
+                self.manager.wait_until_finished()  # graftcheck: disable=bare-io
+            except Exception:
+                self._scrub_partial(step)
+                raise
+
+        retry_call(_commit, site="ckpt_write", policy=self._policy)
         if data_state is not None:
-            with open(self._data_state_path(step), "w") as f:
-                json.dump(data_state, f)
+            payload = json.dumps({"step": step, **data_state})
+            retry_call(
+                lambda: _write_atomic(self._data_state_path(step), payload),
+                site="ckpt_sidecar", policy=self._policy)
+            if with_checksums:
+                manifest["data_state_crc"] = (zlib.crc32(payload.encode())
+                                              & 0xFFFFFFFF)
+        if jax.process_index() == 0:
+            retry_call(
+                lambda: _write_atomic(self._manifest_path(step),
+                                      json.dumps(manifest)),
+                site="ckpt_manifest", policy=self._policy)
+        self._prune_stale_sidecars()
+        faults.hit("ckpt_commit", path=self._step_dir(step))
+
+    def _scrub_rejected(self, steps: typing.Sequence[int]) -> None:
+        """Remove corrupt/torn checkpoint steps after a successful fallback
+        restore.  The corrupt data is useless for continuation and its
+        presence blocks progress (see restore); orbax's own delete keeps
+        the manager's step list consistent.  Best-effort."""
+        for s in steps:
+            LOG.warning("scrubbing rejected checkpoint step %d", s)
+            try:
+                self.manager.delete(s)
+            except Exception:
+                self._scrub_partial(s)
+        self._prune_stale_sidecars()
+
+    def _prune_stale_sidecars(self) -> None:
+        """Drop manifests/cursor sidecars whose step dir orbax pruned
+        (max_to_keep) plus orphaned ``*.tmp.<pid>`` files from atomic
+        writes interrupted between write and rename: restore ignores both,
+        but a tidy dir keeps the supervisor's progress probe honest.
+        Best-effort."""
+        keep = set(self.manager.all_steps())
+        for fn in os.listdir(self.path):
+            if ".json.tmp." in fn:
+                try:
+                    os.remove(os.path.join(self.path, fn))
+                except OSError:
+                    pass
+                continue
+            for prefix in ("manifest_", "data_state_"):
+                if not (fn.startswith(prefix) and fn.endswith(".json")):
+                    continue
+                stem = fn[len(prefix):-len(".json")].split("_p")[0]
+                try:
+                    s = int(stem)
+                except ValueError:
+                    continue
+                if s not in keep:
+                    try:
+                        os.remove(os.path.join(self.path, fn))
+                    except OSError:
+                        pass
+
+    def _scrub_partial(self, step: int) -> None:
+        """Best-effort removal of a torn step dir so the retry's re-save
+        does not trip over the leftovers."""
+        import shutil
+        d = self._step_dir(step)
+        if os.path.isdir(d):
+            LOG.warning("scrubbing partial checkpoint dir %s before retry", d)
+            shutil.rmtree(d, ignore_errors=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.path, str(step))
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.path, f"manifest_{step}.json")
+
+    def _read_manifest(self, step: int) -> typing.Optional[dict]:
+        """The step's manifest, or None when missing/unreadable (both mean
+        'not verified')."""
+        path = self._manifest_path(step)
+        try:
+            with open(path) as f:  # graftcheck: disable=bare-io
+                m = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            LOG.error("manifest %s unreadable (%r) — treating step %d as "
+                      "unverified", path, e, step)
+            return None
+        if not isinstance(m, dict) or m.get("step") != step:
+            LOG.error("manifest %s malformed or step-mismatched — treating "
+                      "step %d as unverified", path, step)
+            return None
+        return m
 
     def _data_state_path(self, step: int) -> str:
         """Data-pipeline cursor sidecar.  Multi-process runs keep ONE cursor
@@ -61,7 +262,9 @@ class Checkpointer:
                   if jax.process_count() > 1 else "")
         return os.path.join(self.path, f"data_state_{step}{suffix}.json")
 
-    def _load_data_state(self, step: int) -> typing.Optional[dict]:
+    def _load_data_state(self, step: int,
+                         expected_crc: typing.Optional[int] = None
+                         ) -> typing.Optional[dict]:
         # fall back to the other naming so cursors survive a process-count
         # change (or a checkpoint written before per-process sidecars):
         # multi-process probes its own _p{r} file then the legacy plain
@@ -70,19 +273,52 @@ class Checkpointer:
         rank0 = os.path.join(self.path, f"data_state_{step}_p0.json")
         own = self._data_state_path(step)
         for path in (own, legacy, rank0):
-            if os.path.exists(path):
-                if path != own:
-                    # loud like the params-migration NOTE: after a
-                    # process-count change this rank resumes from another
-                    # rank's (or the legacy single-process) stream position,
-                    # so rows may repeat or skip relative to its own history
-                    logging.getLogger(__name__).warning(
-                        "rank %d data cursor %s missing; falling back to %s "
-                        "— this rank's data-stream position comes from a "
-                        "different process layout", jax.process_index(),
-                        os.path.basename(own), os.path.basename(path))
-                with open(path) as f:
-                    return json.load(f)
+            if not os.path.exists(path):
+                continue
+            if path != own:
+                # loud like the params-migration NOTE: after a
+                # process-count change this rank resumes from another
+                # rank's (or the legacy single-process) stream position,
+                # so rows may repeat or skip relative to its own history
+                logging.getLogger(__name__).warning(
+                    "rank %d data cursor %s missing; falling back to %s "
+                    "— this rank's data-stream position comes from a "
+                    "different process layout", jax.process_index(),
+                    os.path.basename(own), os.path.basename(path))
+            def _read(p=path) -> str:
+                with open(p) as f:  # graftcheck: disable=bare-io
+                    return f.read()
+
+            raw = retry_call(_read, site="ckpt_sidecar", policy=self._policy)
+            if expected_crc is not None and path == own:
+                got = zlib.crc32(raw.encode()) & 0xFFFFFFFF
+                if got != expected_crc:
+                    raise CheckpointCorrupt(
+                        f"data-state sidecar {os.path.basename(path)} fails "
+                        f"its manifest checksum (crc {got:#010x} != "
+                        f"{expected_crc:#010x}) — torn or corrupt cursor")
+            try:
+                ds = json.loads(raw)
+            except ValueError as e:
+                raise CheckpointCorrupt(
+                    f"data-state sidecar {os.path.basename(path)} is not "
+                    f"valid JSON ({e}) — torn or corrupt cursor") from e
+            # refuse a stale cursor LOUDLY: a sidecar recorded for a
+            # different step would silently repeat/skip training data
+            if "step" in ds and int(ds["step"]) != step:
+                raise CheckpointCorrupt(
+                    f"data-state sidecar {os.path.basename(path)} records "
+                    f"step {ds['step']} but the checkpoint is step {step} — "
+                    "refusing to resume from a stale data cursor")
+            if "step" not in ds:
+                logging.getLogger(__name__).warning(
+                    "data cursor %s predates step-stamped sidecars; "
+                    "accepting without step validation",
+                    os.path.basename(path))
+            # the stamp is transport metadata: callers get back exactly the
+            # dict they passed to save()
+            ds.pop("step", None)
+            return ds
         logging.getLogger(__name__).warning(
             "no data cursor found for step %d (rank %d) — the input "
             "pipeline restarts from its initial position", step,
@@ -90,46 +326,160 @@ class Checkpointer:
         return None
 
     def wait(self) -> None:
-        self.manager.wait_until_finished()
+        # save() already waits inside its commit (the manifest depends on
+        # it); this remains for callers pacing external work off the barrier
+        retry_call(
+            lambda: self.manager.wait_until_finished(),  # graftcheck: disable=bare-io
+            site="ckpt_write", policy=self._policy)
 
     # -- restore -------------------------------------------------------------
     def latest_step(self) -> typing.Optional[int]:
         return self.manager.latest_step()
 
+    def all_steps(self) -> typing.List[int]:
+        return sorted(self.manager.all_steps())
+
     def restore(self, template: TrainState, cfg=None
                 ) -> typing.Tuple[TrainState, typing.Optional[dict]]:
-        """Restore the latest checkpoint onto the template's shardings.
+        """Restore the newest VERIFIED checkpoint onto the template's
+        shardings, walking older checkpoints when the latest is torn (no
+        manifest while siblings have one) or corrupt (structure/checksum/
+        sidecar verification fails).  Checkpoints predating manifests (none
+        present at all) restore unverified, exactly as before.
 
         With ``cfg`` given and ``pipeline_parallel > 1``, checkpoints written
         before stage-stacked pipeline residency (flat per-depth layout) are
         detected by key-set mismatch and migrated in place of a structure
         error (a one-time host-memory round trip)."""
-        step = self.latest_step()
-        if step is None:
+        steps = sorted(self.manager.all_steps(), reverse=True)
+        if not steps:
             return template, None
+        any_manifest = any(os.path.exists(self._manifest_path(s))
+                           for s in steps)
+        rejected: typing.List[str] = []
+        rejected_steps: typing.List[int] = []
+        for step in steps:
+            manifest = self._read_manifest(step) if any_manifest else None
+            if any_manifest and manifest is None:
+                LOG.error(
+                    "checkpoint step %d has no valid integrity manifest — "
+                    "torn write; falling back to previous verified "
+                    "checkpoint", step)
+                rejected.append(f"{step}: missing/invalid manifest")
+                rejected_steps.append(step)
+                self._fallbacks.inc()
+                continue
+            try:
+                state, data_state = self._restore_step(step, template, cfg,
+                                                       manifest)
+            except CheckpointCorrupt as e:
+                LOG.error(
+                    "checkpoint step %d failed verification (%s) — falling "
+                    "back to previous verified checkpoint", step, e)
+                rejected.append(f"{step}: {e}")
+                rejected_steps.append(step)
+                self._fallbacks.inc()
+                continue
+            except OSError:
+                # a transient storage outage that exhausted the retry budget
+                # is infrastructure, NOT corruption: falling back would
+                # silently discard committed progress.  Surface it.
+                raise
+            except Exception as e:
+                # orbax-level failure (truncated/compressed-garbage leaf
+                # file, missing metadata): same fallback, different layer
+                LOG.error(
+                    "checkpoint step %d failed restore (%r) — falling back "
+                    "to previous verified checkpoint", step, e)
+                rejected.append(f"{step}: {type(e).__name__}: {e}")
+                rejected_steps.append(step)
+                self._fallbacks.inc()
+                continue
+            if rejected:
+                LOG.warning("restored fallback checkpoint at step %d "
+                            "(rejected newer: %s)", step, "; ".join(rejected))
+                # scrub the rejected (newer) steps NOW: orbax's should_save
+                # skips any save whose step <= the latest on-disk step, so a
+                # corrupt step-100 dir left in place would silently swallow
+                # every checkpoint until training re-passed step 100
+                self._scrub_rejected(rejected_steps)
+            return state, data_state
+        raise RuntimeError(
+            f"no restorable checkpoint under {self.path} — every candidate "
+            f"failed verification ({'; '.join(rejected)}).  Refusing to "
+            "fresh-start over an existing checkpoint dir (max_to_keep could "
+            "overwrite the evidence); repair or move it aside")
+
+    def _restore_step(self, step: int, template: TrainState, cfg,
+                      manifest: typing.Optional[dict]
+                      ) -> typing.Tuple[TrainState, typing.Optional[dict]]:
         tree = {"params": template.params, "opt_state": template.opt_state,
                 "step": template.step}
         abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding),
             tree)
+        migrated = False
         try:
-            restored = self.manager.restore(
-                step, args=ocp.args.StandardRestore(abstract))
+            restored = retry_call(
+                lambda: self.manager.restore(  # graftcheck: disable=bare-io
+                    step, args=ocp.args.StandardRestore(abstract)),
+                site="ckpt_read", policy=self._policy)
+            state = TrainState(restored["params"], restored["opt_state"],
+                               restored["step"])
         except ValueError as e:
             # structure mismatch: possibly a pre-stage-stacked pipeline
             # checkpoint (flat per-depth layout) — migrate if so; any other
             # ValueError is re-raised unchanged from the migration probe
             if cfg is None or getattr(cfg, "pipeline_parallel", 1) <= 1:
                 raise
-            return self._restore_flat_pipeline(step, template, cfg, e)
-        return (TrainState(restored["params"], restored["opt_state"],
-                           restored["step"]),
-                self._load_data_state(step))
+            state = self._restore_flat_pipeline(step, template, cfg, e)
+            migrated = True
+        if manifest is not None and not migrated:
+            self._verify(step, state, manifest)
+        elif manifest is not None:
+            LOG.warning("checkpoint step %d migrated from the flat pipeline "
+                        "layout; leaf checksums not comparable — skipping "
+                        "verification", step)
+        crc = manifest.get("data_state_crc") if manifest else None
+        return state, self._load_data_state(step, expected_crc=crc)
+
+    def _verify(self, step: int, state: TrainState, manifest: dict) -> None:
+        """Structure + per-leaf checksum verification against the manifest.
+        Checksums exist only for single-process saves; a structure-only
+        manifest still catches torn/mis-keyed checkpoints."""
+        tree = {"params": state.params, "opt_state": state.opt_state,
+                "step": state.step}
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        got_keys = {jax.tree_util.keystr(p) for p, _ in flat}
+        want = manifest.get("leaves", {})
+        if set(want) != got_keys:
+            missing = sorted(set(want) - got_keys)[:3]
+            extra = sorted(got_keys - set(want))[:3]
+            raise CheckpointCorrupt(
+                f"step {step} tree structure differs from its manifest "
+                f"(missing {missing}, unexpected {extra})")
+        if jax.process_count() != 1:
+            return
+        for path, leaf in flat:
+            key = jax.tree_util.keystr(path)
+            entry = want[key]
+            if "crc32" not in entry:
+                continue
+            arr = np.asarray(leaf)
+            if list(arr.shape) != entry["shape"]:
+                raise CheckpointCorrupt(
+                    f"step {step} leaf {key} shape {list(arr.shape)} != "
+                    f"manifest {entry['shape']}")
+            got = _leaf_crc(arr, entry["dtype"])
+            if got != entry["crc32"]:
+                raise CheckpointCorrupt(
+                    f"step {step} leaf {key} fails its checksum "
+                    f"({got:#010x} != {entry['crc32']:#010x}) — bit corruption "
+                    "or a torn leaf write")
 
     def _restore_flat_pipeline(self, step: int, template: TrainState, cfg,
-                               original: Exception
-                               ) -> typing.Tuple[TrainState,
-                                                 typing.Optional[dict]]:
+                               original: Exception) -> TrainState:
         """One-time migration: restore a flat per-depth pipeline checkpoint
         as saved (host numpy — a one-off host-memory round trip), stack
         params AND optimizer slots into the stage-stacked layout, and place
@@ -137,7 +487,10 @@ class Checkpointer:
         already be stage-stacked, ``original`` (the structure error from the
         normal restore) is the real problem and is re-raised unchanged."""
         from ..models import pipeline_params_stacked, stack_pipeline_params
-        raw = self.manager.restore(step, args=ocp.args.StandardRestore(None))
+        raw = retry_call(
+            lambda: self.manager.restore(  # graftcheck: disable=bare-io
+                step, args=ocp.args.StandardRestore(None)),
+            site="ckpt_read", policy=self._policy)
         if pipeline_params_stacked(cfg, raw["params"]):
             raise original
         print(f"NOTE: checkpoint at step {step} predates stage-stacked "
@@ -151,9 +504,8 @@ class Checkpointer:
         params = jax.tree_util.tree_map(put, dict(template.params), params)
         opt_state = jax.tree_util.tree_map(put, dict(template.opt_state),
                                            opt_state)
-        state = TrainState(params, opt_state,
-                           put(template.step, raw["step"]))
-        return state, self._load_data_state(step)
+        return TrainState(params, opt_state,
+                          put(template.step, raw["step"]))
 
 
 def current_step(model_path: str) -> int:
@@ -163,7 +515,7 @@ def current_step(model_path: str) -> int:
     if not os.path.isdir(path):
         return 0
     try:
-        step = ocp.CheckpointManager(path).latest_step()
+        step = ocp.CheckpointManager(path).latest_step()  # graftcheck: disable=bare-io
         return 0 if step is None else int(step)
     except Exception as e:  # pragma: no cover - corrupt metadata etc.
         # surface the problem rather than silently restarting: with

@@ -21,6 +21,7 @@ import typing
 import numpy as np
 
 from ..obs import spans
+from ..reliability import FLUSH_POLICY, retry_call
 
 # log2-|grad| histogram bucket edges shared between the train step (which
 # bins on-device, train/state.py) and the TensorBoard rendering below
@@ -41,7 +42,7 @@ def read_metric_rows(path: str) -> typing.List[dict]:
     the test helpers do) so no consumer crashes on a marker row."""
     if os.path.isdir(path):
         path = os.path.join(path, "metrics.jsonl")
-    with open(path) as f:
+    with open(path) as f:  # graftcheck: disable=bare-io
         return [r for r in (json.loads(line) for line in f) if "loss" in r]
 
 
@@ -58,7 +59,9 @@ class MetricWriter:
     def __init__(self, model_path: str, flush_every: int = 1):
         self.path = model_path
         os.makedirs(model_path, exist_ok=True)
-        self._f = open(os.path.join(model_path, "metrics.jsonl"), "a")
+        self._f = retry_call(
+            lambda: open(os.path.join(model_path, "metrics.jsonl"), "a"),  # graftcheck: disable=bare-io
+            site="metrics_open")
         self.flush_every = flush_every
         self._n = 0
         self._t0 = time.time()
@@ -79,7 +82,7 @@ class MetricWriter:
         self._f.write(json.dumps({
             "run_start": True, "resume_step": int(resume_step),
             "config_hash": cfg_hash, "wall_time": time.time()}) + "\n")
-        self._f.flush()
+        self.flush()
 
     def write(self, step: int, metrics: typing.Dict[str, typing.Any],
               wall_time: typing.Optional[float] = None) -> None:
@@ -108,7 +111,7 @@ class MetricWriter:
         self._f.write(json.dumps(scalars) + "\n")
         self._n += 1
         if self._n % self.flush_every == 0:
-            self._f.flush()
+            self.flush()
         if self._tb is not None:
             for k, v in scalars.items():
                 if k not in ("step", "wall_time"):
@@ -129,7 +132,9 @@ class MetricWriter:
                     bucket_counts=counts.tolist(), global_step=step)
 
     def flush(self) -> None:
-        self._f.flush()
+        # bounded retry (FLUSH_POLICY): a transient EIO/ENOSPC blip must not
+        # kill the run, but a wedged disk must not stall the step loop either
+        retry_call(self._f.flush, site="metrics_flush", policy=FLUSH_POLICY)
 
     def close(self) -> None:
         self._f.close()

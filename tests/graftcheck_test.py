@@ -492,3 +492,68 @@ def test_cli_all_configs_clean():
          "--all-configs"], capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "no findings" in proc.stdout
+
+
+# -- bare-io ratchet (ISSUE 4) ------------------------------------------------
+
+def test_ast_bare_io_seeded_regression_caught(tmp_path, monkeypatch):
+    """ISSUE satellite: unwrapped open()/orbax calls in the train/data hot
+    paths fail the bare-io ratchet (golden committed at zero)."""
+    root = _mini_tree(tmp_path)
+    (tmp_path / "homebrewnlp_tpu/train/ckpt.py").write_text(
+        "import orbax.checkpoint as ocp\n"
+        "from orbax.checkpoint import CheckpointManager as CM\n"
+        "def save(self, step, tree):\n"
+        "    mgr = ocp.CheckpointManager('/ckpt')\n"     # bare construction
+        "    mgr2 = CM('/ckpt2')\n"                      # aliased ctor
+        "    self.manager.save(step, tree)\n"            # bare save
+        "    self.manager.wait_until_finished()\n"       # bare barrier
+        "    with open('sidecar.json', 'w') as f:\n"     # bare open
+        "        f.write('{}')\n")
+    (tmp_path / "homebrewnlp_tpu/data/reader.py").write_text(
+        "def read(path):\n"
+        "    return open(path, 'rb').read()\n")          # bare open
+    golden = tmp_path / "goldens" / "ast_bare_io.json"
+    golden.parent.mkdir(parents=True, exist_ok=True)
+    golden.write_text("{}")
+    monkeypatch.setattr(ast_rules, "bare_io_golden_path",
+                        lambda: str(golden))
+    counts = ast_rules.bare_io_counts(root)
+    assert counts == {"homebrewnlp_tpu/train/ckpt.py": 5,
+                      "homebrewnlp_tpu/data/reader.py": 1}, counts
+    findings = ast_rules.check_bare_io(root)
+    assert len(findings) == 2
+    assert all(f.severity == "error" for f in findings)
+    assert "reliability.retry" in findings[0].message
+
+
+def test_ast_bare_io_suppression_and_exemptions(tmp_path, monkeypatch):
+    """Retry-wrapped sites carry the disable comment; fs.py/synthetic.py
+    (the I/O layer and fixture generation) are exempt; unrelated .save()
+    calls (no manager in the chain) and non-orbax constructors are clean."""
+    root = _mini_tree(tmp_path)
+    (tmp_path / "homebrewnlp_tpu/train/ckpt.py").write_text(
+        "def save(self, step, tree):\n"
+        "    self.manager.save(step, tree)  # graftcheck: disable=bare-io\n"
+        "    self.writer.save(step)\n"            # not a manager chain
+        "    CheckpointManager('/x')\n")          # not an orbax alias
+    (tmp_path / "homebrewnlp_tpu/data/fs.py").write_text(
+        "def open_stream(path, mode='rb'):\n"
+        "    return open(path, mode)\n")
+    (tmp_path / "homebrewnlp_tpu/data/synthetic.py").write_text(
+        "def write(path):\n"
+        "    open(path, 'w').write('x')\n")
+    golden = tmp_path / "goldens" / "ast_bare_io.json"
+    golden.parent.mkdir(parents=True, exist_ok=True)
+    golden.write_text("{}")
+    monkeypatch.setattr(ast_rules, "bare_io_golden_path",
+                        lambda: str(golden))
+    assert ast_rules.bare_io_counts(root) == {}
+    assert ast_rules.check_bare_io(root) == []
+
+
+def test_ast_bare_io_repo_is_clean():
+    """The committed golden is ZERO and the tree satisfies it: every hot-
+    path I/O call routes through reliability.retry or data/fs.py."""
+    assert ast_rules.bare_io_counts(REPO) == {}
+    assert json.load(open(ast_rules.bare_io_golden_path())) == {}

@@ -1,0 +1,609 @@
+"""Fault-tolerance suite (ISSUE 4): retry/backoff policy, fault-plan grammar
+and injection, preemption-safe checkpoint manifests with fallback-to-verified
+restore, corrupt-record budgets, SIGTERM grace shutdown with bit-identical
+resume, and the auto-resume supervisor — the CI ``chaos`` job runs this file
+end to end on CPU."""
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from homebrewnlp_tpu import main as cli
+from homebrewnlp_tpu.data.synthetic import write_text_tfrecords
+from homebrewnlp_tpu.obs.registry import REGISTRY, MetricsRegistry
+from homebrewnlp_tpu.reliability import (EXIT_CRASH_LOOP, EXIT_PREEMPTED,
+                                         CorruptRecordBudget,
+                                         GraceController, RetryPolicy,
+                                         faults, retry_call, retrying)
+from homebrewnlp_tpu.reliability.faults import (FaultInjectedCrash,
+                                                FaultInjectedIOError,
+                                                FaultPlan, parse_plan)
+
+from .backend import tiny_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import supervise  # noqa: E402  (tools/supervise.py)
+
+
+def _args(steps):
+    return argparse.Namespace(steps=steps, profile="", workers=None)
+
+
+def _rows(model_path):
+    from homebrewnlp_tpu.train.metrics import read_metric_rows
+    return read_metric_rows(model_path)
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- retry policy -------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures():
+    reg = MetricsRegistry()
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.1, jitter=0.0)
+    out = retry_call(flaky, site="t", policy=policy, registry=reg,
+                     sleep=sleeps.append)
+    assert out == "ok" and len(calls) == 3
+    assert sleeps == [0.1, 0.2]  # exponential, no jitter
+    assert reg.counter("hbnlp_io_retries_total",
+                       labelnames=("site",)).value(site="t") == 2
+    assert reg.counter("hbnlp_io_giveups_total",
+                       labelnames=("site",)).value(site="t") == 0
+
+
+def test_retry_gives_up_and_reraises():
+    reg = MetricsRegistry()
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+    with pytest.raises(OSError, match="always"):
+        retry_call(lambda: (_ for _ in ()).throw(OSError("always")),
+                   site="t", policy=policy, registry=reg, sleep=lambda s: None)
+    assert reg.counter("hbnlp_io_giveups_total",
+                       labelnames=("site",)).value(site="t") == 1
+
+
+def test_retry_non_retryable_passes_through():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("a bug, not weather")
+
+    with pytest.raises(ValueError):
+        retry_call(broken, site="t", registry=MetricsRegistry(),
+                   sleep=lambda s: None)
+    assert len(calls) == 1  # no retry on non-transport errors
+
+
+def test_retry_deadline_bounds_attempts():
+    policy = RetryPolicy(max_attempts=100, base_delay_s=0.0, jitter=0.0,
+                         deadline_s=0.05)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        time.sleep(0.03)
+        raise OSError("slow transient")
+
+    with pytest.raises(OSError):
+        retry_call(flaky, site="t", policy=policy,
+                   registry=MetricsRegistry(), sleep=lambda s: None)
+    assert len(calls) < 10  # the wall deadline cut the 100-attempt budget
+
+
+def test_retrying_decorator():
+    calls = []
+
+    @retrying("deco", RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                                  jitter=0.0), registry=MetricsRegistry())
+    def sometimes(x):
+        calls.append(x)
+        if len(calls) == 1:
+            raise TimeoutError("first")
+        return x * 2
+
+    assert sometimes(21) == 42 and calls == [21, 21]
+
+
+# -- fault plan ---------------------------------------------------------------
+
+def test_fault_plan_grammar():
+    rules = parse_plan("ckpt_write:fail@2;feeder:die@step10;sigterm@step25")
+    assert [(r.site, r.action, r.at) for r in rules] == [
+        ("ckpt_write", "fail", 2), ("feeder", "die", 10),
+        ("step", "sigterm", 25)]
+    assert parse_plan("") == [] and parse_plan(None) == []
+    for bad in ("nonsense", "x:y@z", "ckpt_write:explode@1", ":fail@1"):
+        with pytest.raises(ValueError):
+            parse_plan(bad)
+
+
+def test_fault_plan_config_validation():
+    with pytest.raises(ValueError):
+        tiny_config(fault_plan="ckpt_write:explode@1")
+    assert tiny_config(fault_plan="sigterm@step5").fault_plan
+
+
+def test_fault_rules_fire_once_at_trigger():
+    plan = FaultPlan.from_spec("io:fail@2")
+    plan.hit("io")  # 1st: no fire
+    with pytest.raises(FaultInjectedIOError):
+        plan.hit("io")  # 2nd: fires
+    plan.hit("io")  # 3rd: one-shot, spent
+    plan = FaultPlan.from_spec("step:die@7")
+    plan.hit("step", value=6)
+    with pytest.raises(FaultInjectedCrash):
+        plan.hit("step", value=7)  # value-pinned trigger
+
+
+# -- corrupt-record budget ----------------------------------------------------
+
+def test_corrupt_budget_skips_then_raises():
+    b = CorruptRecordBudget(2, registry=MetricsRegistry())
+    b.spend("a.tfrecord", OSError("x"))
+    b.spend("b.tfrecord", OSError("y"))
+    with pytest.raises(OSError, match="budget exhausted"):
+        b.spend("c.tfrecord", OSError("z"))
+
+
+def test_pipeline_survives_injected_read_failure_within_budget(
+        tmp_path, caplog):
+    """data_read:fail under a budget: the bad shard is skipped and logged,
+    the stream keeps producing from the remaining files."""
+    from homebrewnlp_tpu.data.pipeline import GptPipeline
+    write_text_tfrecords(str(tmp_path), n_files=3, records_per_file=1,
+                         tokens_per_record=120, seed=5)
+    cfg = tiny_config(vocab_size=256, interleaved_datasets=1,
+                      corrupt_record_budget=3,
+                      dataset_configs=[{"type": "text",
+                                        "path": str(tmp_path / "*.tfrecord")}])
+    faults.install("data_read:fail@1")  # first shard dies at its first read
+    pipe = GptPipeline(cfg, 2)
+    with caplog.at_level(logging.WARNING, "homebrewnlp_tpu.reliability"):
+        batches = []
+        for batch in pipe:
+            batches.append(batch)
+            if len(batches) >= 3:
+                break
+    assert len(batches) >= 2  # stream survived the injected failure
+    assert any("corrupt-record budget" in r.message for r in caplog.records)
+
+
+def test_pipeline_strict_without_budget(tmp_path):
+    from homebrewnlp_tpu.data.pipeline import GptPipeline
+    write_text_tfrecords(str(tmp_path), n_files=2, records_per_file=1,
+                         tokens_per_record=120, seed=5)
+    cfg = tiny_config(vocab_size=256, interleaved_datasets=1,
+                      corrupt_record_budget=0,
+                      dataset_configs=[{"type": "text",
+                                        "path": str(tmp_path / "*.tfrecord")}])
+    faults.install("data_read:fail@1")
+    with pytest.raises(OSError):
+        list(GptPipeline(cfg, 2))
+
+
+# -- grace controller ---------------------------------------------------------
+
+def test_grace_controller_deadline_forces_exit():
+    exits = []
+    g = GraceController(deadline_s=0.05, exit_fn=exits.append)
+    g.install()
+    try:
+        os.kill(os.getpid(), __import__("signal").SIGTERM)
+        assert g.triggered and g.signame == "SIGTERM"
+        time.sleep(0.2)  # deadline timer fires: a wedged drain forces exit
+        assert exits == [84]
+    finally:
+        g.uninstall()
+
+
+# -- checkpoint manifests + verified restore ---------------------------------
+
+def _ckpt_run(model_path, steps, **over):
+    cfg = tiny_config(model_path=model_path, use_checkpointing=True,
+                      steps_per_checkpoint=2, max_checkpoints_keep=5, **over)
+    cli.train(cfg, _args(steps))
+    return cfg
+
+
+def _restore_step(model_path, **over):
+    """Build a fresh template and restore whatever the Checkpointer deems
+    the newest VERIFIED checkpoint; returns the restored step."""
+    from homebrewnlp_tpu.data.synthetic import synthetic_text_batch
+    from homebrewnlp_tpu.data import to_global
+    from homebrewnlp_tpu.parallel import make_mesh
+    from homebrewnlp_tpu.train import Checkpointer, Trainer
+    cfg = tiny_config(model_path=model_path, use_checkpointing=True, **over)
+    mesh = make_mesh(cfg)
+    trainer = Trainer(cfg, mesh)
+    state = trainer.init(to_global(synthetic_text_batch(cfg, 0), cfg, mesh))
+    ckpt = Checkpointer(os.path.join(model_path, "ckpt"))
+    state, data_state = ckpt.restore(state, cfg)
+    return int(state.step), data_state
+
+
+def test_save_writes_manifest_after_commit(tmp_path, eight_devices):
+    _ckpt_run(str(tmp_path), 4)
+    ck = tmp_path / "ckpt"
+    m = json.loads((ck / "manifest_4.json").read_text())
+    assert m["step"] == 4 and m["structure"] and m["config_hash"]
+    assert all("crc32" in e for e in m["leaves"].values())
+    assert (ck / "4").is_dir()  # manifest never precedes the step dir
+
+
+def test_restore_falls_back_on_corrupt_leaf(tmp_path, eight_devices, caplog):
+    """Seeded regression for the manifest code: bit-flip an orbax leaf of
+    the NEWEST checkpoint; restore must land on the previous verified one
+    with a clear log line, not crash and not trust the corrupt data."""
+    from homebrewnlp_tpu.reliability.faults import corrupt_largest_file
+    _ckpt_run(str(tmp_path), 4)  # checkpoints at steps 2 and 4
+    corrupt_largest_file(str(tmp_path / "ckpt" / "4"))
+    with caplog.at_level(logging.ERROR, "homebrewnlp_tpu.train.checkpoint"):
+        step, _ = _restore_step(str(tmp_path))
+    assert step == 2
+    assert any("falling back" in r.message for r in caplog.records)
+
+
+def test_restore_falls_back_on_missing_manifest(tmp_path, eight_devices,
+                                                caplog):
+    """A step dir without its manifest is a torn write (the manifest is the
+    commit marker): restore skips it."""
+    _ckpt_run(str(tmp_path), 4)
+    os.remove(tmp_path / "ckpt" / "manifest_4.json")
+    with caplog.at_level(logging.ERROR, "homebrewnlp_tpu.train.checkpoint"):
+        step, _ = _restore_step(str(tmp_path))
+    assert step == 2
+    assert any("torn write" in r.message for r in caplog.records)
+
+
+def test_restore_falls_back_on_corrupt_sidecar(tmp_path, eight_devices,
+                                               caplog):
+    """A data-state sidecar failing its manifest crc (torn cursor write)
+    rejects the whole checkpoint — resuming the model without its data
+    cursor would silently replay data."""
+    paths_dir = tmp_path / "data"
+    write_text_tfrecords(str(paths_dir), n_files=2, records_per_file=2,
+                         tokens_per_record=200, seed=7)
+    _ckpt_run(str(tmp_path / "run"), 4, vocab_size=256,
+              interleaved_datasets=2,
+              dataset_configs=[{"type": "text",
+                                "path": str(paths_dir / "*.tfrecord")}])
+    side = tmp_path / "run" / "ckpt" / "data_state_4.json"
+    assert side.exists()
+    side.write_text(side.read_text()[:-7] + "GARBAGE")
+    with caplog.at_level(logging.ERROR, "homebrewnlp_tpu.train.checkpoint"):
+        step, data_state = _restore_step(
+            str(tmp_path / "run"), vocab_size=256, interleaved_datasets=2,
+            dataset_configs=[{"type": "text",
+                              "path": str(paths_dir / "*.tfrecord")}])
+    assert step == 2 and data_state is not None
+    assert any("falling back" in r.message for r in caplog.records)
+
+
+def test_stale_sidecar_step_refused(tmp_path, eight_devices):
+    """Satellite: a sidecar whose recorded step disagrees with the restored
+    checkpoint step must refuse loudly (here: sole checkpoint -> restore
+    raises) instead of silently resuming from a stale cursor."""
+    paths_dir = tmp_path / "data"
+    write_text_tfrecords(str(paths_dir), n_files=2, records_per_file=2,
+                         tokens_per_record=200, seed=7)
+    dsets = [{"type": "text", "path": str(paths_dir / "*.tfrecord")}]
+    cfg = tiny_config(model_path=str(tmp_path / "run"),
+                      use_checkpointing=True, steps_per_checkpoint=4,
+                      vocab_size=256, interleaved_datasets=2,
+                      dataset_configs=dsets)
+    cli.train(cfg, _args(4))  # one checkpoint, at step 4
+    ck = tmp_path / "run" / "ckpt"
+    side = json.loads((ck / "data_state_4.json").read_text())
+    side["step"] = 2  # a stale cursor from some other step
+    (ck / "data_state_4.json").write_text(json.dumps(side))
+    # legacy mode (no manifests): the stale cursor is the only defense
+    for fn in os.listdir(ck):
+        if fn.startswith("manifest_"):
+            os.remove(ck / fn)
+    with pytest.raises(RuntimeError, match="stale data cursor|failed"):
+        _restore_step(str(tmp_path / "run"), vocab_size=256,
+                      interleaved_datasets=2, dataset_configs=dsets)
+
+
+def test_legacy_checkpoint_without_manifest_still_restores(tmp_path,
+                                                           eight_devices):
+    """Pre-manifest checkpoints (no manifest anywhere) keep restoring —
+    verification only gates when manifests exist."""
+    _ckpt_run(str(tmp_path), 4)
+    ck = tmp_path / "ckpt"
+    for fn in os.listdir(ck):
+        if fn.startswith("manifest_"):
+            os.remove(ck / fn)
+    step, _ = _restore_step(str(tmp_path))
+    assert step == 4
+
+
+def test_ckpt_write_failure_retried(tmp_path, eight_devices):
+    """ckpt_write:fail@1 + ckpt_retries: the injected storage failure is
+    retried and training completes with a valid checkpoint."""
+    c = REGISTRY.counter("hbnlp_io_retries_total", labelnames=("site",))
+    before = c.value(site="ckpt_write")
+    _ckpt_run(str(tmp_path), 4, fault_plan="ckpt_write:fail@1",
+              ckpt_retries=2)
+    assert c.value(site="ckpt_write") >= before + 1
+    assert (tmp_path / "ckpt" / "manifest_4.json").exists()
+
+
+def test_fault_corrupts_freshest_checkpoint_then_restore_falls_back(
+        tmp_path, eight_devices):
+    """The corrupt action end to end: ckpt_commit:corrupt@2 tears the step-4
+    checkpoint as it lands; a later restore transparently lands on step 2."""
+    _ckpt_run(str(tmp_path), 4, fault_plan="ckpt_commit:corrupt@2")
+    step, _ = _restore_step(str(tmp_path))
+    assert step == 2
+
+
+# -- SIGTERM grace shutdown + resume ------------------------------------------
+
+def _data_cfg(tmp_path, model, **over):
+    paths_dir = tmp_path / "data"
+    if not paths_dir.exists():
+        write_text_tfrecords(str(paths_dir), n_files=2, records_per_file=2,
+                             tokens_per_record=400, seed=7)
+    return tiny_config(
+        model_path=str(tmp_path / model), use_checkpointing=True,
+        steps_per_checkpoint=3, vocab_size=256, interleaved_datasets=2,
+        dataset_configs=[{"type": "text",
+                          "path": str(paths_dir / "*.tfrecord")}], **over)
+
+
+def test_sigterm_grace_resume_bit_identical(tmp_path, eight_devices):
+    """Acceptance drill core: SIGTERM mid-run -> EXIT_PREEMPTED after a
+    grace checkpoint; the relaunched run's loss sequence is bit-identical
+    to an uninterrupted run of the same length (model AND data cursor)."""
+    cli.train(_data_cfg(tmp_path, "ref"), _args(6))  # uninterrupted
+    with pytest.raises(SystemExit) as e:
+        cli.train(_data_cfg(tmp_path, "pre", fault_plan="sigterm@step4"),
+                  _args(6))
+    assert e.value.code == EXIT_PREEMPTED
+    # the grace checkpoint landed at the interruption point, manifest-valid
+    assert (tmp_path / "pre" / "ckpt" / "manifest_4.json").exists()
+    cli.train(_data_cfg(tmp_path, "pre"), _args(6))  # the relaunch
+    ref = {r["step"]: r["loss"] for r in _rows(str(tmp_path / "ref"))}
+    got = {r["step"]: r["loss"] for r in _rows(str(tmp_path / "pre"))}
+    assert set(ref) == set(got) == set(range(6))
+    assert all(np.isfinite(v) for v in ref.values())
+    for s in range(6):
+        assert ref[s] == got[s], f"loss diverged at step {s} after resume"
+
+
+@pytest.mark.slow
+def test_sigterm_grace_resume_300_steps(tmp_path, eight_devices):
+    """Extends the 300-step sync-parity acceptance: preempt at step 150,
+    resume, and require the full 300-loss sequence bit-identical to the
+    uninterrupted run."""
+    sync_cfg = tiny_config(model_path=str(tmp_path / "ref"),
+                           async_inflight_steps=0, device_prefetch_depth=0)
+    cli.train(sync_cfg, _args(300))
+    pre = tiny_config(model_path=str(tmp_path / "pre"),
+                      use_checkpointing=True, steps_per_checkpoint=50,
+                      fault_plan="sigterm@step150")
+    with pytest.raises(SystemExit) as e:
+        cli.train(pre, _args(300))
+    assert e.value.code == EXIT_PREEMPTED
+    cli.train(tiny_config(model_path=str(tmp_path / "pre"),
+                          use_checkpointing=True, steps_per_checkpoint=50),
+              _args(300))
+    ref = {r["step"]: r["loss"] for r in _rows(str(tmp_path / "ref"))}
+    got = {r["step"]: r["loss"] for r in _rows(str(tmp_path / "pre"))}
+    assert set(got) == set(range(300))
+    assert [ref[s] for s in range(300)] == [got[s] for s in range(300)]
+
+
+# -- supervisor ---------------------------------------------------------------
+
+def test_supervisor_preemption_relaunches_without_backoff():
+    sleeps = []
+    outcomes = iter([EXIT_PREEMPTED, EXIT_PREEMPTED, 0])
+    progress = iter([-1, 3, 6, 9])
+    sup = supervise.Supervisor(
+        lambda: next(outcomes), lambda: next(progress),
+        sleep=sleeps.append, registry=MetricsRegistry())
+    assert sup.run() == 0
+    assert sleeps == []  # preemption never backs off
+    assert sup.restarts == 2
+
+
+def test_supervisor_crash_backs_off_and_recovers():
+    sleeps = []
+    outcomes = iter([1, 1, 0])
+    progress = iter([-1, 5, 10, 15])  # every run makes progress
+    sup = supervise.Supervisor(
+        lambda: next(outcomes), lambda: next(progress),
+        backoff_base_s=1.0, backoff_max_s=8.0, sleep=sleeps.append,
+        registry=MetricsRegistry())
+    assert sup.run() == 0
+    # progress resets the backoff, so both crashes wait the base delay
+    assert sleeps == [1.0, 1.0]
+
+
+def test_supervisor_aborts_crash_loop_without_progress():
+    sleeps = []
+    sup = supervise.Supervisor(
+        lambda: 1, lambda: 7,  # always crashes, progress frozen
+        max_failures_no_progress=3, backoff_base_s=1.0,
+        sleep=sleeps.append, registry=MetricsRegistry())
+    assert sup.run() == EXIT_CRASH_LOOP
+    assert len(sleeps) == 2  # two relaunches, third failure aborts
+    assert sleeps == [1.0, 2.0]  # no progress: backoff keeps growing
+
+
+def test_supervisor_progress_probe_reads_disk(tmp_path):
+    assert supervise.last_step_progress(str(tmp_path)) == -1
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"run_start": True, "resume_step": 0}) + "\n"
+        + json.dumps({"step": 4, "loss": 1.0}) + "\n"
+        + '{"torn line')
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    (ck / "manifest_6.json").write_text("{}")
+    assert supervise.last_step_progress(str(tmp_path)) == 6
+
+
+def test_supervisor_end_to_end_drill(tmp_path, eight_devices):
+    """THE acceptance drill: feeder death (crash) -> supervisor relaunch
+    with backoff; SIGTERM (preemption + grace checkpoint) -> immediate
+    relaunch; final run completes; the assembled loss sequence is
+    bit-identical to an uninterrupted run."""
+    cli.train(_data_cfg(tmp_path, "ref"), _args(6))
+    plans = ["feeder:die@2", "sigterm@step4", ""]
+
+    def launch():
+        cfg = _data_cfg(tmp_path, "drill", fault_plan=plans.pop(0))
+        try:
+            cli.train(cfg, _args(6))
+        except SystemExit as e:
+            return int(e.code or 0)
+        except Exception:
+            return 1
+        return 0
+
+    sleeps = []
+    sup = supervise.Supervisor(
+        launch, lambda: supervise.last_step_progress(str(tmp_path / "drill")),
+        sleep=sleeps.append, registry=MetricsRegistry())
+    assert sup.run() == 0
+    assert sup.restarts == 2 and len(sleeps) == 1  # 1 crash, 1 preemption
+    ref = {r["step"]: r["loss"] for r in _rows(str(tmp_path / "ref"))}
+    got = {r["step"]: r["loss"] for r in _rows(str(tmp_path / "drill"))}
+    assert set(got) == set(range(6))
+    for s in range(6):
+        assert ref[s] == got[s], f"loss diverged at step {s} after drill"
+
+
+# -- watchdog stall counter (satellite) ---------------------------------------
+
+def test_watchdog_stall_increments_registry_counter(tmp_path):
+    from homebrewnlp_tpu.obs import Health, Watchdog
+    reg = MetricsRegistry()
+    health = Health(stall_factor=2.0)
+    health.step_completed(0)
+    time.sleep(0.02)
+    health.step_completed(1)
+    wd = Watchdog(health, str(tmp_path), factor=2.0, poll_s=0.02,
+                  min_stall_s=0.05, registry=reg)
+    wd.start()
+    time.sleep(0.4)  # stall
+    wd.stop()
+    assert reg.counter("hbnlp_watchdog_stalls_total").value() == 1
+    assert "hbnlp_watchdog_stalls_total 1" in reg.render()
+
+
+# -- feeder death surfaces as a crash ----------------------------------------
+
+def test_feeder_death_crashes_run_with_flushed_metrics(tmp_path,
+                                                       eight_devices):
+    """feeder:die kills the producer thread; the consumer re-raises, the
+    run exits nonzero (a crash, not a hang), and already-completed steps
+    are flushed for the post-mortem."""
+    cfg = tiny_config(model_path=str(tmp_path),
+                      fault_plan="feeder:die@3", device_prefetch_depth=1)
+    with pytest.raises(FaultInjectedCrash):
+        cli.train(cfg, _args(10))
+    steps = [r["step"] for r in _rows(str(tmp_path))]
+    assert steps == [0, 1]  # two batches fed before the injected death
+
+
+# -- code-review hardening regressions ----------------------------------------
+
+def test_supervisor_exit_code_contract_and_no_jax():
+    """tools/supervise.py pins the exit codes locally (it must not import
+    the package, whose __init__ pulls jax); the two definitions cannot
+    drift, and the supervise module must be loadable without jax."""
+    import homebrewnlp_tpu.reliability as rel
+    assert supervise.EXIT_PREEMPTED == rel.EXIT_PREEMPTED
+    assert supervise.EXIT_GRACE_TIMEOUT == rel.EXIT_GRACE_TIMEOUT
+    assert supervise.EXIT_CRASH_LOOP == rel.EXIT_CRASH_LOOP
+    import subprocess
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.modules['jax'] = None\n"  # poison jax import
+         "import importlib.util\n"
+         "spec = importlib.util.spec_from_file_location('supervise', "
+         f"{os.path.join(REPO, 'tools', 'supervise.py')!r})\n"
+         "m = importlib.util.module_from_spec(spec)\n"
+         "spec.loader.exec_module(m)\n"
+         "print(m.EXIT_PREEMPTED)"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "83"
+
+
+def test_save_after_fallback_restore_persists(tmp_path, eight_devices):
+    """Rejected (corrupt) newer checkpoints are scrubbed on fallback, so a
+    later save at a LOWER step is not silently swallowed by orbax's
+    should_save — without the scrub, no checkpoint would persist until
+    training re-passed the corrupt step."""
+    from homebrewnlp_tpu.reliability.faults import corrupt_largest_file
+    _ckpt_run(str(tmp_path), 4)  # checkpoints at 2 and 4
+    corrupt_largest_file(str(tmp_path / "ckpt" / "4"))
+    # fallback restore (lands on 2) scrubs the corrupt step 4 ...
+    step, _ = _restore_step(str(tmp_path))
+    assert step == 2
+    assert not (tmp_path / "ckpt" / "4").exists()
+    # ... so resuming training persists its step-3/4 checkpoints again
+    cli.train(tiny_config(model_path=str(tmp_path), use_checkpointing=True,
+                          steps_per_checkpoint=1, max_checkpoints_keep=5),
+              _args(3))
+    assert (tmp_path / "ckpt" / "3").is_dir()
+    assert (tmp_path / "ckpt" / "manifest_3.json").exists()
+
+
+def test_step_fault_rules_disarm_on_resume(tmp_path, eight_devices):
+    """A sigterm@stepN plan inherited by the relaunched child (config/env)
+    must not refire at the resume step: run 1 preempts at N, run 2 with the
+    SAME plan resumes from N and completes."""
+    cfg = dict(model_path=str(tmp_path), use_checkpointing=True,
+               steps_per_checkpoint=10, fault_plan="sigterm@step2")
+    with pytest.raises(SystemExit) as e:
+        cli.train(tiny_config(**cfg), _args(5))
+    assert e.value.code == EXIT_PREEMPTED
+    cli.train(tiny_config(**cfg), _args(5))  # same plan: must complete
+    assert sorted({r["step"] for r in _rows(str(tmp_path))}) == list(range(5))
+
+
+def test_restore_propagates_exhausted_transient_errors(tmp_path,
+                                                       eight_devices,
+                                                       monkeypatch):
+    """A storage outage that survives the retry budget must surface as the
+    real error, NOT masquerade as corruption and silently fall back to an
+    older checkpoint."""
+    from homebrewnlp_tpu.train import checkpoint as ckpt_mod
+    _ckpt_run(str(tmp_path), 4)
+    real = ckpt_mod.ocp.CheckpointManager.restore
+
+    def outage(self, step, *a, **kw):
+        raise OSError("storage unreachable")
+
+    monkeypatch.setattr(ckpt_mod.ocp.CheckpointManager, "restore", outage)
+    with pytest.raises(OSError, match="storage unreachable"):
+        _restore_step(str(tmp_path))
+    monkeypatch.setattr(ckpt_mod.ocp.CheckpointManager, "restore", real)
+    step, _ = _restore_step(str(tmp_path))  # outage over: newest restores
+    assert step == 4

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Auto-resume supervisor: relaunch training across preemptions and crashes.
+
+The reference assumed an operator (or a cron'd shell loop) would restart a
+preempted TPU run; the framework's own resume machinery (verified
+checkpoints + data-cursor sidecars, train/checkpoint.py) makes the restart
+itself safe, so this closes the loop:
+
+- **preemption** (exit ``EXIT_PREEMPTED`` = 83: SIGTERM/SIGINT handled, a
+  grace checkpoint was cut) -> relaunch immediately, no backoff — spot
+  reclamation is not a bug;
+- **crash** (any other nonzero exit) -> relaunch with exponential backoff;
+- **crash loop** (K consecutive exits with NO step progress, measured from
+  ``metrics.jsonl`` and the verified-checkpoint manifests — never from the
+  child's own claims) -> abort with ``EXIT_CRASH_LOOP`` = 85 so the
+  orchestrator above sees a real failure instead of an infinite restart;
+- progress resets both the failure count and the backoff.
+
+Counters flow through the obs registry
+(``hbnlp_supervisor_exits_total{outcome}``), rendered to
+``<model_path>/supervisor_metrics.prom`` on exit and served live on
+``--obs-port`` if given.  Exit-code contract + drill walkthrough:
+docs/reliability.md.
+
+Usage:
+  python tools/supervise.py --model-path runs/flagship -- \\
+      python main.py --model configs/32big_mixer.json --run_mode train
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+import typing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_light(name: str, relpath: str):
+    """Load a stdlib-only module by FILE PATH, bypassing the
+    ``homebrewnlp_tpu`` package __init__ (which imports jax via config.py).
+    The supervisor must survive exactly the failures that kill the child —
+    a broken jax/libtpu install must not take the relauncher down with it."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_registry = _load_light("hbnlp_obs_registry",
+                        "homebrewnlp_tpu/obs/registry.py")
+MetricsRegistry = _registry.MetricsRegistry
+REGISTRY = _registry.REGISTRY
+
+# the exit-code contract with homebrewnlp_tpu.reliability (which cannot be
+# imported here without dragging in jax); pinned by a reliability_test
+# assertion so the two definitions cannot drift
+EXIT_PREEMPTED = 83
+EXIT_GRACE_TIMEOUT = 84
+EXIT_CRASH_LOOP = 85
+
+LOG = logging.getLogger("homebrewnlp_tpu.supervise")
+
+
+def last_step_progress(model_path: str) -> int:
+    """Newest training progress visible ON DISK: max of the last
+    ``metrics.jsonl`` step and the newest checkpoint-manifest step.  -1
+    before any progress.  Reads only JSON/dirnames — no jax, no orbax."""
+    best = -1
+    mpath = os.path.join(model_path, "metrics.jsonl")
+    if os.path.exists(mpath):
+        try:
+            with open(mpath) as f:
+                for line in f:
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line of a crashed writer
+                    if "loss" in row and "step" in row:
+                        best = max(best, int(row["step"]))
+        except OSError as e:
+            LOG.warning("cannot read %s: %r", mpath, e)
+    ckpt = os.path.join(model_path, "ckpt")
+    if os.path.isdir(ckpt):
+        for fn in os.listdir(ckpt):
+            if fn.startswith("manifest_") and fn.endswith(".json"):
+                try:
+                    best = max(best, int(fn[len("manifest_"):-len(".json")]))
+                except ValueError:
+                    continue
+    return best
+
+
+class Supervisor:
+    """Relaunch policy around an injectable ``launch`` callable (a
+    subprocess in production, an in-process train call in tests).
+
+    ``progress`` is polled after every exit; only on-disk progress counts —
+    a child that crashes before flushing anything reads as 'no progress'."""
+
+    def __init__(self, launch: typing.Callable[[], int],
+                 progress: typing.Callable[[], int], *,
+                 max_failures_no_progress: int = 3,
+                 backoff_base_s: float = 1.0, backoff_max_s: float = 60.0,
+                 max_restarts: int = 0,
+                 sleep: typing.Callable[[float], None] = time.sleep,
+                 registry: typing.Optional[MetricsRegistry] = None):
+        self.launch = launch
+        self.progress = progress
+        self.max_failures_no_progress = int(max_failures_no_progress)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.max_restarts = int(max_restarts)  # 0 = unlimited
+        self.sleep = sleep
+        self.registry = registry if registry is not None else REGISTRY
+        self._exits = self.registry.counter(
+            "hbnlp_supervisor_exits_total",
+            "child exits seen by the supervisor, by outcome",
+            labelnames=("outcome",))
+        self.restarts = 0
+
+    def run(self) -> int:
+        failures_no_progress = 0
+        backoff = self.backoff_base_s
+        last = self.progress()
+        while True:
+            rc = self.launch()
+            now = self.progress()
+            advanced = now > last
+            last = max(last, now)
+            if rc == 0:
+                LOG.info("training completed cleanly at step %d "
+                         "(%d restart(s))", last, self.restarts)
+                self._exits.labels(outcome="clean").inc()
+                return 0
+            preempted = rc == EXIT_PREEMPTED
+            self._exits.labels(
+                outcome="preemption" if preempted else "crash").inc()
+            if advanced:
+                failures_no_progress = 0
+                backoff = self.backoff_base_s
+            else:
+                failures_no_progress += 1
+                if failures_no_progress >= self.max_failures_no_progress:
+                    LOG.error(
+                        "crash loop: %d consecutive exits with no step "
+                        "progress (stuck at step %d, last exit code %d); "
+                        "aborting with %d", failures_no_progress, last, rc,
+                        EXIT_CRASH_LOOP)
+                    self._exits.labels(outcome="crash_loop_abort").inc()
+                    return EXIT_CRASH_LOOP
+            self.restarts += 1
+            if self.max_restarts and self.restarts > self.max_restarts:
+                LOG.error("restart budget (%d) exhausted; passing through "
+                          "exit code %d", self.max_restarts, rc)
+                return rc
+            if preempted:
+                LOG.warning("preemption exit (%d): grace checkpoint cut at "
+                            "step %d; relaunching (restart %d)", rc, last,
+                            self.restarts)
+            else:
+                LOG.warning("crash exit %d at step %d; relaunching in %.1fs "
+                            "(restart %d, %d/%d failures without progress)",
+                            rc, last, backoff, self.restarts,
+                            failures_no_progress,
+                            self.max_failures_no_progress)
+                self.sleep(backoff)
+                backoff = min(backoff * 2.0, self.backoff_max_s)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        usage="supervise.py --model-path DIR [options] -- command ...")
+    p.add_argument("--model-path", required=True,
+                   help="the run's cfg.model_path (progress is read from "
+                        "its metrics.jsonl + checkpoint manifests)")
+    p.add_argument("--max-failures-no-progress", type=int, default=3,
+                   help="K consecutive no-progress exits before the crash-"
+                        "loop abort (exit %d)" % EXIT_CRASH_LOOP)
+    p.add_argument("--backoff-base", type=float, default=1.0,
+                   help="seconds before the first crash relaunch (doubles "
+                        "up to --backoff-max; preemptions skip backoff)")
+    p.add_argument("--backoff-max", type=float, default=60.0)
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="total relaunch budget (0 = unlimited)")
+    p.add_argument("--obs-port", type=int, default=0,
+                   help=">0: serve the supervisor's /metrics on "
+                        "127.0.0.1:<port>")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command after '--'")
+    args = p.parse_args(argv)
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        p.error("no training command given (append it after '--')")
+    args.command = cmd
+    return args
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s supervise %(levelname)s %(message)s")
+    args = parse_args(argv)
+    sup = Supervisor(
+        lambda: subprocess.call(args.command),
+        lambda: last_step_progress(args.model_path),
+        max_failures_no_progress=args.max_failures_no_progress,
+        backoff_base_s=args.backoff_base, backoff_max_s=args.backoff_max,
+        max_restarts=args.max_restarts)
+    server = None
+    if args.obs_port:
+        # the exporter import pulls the full package (and jax); degrade to
+        # no endpoint rather than dying — supervision is the job here
+        try:
+            from homebrewnlp_tpu.obs.exporter import start_server
+            server = start_server(args.obs_port, registry=sup.registry)
+        except Exception as e:
+            LOG.warning("--obs-port unavailable (%r); supervising without "
+                        "a metrics endpoint", e)
+    try:
+        return sup.run()
+    finally:
+        try:
+            os.makedirs(args.model_path, exist_ok=True)
+            with open(os.path.join(args.model_path,
+                                   "supervisor_metrics.prom"), "w") as f:
+                f.write(sup.registry.render())
+        except OSError as e:
+            LOG.warning("could not persist supervisor metrics: %r", e)
+        if server is not None:
+            from homebrewnlp_tpu.obs.exporter import stop_server
+            stop_server(server)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
