@@ -69,16 +69,11 @@ import jax
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
 
-# Peak dense bf16 FLOP/s per chip, by device_kind substring (public specs).
-_PEAK_BF16 = (
-    ("v6", 918e12), ("trillium", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v5", 459e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
+# Peak table + MFU arithmetic shared with the LIVE utilization accounting
+# (homebrewnlp_tpu/train/flops.py): bench's offline mfu and the run's
+# /metrics mfu are the same math over the same cost-analyzed executable,
+# so the two figures cannot drift.
+from homebrewnlp_tpu.train.flops import peak_flops as _peak_flops  # noqa: E402
 
 # The three reference workload definitions (BASELINE.md:19-21), batch shrunk
 # to one chip.  slice_dtype (device-resident param copy) is forced to bf16:
@@ -98,14 +93,6 @@ WORKLOADS = {
     # batch 256 -> 8
     "32ctx_mixer": dict(train_batch_size=8),
 }
-
-
-def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for sub, peak in _PEAK_BF16:
-        if sub in kind:
-            return peak
-    return None  # CPU / unknown: no MFU claim
 
 
 _CACHE_PREWARMED = None
@@ -303,7 +290,63 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
             "cache_prewarmed": cache_prewarmed,
             "hit": bool(cache_prewarmed or warm_s < 0.5 * cold_compile_s),
         }
+        if os.environ.get("HBNLP_BENCH_TELEMETRY", "1") != "0":
+            # device-telemetry overhead probe (docs/observability.md): the
+            # same workload with in-graph numerics armed.  Acceptance:
+            # tokens/s within 2% of the base row, and the telemetry graph's
+            # cost-analyzed flops within 1% of flops_per_step (the norm
+            # reductions are O(params), noise next to the matmuls) — both
+            # ratios ride the line.  LAST probe in the row: its step calls
+            # donate `state`
+            try:
+                row["telemetry"] = _telemetry_probe(
+                    name, trainer, state, batch, flops_exec, row["value"])
+            except Exception as e:  # noqa: BLE001 - must not kill the line
+                row["telemetry"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     return row
+
+
+def _telemetry_probe(name: str, trainer, state, batch, flops_base: float,
+                     base_tok_s: float) -> dict:
+    """Timed windows of the telemetry-enabled step (telemetry_interval=1,
+    anomaly_policy=skip_step — the most expensive configuration: sentinels,
+    norms AND the in-graph update mask).  Returns tokens/s, the ratio vs
+    the base row, and the flops agreement with the base cost analysis."""
+    from homebrewnlp_tpu.optim import Optimizer
+    from homebrewnlp_tpu.train import Trainer
+    from homebrewnlp_tpu.utils import load_config
+
+    cfg_tel = load_config(f"configs/{name}.json", **_COMMON,
+                          **WORKLOADS[name], telemetry_interval=1,
+                          anomaly_policy="skip_step")
+    tr = Trainer(cfg_tel)
+    tr.axes = trainer.axes
+    tr.optimizer = Optimizer(cfg_tel, trainer.axes)
+    cost = tr.step_cost_analysis(state, batch)
+    flops_tel = float(cost.get("flops", 0.0))
+    rng = jax.random.key(2)
+    for i in range(3):  # warmup the telemetry executable
+        state, metrics = tr.step(state, batch, jax.random.fold_in(rng, i))
+    float(metrics["loss"])
+    n_steps, dts = 10, []
+    for w in range(3):
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            state, metrics = tr.step(state, batch,
+                                     jax.random.fold_in(rng, 100 + w * 16 + i))
+        jax.block_until_ready(state)
+        float(metrics["loss"])
+        dts.append(time.perf_counter() - t0)
+    dt = sorted(dts)[len(dts) // 2]
+    tokens = cfg_tel.train_batch_size * cfg_tel.sequence_length * n_steps
+    tok_s = tokens / dt / max(1, len(jax.devices()))
+    return {
+        "value": round(tok_s, 2),
+        "ratio_vs_base": round(tok_s / base_tok_s, 4) if base_tok_s else None,
+        "flops_per_step": flops_tel,
+        "flops_ratio_vs_base": (round(flops_tel / flops_base, 4)
+                                if flops_base else None),
+    }
 
 
 def ensure_real_corpus(pattern: str, builder=None):

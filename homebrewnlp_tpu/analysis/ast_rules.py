@@ -27,10 +27,14 @@ Static source rules (no tracing, no jax beyond the axis registry import):
   ``goldens/ast_host_sync.json`` may only go down.
 - ``obs-in-trace``: no observability calls (anything imported from the
   ``obs`` package — span tracer, metrics registry, exporter) inside
-  jit-traced code (models/, ops/, infer/, optim/).  A host-side span or
-  counter update in traced code either bakes a trace-time no-op into the
-  graph or, worse, forces a host callback; instrumentation belongs in the
-  host loop layers (main.py, data/feed.py, train/metrics.py, serve/).
+  jit-traced code (models/, ops/, infer/, optim/, train/state.py).  A
+  host-side span or counter update in traced code either bakes a
+  trace-time no-op into the graph or, worse, forces a host callback;
+  instrumentation belongs in the host loop layers (main.py, data/feed.py,
+  train/metrics.py, serve/).  ONE explicit exception:
+  ``obs/device_telemetry.py`` (``OBS_IN_TRACE_ALLOWED``) is pure jnp by
+  contract and is how the train step computes in-graph numerics — imports
+  of/from it never count, everything else in ``obs`` stays forbidden.
   Ratcheted: per-file counts pinned in ``goldens/ast_obs_in_trace.json``
   (committed empty) may only go down.
 - ``bare-io``: no unwrapped I/O in the ``train/`` and ``data/`` hot paths
@@ -328,9 +332,19 @@ def check_host_sync(root: str, update_goldens: bool = False
                   "deferred drain")
 
 
-#: jit-traced scopes the obs-in-trace rule forbids span/registry calls in
+#: jit-traced scopes the obs-in-trace rule forbids span/registry calls in.
+#: train/state.py joined with the device-telemetry PR: the step function it
+#: builds IS traced code, and it legitimately imports the one allowlisted
+#: obs module below.
 OBS_IN_TRACE_SCOPE = ("homebrewnlp_tpu/models", "homebrewnlp_tpu/ops",
-                      "homebrewnlp_tpu/infer", "homebrewnlp_tpu/optim")
+                      "homebrewnlp_tpu/infer", "homebrewnlp_tpu/optim",
+                      "homebrewnlp_tpu/train/state.py")
+
+#: the ONE obs module legal in traced code: ``obs/device_telemetry.py`` is
+#: pure jnp by contract (its host half runs only in the metric drain), so
+#: imports of/from it never count — every other obs module (spans,
+#: registry, exporter) stays forbidden in the traced scopes.
+OBS_IN_TRACE_ALLOWED = frozenset({"device_telemetry"})
 
 
 def _obs_aliases(tree: ast.Module
@@ -343,14 +357,24 @@ def _obs_aliases(tree: ast.Module
     roots come from a bare ``import homebrewnlp_tpu.obs.spans``: only the
     TOP-LEVEL name is bound, so a call through it counts only when its
     attribute chain passes through ``obs`` (otherwise ``homebrewnlp_tpu.nd
-    .register_axis(...)`` in the same file would be miscounted)."""
+    .register_axis(...)`` in the same file would be miscounted).
+
+    Imports of (or from) an ``OBS_IN_TRACE_ALLOWED`` module bind nothing:
+    ``from ..obs import device_telemetry`` / ``from
+    ..obs.device_telemetry import collect`` are the sanctioned way for
+    traced code to reach the in-graph telemetry."""
     aliases: typing.Set[str] = set()
     dotted_roots: typing.Set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.ImportFrom):
             mod = node.module or ""
-            if "obs" in mod.split("."):
+            parts = mod.split(".")
+            if OBS_IN_TRACE_ALLOWED & set(parts):
+                continue  # importing FROM the allowlisted module
+            if "obs" in parts:
                 for a in node.names:
+                    if a.name in OBS_IN_TRACE_ALLOWED:
+                        continue  # `from ..obs import device_telemetry`
                     aliases.add(a.asname or a.name)
             else:  # the package imported as a name: `from .. import obs`
                 for a in node.names:
@@ -362,8 +386,17 @@ def _obs_aliases(tree: ast.Module
                 if "obs" not in parts:
                     continue
                 if a.asname is not None or parts[0] == "obs":
-                    aliases.add(a.asname or parts[0])
+                    # direct alias: skip the binding ONLY when it names the
+                    # allowlisted module itself (`import ...device_telemetry
+                    # as dt`)
+                    if not OBS_IN_TRACE_ALLOWED & set(parts):
+                        aliases.add(a.asname or parts[0])
                 else:
+                    # bare dotted import binds the TOP-LEVEL name: track the
+                    # root even for an allowlisted module — the chain filter
+                    # at the call site decides, so `import homebrewnlp_tpu.
+                    # obs.device_telemetry` cannot whitelist a sibling
+                    # `homebrewnlp_tpu.obs.spans.span(...)` in the same file
                     dotted_roots.add(parts[0])
     return aliases, dotted_roots
 
@@ -397,8 +430,12 @@ def obs_in_trace_counts(root: str) -> typing.Dict[str, int]:
                 cur = cur.func if isinstance(cur, ast.Call) else cur.value
             if not isinstance(cur, ast.Name):
                 continue
-            hit = cur.id in aliases or (cur.id in dotted_roots
-                                        and "obs" in chain)
+            rooted = cur.id in aliases or (cur.id in dotted_roots
+                                           and "obs" in chain)
+            # chain-level allowlist: a call whose attribute path passes
+            # through device_telemetry (`obs.device_telemetry.collect(...)`)
+            # is the sanctioned in-graph telemetry, whatever root it rides
+            hit = rooted and not (OBS_IN_TRACE_ALLOWED & set(chain))
             if hit and not _suppressed(lines, node.lineno, "obs-in-trace"):
                 n += 1
         if n:
@@ -419,7 +456,9 @@ def check_obs_in_trace(root: str, update_goldens: bool = False
         unit="obs span/registry call(s) in jit-traced code",
         over_hint="host observability inside traced code bakes a no-op into "
                   "the graph (or forces a host callback); instrument the "
-                  "host loop layers instead (docs/observability.md)")
+                  "host loop layers instead — in-graph numerics belong in "
+                  "the allowlisted obs/device_telemetry.py "
+                  "(docs/observability.md)")
 
 
 #: hot paths the bare-io rule audits: every I/O call here must go through

@@ -227,7 +227,9 @@ def trace_train(cfg: Config, mesh=None) -> typing.Tuple[StepTrace, dict, dict]:
                        jax.ShapeDtypeStruct((), jnp.int32))
     step = trainer._make_step()
     with trace_compat(), mesh:
-        traced = step.trace(state, batch, jax.random.key(0))
+        # step_extra_args: telemetry-enabled configs take a grad_scale input
+        traced = step.trace(state, batch, jax.random.key(0),
+                            *trainer.step_extra_args())
     args_info = traced.args_info
     # args_info mirrors the call tree: ((state, batch, rng), {}) — the
     # TrainState subtree carries the donation bits the audit needs

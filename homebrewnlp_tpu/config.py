@@ -198,6 +198,22 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # export model_path/trace.json (Chrome trace-event JSON, Perfetto-
     # loadable); each span also mirrors into jax.profiler.TraceAnnotation
     obs_spans=False,
+    # device telemetry (docs/observability.md "Device telemetry").
+    # telemetry_interval: >0 computes in-graph numerics (grad/param/update
+    # norms, NaN/Inf sentinels) inside the jitted step EVERY update and
+    # writes the norm-class metrics every N updates (sentinels drain every
+    # step); 0 = off — the step compiles to the exact pre-telemetry graph,
+    # keeping the sync-parity sequence bit-identical.
+    telemetry_interval=0,
+    # telemetry_groups: param-name substrings; each gets a per-group
+    # gradient-norm metric telemetry/grad_norm/<group> (e.g.
+    # ["embed", "body", "output"])
+    telemetry_groups=(),
+    # anomaly_policy: what the NaN/Inf gradient sentinels trigger —
+    # "log" (observe only), "skip_step" (mask the optimizer update
+    # in-graph and count hbnlp_anomaly_skips_total), "halt" (exit with
+    # EXIT_ANOMALY_HALT so a supervisor restarts from the last checkpoint)
+    anomaly_policy="log",
     # watchdog_factor: N>0 arms the hang watchdog — when no step completes
     # within N x the EMA step time, thread stacks + device memory stats are
     # dumped to model_path/diagnostics/ (once per stall; never kills the
@@ -332,6 +348,16 @@ class Config:
                              "(0 = inline batch assembly)")
         if int(self.obs_port) < 0:
             raise ValueError("obs_port must be >= 0 (0 = exporter disabled)")
+        if int(self.telemetry_interval) < 0:
+            raise ValueError("telemetry_interval must be >= 0 "
+                             "(0 = device telemetry disabled)")
+        self.telemetry_interval = int(self.telemetry_interval)
+        self.telemetry_groups = [str(g) for g in self.telemetry_groups]
+        from .obs.device_telemetry import ANOMALY_POLICIES
+        if self.anomaly_policy not in ANOMALY_POLICIES:
+            raise ValueError(
+                f"unknown anomaly_policy {self.anomaly_policy!r}; expected "
+                f"one of {ANOMALY_POLICIES}")
         if self.watchdog_factor < 0:
             raise ValueError("watchdog_factor must be >= 0 "
                              "(0 = watchdog disabled)")
@@ -356,7 +382,16 @@ class Config:
             # surface a typoed plan at config load, not mid-run; parse_plan
             # raises ValueError naming the bad entry
             from .reliability.faults import parse_plan
-            parse_plan(self.fault_plan)
+            rules = parse_plan(self.fault_plan)
+            if (any(r.site == "grads" for r in rules)
+                    and self.telemetry_interval <= 0):
+                # the grads site is polled by the loop only when device
+                # telemetry is on — a silently-inert chaos drill would
+                # report success while testing nothing
+                raise ValueError(
+                    "fault_plan uses the 'grads' site, which requires "
+                    "telemetry_interval > 0 (the injection rides the "
+                    "telemetry grad_scale input)")
 
         for attr in ("position_embedding", "token_embedding", "output_embedding",
                      "empty_frame_embedding"):

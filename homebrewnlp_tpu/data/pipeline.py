@@ -276,7 +276,8 @@ class GptPipeline:
                   else bool(cfg.repeat_dataset))
         # corrupt_record_budget > 0: unreadable records/shards are skipped
         # (logged + counted) up to the budget instead of killing the run
-        budget = (CorruptRecordBudget(cfg.corrupt_record_budget)
+        budget = (CorruptRecordBudget(cfg.corrupt_record_budget,
+                                      pipeline="text")
                   if cfg.corrupt_record_budget > 0 else None)
         self.interleave = _Interleave(
             files, file_skips, window, cfg.sequence_length,
@@ -339,7 +340,8 @@ class JannetTextPipeline:
                                    cfg.data_seed * int(cfg.shuffle_input_filenames))
         per_frame = cfg.language_token_per_frame - 1
         window = (cfg.time_patch_size + 1) * per_frame
-        budget = (CorruptRecordBudget(cfg.corrupt_record_budget)
+        budget = (CorruptRecordBudget(cfg.corrupt_record_budget,
+                                      pipeline="text")
                   if cfg.corrupt_record_budget > 0 else None)
         self.interleave = _Interleave(files, skips, window, window,
                                       cfg.interleaved_datasets, repeat=True,

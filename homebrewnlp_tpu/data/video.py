@@ -12,11 +12,13 @@ concat masks (dataset_video._pre_func, inputs.py:412-465).
 """
 from __future__ import annotations
 
+import functools
 import typing
 
 import numpy as np
 
 from ..config import Config
+from ..reliability import CorruptRecordBudget, faults
 from .pipeline import _ShuffleBuffer, split_files
 from .tfrecord import decode_example, read_records
 
@@ -82,6 +84,21 @@ class FrameDecoder:
             mask = token_range <= int(ex["mask"][0])
         return frame, concat, skip, tokens, mask
 
+    def skipped(self) -> typing.Tuple[np.ndarray, int, int,
+                                      typing.Optional[np.ndarray],
+                                      typing.Optional[np.ndarray]]:
+        """Placeholder for an undecodable record under the corrupt-record
+        budget: a zero frame flagged ``skip`` — exactly the shape the model
+        already handles for real skip-frames, so window/batch alignment and
+        the resume cursor are unaffected by the substitution (unlike the
+        text pipeline, where a skipped record shifts window numbering)."""
+        cfg = self.cfg
+        tokens = mask = None
+        if cfg.language_token_per_frame > 0:
+            tokens = np.zeros(cfg.language_token_per_frame, np.int32)
+            mask = np.zeros(cfg.language_token_per_frame, bool)
+        return (np.zeros(self.frame_shape, self.dtype), 0, 1, tokens, mask)
+
 
 class VideoPipeline:
     """Windowed, batched video (+token) samples (reference dataset_video).
@@ -104,6 +121,13 @@ class VideoPipeline:
         self.files, _ = split_files(paths, slice_index, slice_count,
                                     cfg.data_seed * int(cfg.shuffle_input_filenames))
         self.decoder = FrameDecoder(cfg)
+        # corrupt_record_budget > 0: per-frame decode errors substitute a
+        # skipped frame (counted on hbnlp_corrupt_records_total{
+        # pipeline="video"}) and framing errors abandon the shard, up to the
+        # budget, instead of killing the run (docs/reliability.md)
+        self.budget = (CorruptRecordBudget(cfg.corrupt_record_budget,
+                                           pipeline="video")
+                       if cfg.corrupt_record_budget > 0 else None)
         # cursor: next window position in the stream (file_idx may equal
         # len(files): the repeat loop wraps it)
         self.file_idx = 0
@@ -113,17 +137,50 @@ class VideoPipeline:
         # inputs.py:556-559); cv2 releases the GIL
         self._workers = int(cfg.parallel_interleave or 1)
 
-    def _decode_records(self, path: str, skip_records: int = 0):
+    def _iter_records(self, path: str, skip_records: int = 0):
+        """Record payloads of one shard; under a budget, a read/framing
+        error spends it and abandons the rest of the shard (the reader
+        position is unknown past a framing error — same rule as the text
+        pipeline)."""
         records = read_records(path, skip=skip_records)
+        while True:
+            try:
+                # fault site "data_read:fail@N" exercises the budget path
+                faults.hit("data_read")
+                payload = next(records)
+            except StopIteration:
+                return
+            except Exception as e:
+                if self.budget is None:
+                    raise
+                self.budget.spend(path, e)  # raises when over budget
+                return
+            yield payload
+
+    def _safe_decode(self, path: str, payload: bytes):
+        """Frame decode with the budget: an undecodable JPEG / bad Example
+        spends the budget and yields a skipped-frame placeholder (decoder
+        docstring) — per-frame decode errors skip-and-count, never raise."""
+        try:
+            return self.decoder(payload)
+        except Exception as e:
+            if self.budget is None:
+                raise
+            self.budget.spend(f"{path} (frame decode)", e)
+            return self.decoder.skipped()
+
+    def _decode_records(self, path: str, skip_records: int = 0):
+        records = self._iter_records(path, skip_records=skip_records)
+        decode = functools.partial(self._safe_decode, path)
         if self._workers <= 1:
             for payload in records:
-                yield self.decoder(payload)
+                yield decode(payload)
             return
         from multiprocessing.pool import ThreadPool
         # pool per file so worker threads are torn down deterministically
         # (a long-lived pool would keep non-daemon threads alive at exit)
         with ThreadPool(self._workers) as pool:
-            yield from pool.imap(self.decoder, records, chunksize=4)
+            yield from pool.imap(decode, records, chunksize=4)
 
     def _file_windows(self, path: str, skip_windows: int = 0):
         cfg = self.cfg

@@ -89,7 +89,9 @@ def train(cfg, args) -> None:
     ``EXIT_PREEMPTED`` so a supervisor (tools/supervise.py) can tell
     preemption from crash."""
     from .obs import Obs
-    from .reliability import EXIT_PREEMPTED, GraceController, faults
+    from .obs.device_telemetry import AnomalyHalt
+    from .reliability import (EXIT_ANOMALY_HALT, EXIT_PREEMPTED,
+                              GraceController, faults)
     from .train import color_print
     # installed (or cleared) EVERY run: a plan must never leak across runs
     faults.install(cfg.fault_plan or None)
@@ -102,6 +104,14 @@ def train(cfg, args) -> None:
         obs.start()
         grace.install()
         _train_loop(cfg, args, obs, grace)
+    except AnomalyHalt as e:
+        # device telemetry saw non-finite gradients under
+        # anomaly_policy="halt": exit with the distinct code BEFORE any
+        # further checkpoint could persist poisoned state; the supervisor
+        # treats it as a crash (backoff + resume from the last good save)
+        color_print(f"ANOMALY HALT: {e}; exiting with code "
+                    f"{EXIT_ANOMALY_HALT}")
+        raise SystemExit(EXIT_ANOMALY_HALT) from e
     finally:
         grace.uninstall()
         obs.close()
@@ -122,11 +132,14 @@ def _train_loop(cfg, args, obs, grace) -> None:
     dispatch; the normal tail then cuts the grace checkpoint."""
     import itertools
 
+    run_t0 = time.time()  # TRUE run start: goodput's wall origin must
+    # include mesh build, init/restore, and the step compile below
+
     import jax
     from .data import RunLog, dataset, to_global
     from .data.feed import DeviceFeeder
     from .data.synthetic import synthetic_text_batch
-    from .obs import spans
+    from .obs import device_telemetry, spans
     from .reliability import faults
     from .train import AsyncMetricWriter, MetricWriter, color_print
     from .train.metrics import config_hash
@@ -153,8 +166,8 @@ def _train_loop(cfg, args, obs, grace) -> None:
     else:
         color_print("no dataset files found; using synthetic data")
         first_np = synthetic_text_batch(cfg, 0)
-    trainer, state, ckpt, data_state = _build_state(
-        cfg, to_global(first_np, cfg, mesh), mesh)
+    template_gb = to_global(first_np, cfg, mesh)
+    trainer, state, ckpt, data_state = _build_state(cfg, template_gb, mesh)
     if int(state.step) == 0 and cfg.current_step > 0:
         # config-forced starting step with no checkpoint (the reference reads
         # it from estimator internals and skips data accordingly,
@@ -176,12 +189,38 @@ def _train_loop(cfg, args, obs, grace) -> None:
             pipe.load_state_dict(data_state["pipeline"])
 
     _dump_run_artifacts(cfg, trainer, state.params)
+    # device telemetry (docs/observability.md "Device telemetry"): static
+    # utilization accounting once at startup — the HLO cost analysis rides
+    # the step compile the run pays anyway (the kept AOT executable then
+    # serves every loop step) — plus the drain-side anomaly monitor
+    telemetry_on = cfg.telemetry_interval > 0
+    util = anomaly = None
+    if telemetry_on:
+        from .obs.device_telemetry import AnomalyMonitor
+        from .train import flops as flops_mod
+        anomaly = AnomalyMonitor(cfg.anomaly_policy, registry=obs.registry
+                                 if obs.enabled else None)
+        # template_gb is reused from init: cost analysis only LOWERS the
+        # step, so no second H2D transfer of a full global batch
+        util = flops_mod.utilization_for(
+            trainer, state, template_gb,
+            tokens_per_step=cfg.train_batch_size * max(1, cfg.macro_batching)
+            * cfg.sequence_length)
+        color_print(f"device telemetry on: {util.flops_per_step:.3e} "
+                    f"flops/step ({util.device_kind}), anomaly_policy="
+                    f"{cfg.anomaly_policy}")
+    del template_gb  # release the init batch's device buffers for the run
     # deferred metrics drain: debug_train_step keeps the reference's
     # synchronous per-step prints, so it forces the window to 0
     window = 0 if cfg.debug_train_step else cfg.async_inflight_steps
     writer = AsyncMetricWriter(MetricWriter(cfg.model_path), window=window,
                                health=obs.health if obs.enabled else None,
-                               registry=obs.registry if obs.enabled else None)
+                               registry=obs.registry if obs.enabled else None,
+                               anomaly=anomaly)
+    if util is not None:
+        writer.set_utilization(util, run_start=run_t0)
+        if obs.enabled:
+            obs.watch_utilization(writer, util)
     # run boundary marker: restarts append to metrics.jsonl, so bench /
     # post-mortem tooling splits runs on these records
     cfg_hash = config_hash(cfg)
@@ -256,11 +295,28 @@ def _train_loop(cfg, args, obs, grace) -> None:
             if args.profile and u == profile_window.start:
                 jax.profiler.start_trace(args.profile)
                 tracing = True
+            host_step = step0 + (u - u0) * m  # counter BEFORE this update
+            grad_scale = None
+            if telemetry_on:
+                # fault site "grads": the caller-implemented "nan" action
+                # feeds a NaN gradient scale into this one step so the
+                # anomaly policies are drillable (grads:nan@stepN) — params
+                # stay clean because skip_step masks the update in-graph
+                if "nan" in faults.take("grads", value=host_step):
+                    grad_scale = np.nan
             with spans.span("step", update=u):
                 state, metrics = trainer.step(state, gb,
-                                              jax.random.fold_in(rng, u))
-            host_step = step0 + (u - u0) * m  # counter BEFORE this update
+                                              jax.random.fold_in(rng, u),
+                                              grad_scale=grad_scale)
             u_done = u + 1
+            if telemetry_on:
+                # host-side thinning: norm-class telemetry keys off the
+                # telemetry_interval grid never transfer; sentinels always
+                # do.  The grid keys on the GLOBAL update index so a
+                # resumed run's norm rows land on the same steps as an
+                # uninterrupted one's
+                metrics = device_telemetry.thin(metrics, u,
+                                                cfg.telemetry_interval)
             writer.write(host_step, metrics)
             if obs.enabled:
                 obs.step_dispatched(tokens_per_update)
@@ -308,6 +364,12 @@ def _train_loop(cfg, args, obs, grace) -> None:
             # the in-flight window's COMPLETED updates — those are exactly
             # the losses a post-mortem needs
             writer.flush()
+        except device_telemetry.AnomalyHalt:
+            # the halt sentinel drained during this exit flush (a short run
+            # can end before the deferred window ever drains the anomalous
+            # step): propagate — the tail below must NOT cut a checkpoint
+            # of potentially-poisoned params
+            raise
         except Exception:
             pass  # the failing step's own metrics may be unmaterializable
     if tracing:  # run ended inside the profile window

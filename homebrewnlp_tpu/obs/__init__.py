@@ -167,6 +167,15 @@ class Obs:
         depth = self.registry.get("hbnlp_feeder_queue_depth")
         if depth is not None:  # only train runs register the feeder probe
             depth.set_function(lambda: 0)
+        for name in self._UTIL_GAUGES:
+            g = self.registry.get(name)
+            if g is None:  # only telemetry-enabled runs register these
+                continue
+            try:
+                final = float(g.value())
+            except Exception:
+                final = 0.0
+            g.set_function(lambda final=final: final)
 
     def pause(self, reason: str):
         """Context manager declaring an expected no-steps window (checkpoint
@@ -188,6 +197,33 @@ class Obs:
         self.registry.gauge(
             "hbnlp_feeder_queue_depth",
             "device batches parked in the feeder queue", fn=feeder.qsize)
+
+    #: utilization gauges registered by watch_utilization; frozen on close
+    _UTIL_GAUGES = ("hbnlp_mfu", "hbnlp_tokens_per_sec", "hbnlp_goodput",
+                    "hbnlp_flops_per_step")
+
+    def watch_utilization(self, writer, util) -> None:
+        """Register the live utilization surface (docs/observability.md
+        "Device telemetry"): MFU + tokens/s from the writer's most recent
+        drained step, goodput (useful step time / wall time), and the static
+        per-step FLOPs from the HLO cost analysis.  All render-time
+        callbacks; /healthz mirrors them via the Health utilization probe."""
+        self.registry.gauge(
+            "hbnlp_mfu", "model FLOPs utilization of the last drained step "
+            "(HLO cost-analysis flops / wall / peak)",
+            fn=lambda: writer.last_rates.get("mfu", 0.0))
+        self.registry.gauge(
+            "hbnlp_tokens_per_sec", "training throughput of the last "
+            "drained step", fn=lambda: writer.last_rates.get(
+                "tokens_per_sec", 0.0))
+        self.registry.gauge(
+            "hbnlp_goodput", "useful step seconds / wall seconds this run",
+            fn=writer.goodput)
+        self.registry.gauge(
+            "hbnlp_flops_per_step", "per-step FLOPs of the compiled train "
+            "step (XLA cost analysis)", fn=lambda: util.flops_per_step)
+        self.health.set_utilization_probe(
+            lambda: dict(writer.last_rates, goodput=writer.goodput()))
 
     def sample_device_memory(self) -> None:
         """Refresh per-device memory gauges (called each checkpoint window;

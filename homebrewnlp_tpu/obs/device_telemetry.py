@@ -1,0 +1,171 @@
+"""Device-side training telemetry: pure-``jnp`` numerics INSIDE the step.
+
+The host-side obs layer (spans, registry, watchdog) can say *when* a run
+stalls; this module says *what the model is doing on device*: global and
+per-group gradient norms, parameter norm, update/param ratio, the gradient
+scale, and NaN/Inf sentinels — all computed inside the jitted train step
+(train/state.py) and returned as extra entries of the ordinary metrics
+tree.  Those entries are device arrays like every other metric, so they
+ride the existing ``AsyncMetricWriter`` deferred-drain window: **zero new
+``block_until_ready``/``.item()``/``float()`` on the hot path** (the
+``host-sync`` graftcheck ratchet stays pinned at zero).
+
+This file is the ONE obs module legal in traced code: graftcheck's
+``obs-in-trace`` rule allowlists ``device_telemetry`` imports while still
+failing any ``spans``/``registry`` use in ``models/``/``ops/``/``optim/``/
+``train/state.py`` — the in-graph half below is pure ``jnp`` (no spans, no
+registry, no I/O), and the host half (:class:`AnomalyMonitor`) runs only in
+the metric drain.
+
+Anomaly policies (``cfg.anomaly_policy``), acting on the sentinels:
+
+- ``"log"``       — observe-only: non-finite grads are logged at drain time;
+                    the update applies as-is (loss sequence unchanged).
+- ``"skip_step"`` — the optimizer update AND slot updates are masked
+                    in-graph for non-finite grads (the step is a true no-op
+                    for model state; the step counter and data cursor still
+                    advance), counted on ``hbnlp_anomaly_skips_total``.
+- ``"halt"``      — the drain raises :class:`AnomalyHalt`; main.py exits
+                    with ``EXIT_ANOMALY_HALT`` (86), which the supervisor
+                    treats as a crash (backoff, not immediate relaunch).
+
+Detection is deferred by design: sentinels materialize when the step's
+metrics drain, up to ``async_inflight_steps`` updates after dispatch —
+the price of keeping the loop sync-free (docs/observability.md).
+"""
+from __future__ import annotations
+
+import logging
+import typing
+
+import jax.numpy as jnp
+
+LOG = logging.getLogger("homebrewnlp_tpu.obs.telemetry")
+
+#: every telemetry metric key starts with this
+PREFIX = "telemetry/"
+#: keys that must drain EVERY step (anomaly detection), regardless of the
+#: ``telemetry_interval`` thinning below
+SENTINEL_KEYS = (PREFIX + "nonfinite_grads", PREFIX + "applied",
+                 PREFIX + "grad_scale")
+
+ANOMALY_POLICIES = ("log", "skip_step", "halt")
+
+
+# -- in-graph half (called from the jitted step; pure jnp) -------------------
+
+def grads_finite(grads: typing.Dict[str, jnp.ndarray]
+                 ) -> typing.Tuple[jnp.ndarray, jnp.ndarray]:
+    """(all_finite scalar bool, count of grad tensors with non-finite
+    entries).  Per-tensor ``isfinite().all()`` reductions are fused into the
+    step by XLA — no extra pass over HBM beyond the elementwise check."""
+    flags = [jnp.isfinite(g).all() for g in grads.values()]
+    stacked = jnp.stack(flags)
+    return stacked.all(), jnp.sum(~stacked).astype(jnp.int32)
+
+
+def collect(params: typing.Dict[str, jnp.ndarray],
+            grads: typing.Dict[str, jnp.ndarray],
+            update_sq: typing.Dict[str, jnp.ndarray],
+            grad_scale: jnp.ndarray,
+            nonfinite: jnp.ndarray,
+            applied: typing.Optional[jnp.ndarray],
+            norm_sq_fn: typing.Callable[[str, jnp.ndarray], jnp.ndarray],
+            groups: typing.Sequence[str] = (),
+            ) -> typing.Dict[str, jnp.ndarray]:
+    """The telemetry metrics tree for one step (device arrays; the caller
+    merges it into the step's metrics dict).
+
+    - ``norm_sq_fn(name, grad)`` is the step's own norm convention (it
+      de-duplicates stage-replicated pipeline 'shared' tensors) so group
+      norms agree with the headline ``grad_norm``.
+    - ``update_sq`` maps param name -> squared L2 of the APPLIED update
+      (already zero for a masked skip_step update).
+    - ``applied`` is the in-graph skip sentinel (None = policy never masks,
+      rendered as a constant 1.0)."""
+    out: typing.Dict[str, jnp.ndarray] = {}
+    out[PREFIX + "nonfinite_grads"] = nonfinite
+    out[PREFIX + "applied"] = (jnp.float32(1.0) if applied is None
+                               else applied.astype(jnp.float32))
+    out[PREFIX + "grad_scale"] = grad_scale.astype(jnp.float32)
+    psq = sum(jnp.sum(jnp.square(p.astype(jnp.float32)))
+              for p in params.values())
+    usq = sum(update_sq.values())
+    pnorm = jnp.sqrt(psq)
+    unorm = jnp.sqrt(usq)
+    out[PREFIX + "param_norm"] = pnorm
+    out[PREFIX + "update_norm"] = unorm
+    out[PREFIX + "update_ratio"] = unorm / jnp.maximum(pnorm, 1e-12)
+    for group in groups:
+        gsq = sum((norm_sq_fn(k, g) for k, g in grads.items() if group in k),
+                  start=jnp.float32(0.0))
+        out[PREFIX + f"grad_norm/{group}"] = jnp.sqrt(gsq)
+    return out
+
+
+# -- host half (metric drain / loop; never traced) ---------------------------
+
+def thin(metrics: typing.Dict[str, typing.Any], update_index: int,
+         interval: int) -> typing.Dict[str, typing.Any]:
+    """Host-side thinning BEFORE the deferred drain: norm-class telemetry
+    keys are dropped from updates off the ``telemetry_interval`` grid (their
+    device values are never transferred), while the sentinels stay on every
+    step — anomaly detection cannot be thinned away.  The device cost is
+    unchanged (the step is compiled once); this bounds metrics.jsonl growth
+    and the drain's D2H bytes."""
+    if interval <= 1 or update_index % interval == 0:
+        return metrics
+    return {k: v for k, v in metrics.items()
+            if not k.startswith(PREFIX) or k in SENTINEL_KEYS}
+
+
+class AnomalyHalt(RuntimeError):
+    """Raised by the drain under ``anomaly_policy="halt"``; main.py converts
+    it into ``SystemExit(EXIT_ANOMALY_HALT)``."""
+
+
+class AnomalyMonitor:
+    """Drain-time consumer of the sentinels (AsyncMetricWriter hook).
+
+    Called with each step's MATERIALIZED metrics — reading them costs
+    nothing extra, the drain just pulled them.  ``skip_step`` skips were
+    already applied in-graph; this side only counts and logs them."""
+
+    def __init__(self, policy: str, registry=None):
+        if policy not in ANOMALY_POLICIES:
+            raise ValueError(f"unknown anomaly_policy {policy!r}; expected "
+                             f"one of {ANOMALY_POLICIES}")
+        from .registry import REGISTRY
+        self.policy = policy
+        reg = REGISTRY if registry is None else registry
+        self._skips = reg.counter(
+            "hbnlp_anomaly_skips_total",
+            "optimizer updates masked (skipped) for non-finite gradients "
+            "under anomaly_policy=skip_step")
+        self.anomaly_steps: typing.List[int] = []
+        self._halted = False
+
+    def observe(self, step: int, host_metrics: typing.Dict[str, typing.Any]
+                ) -> None:
+        nf = host_metrics.get(PREFIX + "nonfinite_grads")
+        if nf is None or self._halted:
+            return
+        if float(nf) == 0:
+            return
+        self.anomaly_steps.append(int(step))
+        if self.policy == "skip_step":
+            self._skips.inc()
+            LOG.warning("non-finite gradients at step %d (%d tensor(s)): "
+                        "update skipped in-graph (anomaly_policy=skip_step)",
+                        step, int(nf))
+        elif self.policy == "halt":
+            # fire once: the writer's exit-path flush must not raise again
+            # and mask the original halt while unwinding
+            self._halted = True
+            raise AnomalyHalt(
+                f"non-finite gradients at step {step} "
+                f"({int(nf)} tensor(s)) under anomaly_policy=halt")
+        else:
+            LOG.warning("non-finite gradients at step %d (%d tensor(s)); "
+                        "update applied as-is (anomaly_policy=log)",
+                        step, int(nf))

@@ -69,6 +69,8 @@ class Health:
         self._paused_for: typing.Optional[str] = None
         self._pause_wall = 0.0
         self._feeder_probe: typing.Optional[typing.Callable[[], bool]] = None
+        self._util_probe: typing.Optional[
+            typing.Callable[[], typing.Dict[str, float]]] = None
 
     def step_completed(self, step: int,
                        dispatch_wall: typing.Optional[float] = None) -> None:
@@ -95,6 +97,15 @@ class Health:
     def set_feeder_probe(self, fn: typing.Callable[[], bool]) -> None:
         with self._lock:
             self._feeder_probe = fn
+
+    def set_utilization_probe(
+            self, fn: typing.Callable[[], typing.Dict[str, float]]) -> None:
+        """Render-time utilization callback (mfu / tokens_per_sec / goodput,
+        wired by ``Obs.watch_utilization``): /healthz carries the same
+        figures a dashboard scrapes from /metrics, so a human curl answers
+        'is it alive AND is it fast' in one request."""
+        with self._lock:
+            self._util_probe = fn
 
     def begin_pause(self, reason: str) -> None:
         """Declare an expected no-steps window (checkpoint save): /healthz
@@ -178,7 +189,7 @@ class Health:
         with self._lock:
             last_step, last_wall = self._last_step, self._last_wall
             ema, done, probe = self._ema_step_s, self._done, self._feeder_probe
-            paused = self._paused_for
+            paused, util_probe = self._paused_for, self._util_probe
         since = None if last_wall is None else time.time() - last_wall
         feeder_alive = None
         if probe is not None:
@@ -194,8 +205,16 @@ class Health:
             status = "starting"  # compiling / restoring: no step yet
         else:
             status = "ok"  # includes a declared pause within max_pause_s
+        utilization = None
+        if util_probe is not None:
+            try:
+                utilization = {k: round(float(v), 6)
+                               for k, v in util_probe().items()}
+            except Exception:
+                utilization = None
         paused_s = self.paused_seconds()
         return {"status": status,
+                "utilization": utilization,
                 "last_completed_step": last_step,
                 "ema_step_seconds": None if ema is None else round(ema, 6),
                 "seconds_since_last_step": (None if since is None

@@ -259,6 +259,15 @@ class MetricsRegistry:
                 if not isinstance(m, cls):
                     raise ValueError(f"{name} already registered as "
                                      f"{m.kind}, not {cls.kind}")
+                if labelnames and m.labelnames != tuple(labelnames):
+                    # a DECLARED label-set mismatch would surface later as a
+                    # baffling labels() error (or silently split one logical
+                    # series); fail at the second registration site instead.
+                    # No labels declared = the getter idiom (fetch by name),
+                    # always allowed.
+                    raise ValueError(
+                        f"{name} already registered with labels "
+                        f"{m.labelnames}, not {tuple(labelnames)}")
                 return m
             m = cls(self, name, help_text, tuple(labelnames), **kw)
             self._metrics[name] = m

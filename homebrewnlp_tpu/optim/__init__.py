@@ -27,7 +27,8 @@ from ..config import PIPE_STAGE, Config
 from ..ops.init import feature_dims_used
 from .multiloss import STRATEGIES
 from .schedule import learning_rate as learning_rate_fn
-from .transforms import VarCtx, apply_chain, chain_slot_shapes, parse_chain
+from .transforms import (VarCtx, apply_chain, chain_slot_shapes, masked,
+                         parse_chain, update_sumsq)
 
 Params = typing.Dict[str, jnp.ndarray]
 OptState = typing.Dict[str, typing.Dict[str, jnp.ndarray]]
@@ -116,10 +117,18 @@ class Optimizer:
 
     # -- update --------------------------------------------------------------
     def update(self, params: Params, grads: Params, state: OptState,
-               step: jnp.ndarray
-               ) -> typing.Tuple[Params, OptState, jnp.ndarray]:
+               step: jnp.ndarray, *,
+               skip: typing.Optional[jnp.ndarray] = None,
+               collect_update_sq: bool = False):
         """One optimizer application.  ``step`` is the 0-indexed global update
-        counter; debiasing uses step+1."""
+        counter; debiasing uses step+1.  Returns ``(new_params, new_state,
+        lr)``, plus a ``{name: squared-update-L2}`` dict when
+        ``collect_update_sq`` (the device-telemetry update-norm tap).
+
+        ``skip`` (traced scalar bool, the skip_step anomaly policy): when
+        true, params AND slots are masked back to their incoming values in
+        their ORIGINAL dtypes — the whole update is a bit-exact no-op, so a
+        NaN gradient can neither move a weight nor poison a moment slot."""
         cfg = self.cfg
         cdtype = cfg.optimizer_calculation_dtype
         lr = learning_rate_fn(cfg, step)
@@ -135,6 +144,7 @@ class Optimizer:
 
         new_params: Params = {}
         new_state: OptState = {}
+        update_sq: typing.Dict[str, jnp.ndarray] = {}
         for name, value in params.items():
             stacked = self._is_stacked(name)
             axis_names = self.axes.get(name, ())
@@ -172,13 +182,25 @@ class Optimizer:
                         jnp.sum(jnp.square(centered)),
                         jnp.asarray(1e-12, cdtype)))
                     new = centered * (norm / cnorm)
-                return new.astype(value.dtype), {
-                    k: v.astype(cfg.optimizer_slice_dtype)
-                    for k, v in slots.items()}
+                new_value = new.astype(value.dtype)
+                new_slots = {k: v.astype(cfg.optimizer_slice_dtype)
+                             for k, v in slots.items()}
+                if skip is not None:
+                    new_value = masked(skip, value, new_value)
+                    new_slots = {k: masked(skip, raw_slots[k], v)
+                                 for k, v in new_slots.items()}
+                if not collect_update_sq:
+                    return new_value, new_slots
+                return new_value, new_slots, update_sumsq(value, new_value)
 
             fn = jax.vmap(one) if stacked else one
-            new_params[name], new_state[name] = fn(
-                value, grads[name], state[name])
+            result = fn(value, grads[name], state[name])
+            new_params[name], new_state[name] = result[0], result[1]
+            if collect_update_sq:
+                # stacked pipeline variables return a per-stage [P] vector
+                update_sq[name] = jnp.sum(result[2])
+        if collect_update_sq:
+            return new_params, new_state, lr, update_sq
         return new_params, new_state, lr
 
     # -- multi-loss ----------------------------------------------------------

@@ -47,6 +47,31 @@ def _sumsq(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(jnp.square(x))
 
 
+def all_finite(x: jnp.ndarray) -> jnp.ndarray:
+    """Scalar bool: every entry finite (the NaN/Inf sentinel primitive the
+    device-telemetry layer reduces over the whole gradient tree)."""
+    return jnp.isfinite(x).all()
+
+
+def masked(skip: jnp.ndarray, old: jnp.ndarray, new: jnp.ndarray
+           ) -> jnp.ndarray:
+    """``where(skip, old, new)`` in ``old``'s dtype — the skip_step anomaly
+    policy's update mask.  Applied to params AND slots in the ORIGINAL
+    storage dtype (never the calculation dtype): a skipped step must be a
+    bit-exact no-op, and a f32->bf16->f32 round-trip would silently perturb
+    the very state the skip is protecting."""
+    return jnp.where(skip, old, new.astype(old.dtype))
+
+
+def update_sumsq(old_value: jnp.ndarray, new_value: jnp.ndarray
+                 ) -> jnp.ndarray:
+    """Squared L2 of the APPLIED update (f32 accumulate), measured on the
+    stored values so it reflects exactly what changed — zero for a masked
+    skip_step update, standardisation/decay/rezero effects included."""
+    diff = old_value.astype(jnp.float32) - new_value.astype(jnp.float32)
+    return jnp.sum(jnp.square(diff))
+
+
 # -- stateful optimizers -----------------------------------------------------
 
 def adam_slots(shape: typing.Sequence[int]) -> typing.Dict[str, tuple]:

@@ -40,6 +40,11 @@ EXIT_PREEMPTED = 83
 EXIT_GRACE_TIMEOUT = 84
 #: the supervisor aborted: K consecutive exits with no step progress
 EXIT_CRASH_LOOP = 85
+#: device telemetry detected non-finite gradients under
+#: ``anomaly_policy="halt"`` (docs/observability.md): the run exited before
+#: cutting a potentially-poisoned final checkpoint — a supervisor treats it
+#: as a crash (relaunch with backoff, resuming from the last good checkpoint)
+EXIT_ANOMALY_HALT = 86
 
 
 class GraceController:
@@ -118,16 +123,19 @@ class CorruptRecordBudget:
     paper over.  Shared across one pipeline's files (thread-safe: the
     prefetcher thread reads through it)."""
 
-    def __init__(self, limit: int, registry=None):
+    def __init__(self, limit: int, registry=None, pipeline: str = "text"):
         from ..obs.registry import REGISTRY
         self.limit = int(limit)
         self.spent = 0
         self._lock = threading.Lock()
         reg = REGISTRY if registry is None else registry
+        # labelled by pipeline so dashboards can tell a rotting text corpus
+        # from a rotting frame store (the video decoder spends the budget on
+        # undecodable JPEGs, data/video.py)
         self._counter = reg.counter(
             "hbnlp_corrupt_records_total",
             "unreadable data records/shards skipped under the corrupt "
-            "budget")
+            "budget", labelnames=("pipeline",)).labels(pipeline=pipeline)
 
     def spend(self, what: str, exc: BaseException) -> None:
         """Account one unreadable record/shard; raises when over budget."""

@@ -21,7 +21,11 @@ naturally).  Each rule fires **once**.  Sites instrumented today:
 - ``ckpt_write``  — per checkpoint commit attempt (before the orbax write)
 - ``ckpt_commit`` — after a successful commit (``path`` = the step dir)
 - ``feeder``      — per batch in the DeviceFeeder producer thread
-- ``data_read``   — per record pulled from a TFRecord shard
+- ``data_read``   — per record pulled from a TFRecord shard (text AND video
+                    pipelines)
+- ``grads``       — per update, polled by the train loop via :func:`take`
+                    (trigger matches the global step counter); the loop
+                    implements the action itself
 
 Actions:
 
@@ -32,6 +36,11 @@ Actions:
 - ``sigterm`` / ``sigint`` — deliver the signal to this process (preemption)
 - ``corrupt`` — bit-flip the largest file under the site's ``path`` kwarg
                 (``ckpt_commit:corrupt@1`` tears the freshest checkpoint)
+- ``nan``     — caller-implemented (``take`` sites only): the train loop
+                feeds a NaN gradient scale into the step so the device-
+                telemetry anomaly path is drillable (``grads:nan@step3``)
+                without permanently poisoning parameters; requires
+                ``telemetry_interval > 0``
 
 Example: ``fault_plan="ckpt_write:fail@2;feeder:die@step10;sigterm@step25"``
 fails the 2nd checkpoint write once (retried), kills the feeder thread at
@@ -48,7 +57,7 @@ import typing
 
 LOG = logging.getLogger("homebrewnlp_tpu.reliability.faults")
 
-ACTIONS = ("fail", "die", "sigterm", "sigint", "corrupt")
+ACTIONS = ("fail", "die", "sigterm", "sigint", "corrupt", "nan")
 #: bare actions (no explicit site) ride the train-step site
 DEFAULT_SITE = "step"
 
@@ -165,6 +174,25 @@ class FaultPlan:
         for r in due:
             self._execute(r, path)
 
+    def take(self, site: str, value: typing.Optional[int] = None
+             ) -> typing.List[str]:
+        """Pop the due rules of ``site`` and return their actions WITHOUT
+        executing anything — for caller-implemented actions (``nan``) where
+        the site itself is the injection mechanism.  Same trigger semantics
+        as :meth:`hit` (``value`` pins to an external counter; one-shot)."""
+        if not self.rules:
+            return []
+        with self._lock:
+            if value is None:
+                value = self._counts[site] = self._counts.get(site, 0) + 1
+            due = [r for r in self.rules
+                   if r.site == site and not r.fired and r.at == value]
+            for r in due:
+                r.fired = True
+        for r in due:
+            LOG.warning("fault injection: %s taken by caller", r)
+        return [r.action for r in due]
+
     def disarm_until(self, site: str, value: int) -> None:
         """Mark ``site`` rules with triggers <= ``value`` as already fired.
 
@@ -183,6 +211,12 @@ class FaultPlan:
 
     def _execute(self, rule: FaultRule, path: typing.Optional[str]) -> None:
         LOG.warning("fault injection: firing %s", rule)
+        if rule.action == "nan":
+            # caller-implemented action reached through hit() instead of
+            # take(): nothing to execute here
+            LOG.error("rule %s: 'nan' is caller-implemented (take()); "
+                      "ignored at a hit() site", rule)
+            return
         if rule.action == "fail":
             raise FaultInjectedIOError(f"injected storage failure ({rule})")
         if rule.action == "die":
@@ -232,6 +266,11 @@ def hit(site: str, value: typing.Optional[int] = None,
         path: typing.Optional[str] = None) -> None:
     """Module-level convenience over the installed plan (no-op when inert)."""
     _PLAN.hit(site, value=value, path=path)
+
+
+def take(site: str, value: typing.Optional[int] = None) -> typing.List[str]:
+    """Module-level convenience over the installed plan ([] when inert)."""
+    return _PLAN.take(site, value=value)
 
 
 def disarm_until(site: str, value: int) -> None:

@@ -1,0 +1,96 @@
+"""Static utilization accounting: per-step FLOPs from the compiled HLO.
+
+The reference framework never knew what a step *cost* — utilization was a
+number someone computed by hand from the model card.  bench.py grew an XLA
+cost-analysis path (EXECUTED flops of the exact compiled step) for its
+offline BENCH line; this module makes that same accounting available to the
+*live* run, so ``train/metrics.py`` rows, the ``/metrics`` exporter, and
+``metrics.jsonl`` carry MFU / tokens-per-second / goodput continuously
+instead of once per benchmark session.
+
+One source of truth: ``PEAK_BF16`` (per-chip peak dense bf16 FLOP/s by
+``device_kind``) and the cost-analysis call both live here and bench.py
+imports them, so the two MFU figures cannot drift — they are the same
+arithmetic over the same compiled executable (pinned by
+tests/telemetry_test.py::test_flops_reconcile_with_bench_cost_analysis).
+
+Everything here is HOST-side and runs once at startup (the cost analysis
+rides the step compile the run pays anyway, via
+``Trainer.step_cost_analysis``'s kept AOT executable); nothing touches the
+per-step hot path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+#: Peak dense bf16 FLOP/s per chip, by device_kind substring (public specs).
+#: Order matters: more specific substrings first ("v5 lite" before "v5").
+PEAK_BF16: typing.Tuple[typing.Tuple[str, float], ...] = (
+    ("v6", 918e12), ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops(device_kind: str) -> typing.Optional[float]:
+    """Per-chip peak bf16 FLOP/s, or None for CPU/unknown (no MFU claim)."""
+    kind = device_kind.lower()
+    for sub, peak in PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
+
+
+def step_flops(trainer, state, batch) -> float:
+    """EXECUTED flops of the exact compiled train step (XLA cost analysis,
+    same figure bench.py records as ``flops_per_step``).  The AOT executable
+    is kept by the trainer, so the analysis costs no extra compile and the
+    loop's subsequent steps reuse it."""
+    cost = trainer.step_cost_analysis(state, batch)
+    return float(cost.get("flops", 0.0))
+
+
+@dataclasses.dataclass
+class Utilization:
+    """Static per-step accounting; ``rates(step_seconds)`` turns a measured
+    step wall time into the live MFU / throughput figures."""
+
+    flops_per_step: float
+    tokens_per_step: int
+    n_chips: int
+    peak_flops_per_chip: typing.Optional[float]
+    device_kind: str = ""
+
+    def rates(self, step_seconds: float) -> typing.Dict[str, float]:
+        if not step_seconds or step_seconds <= 0:
+            return {}
+        out = {
+            "tokens_per_sec": self.tokens_per_step / step_seconds,
+            "tokens_per_sec_per_chip": (self.tokens_per_step / step_seconds
+                                        / max(1, self.n_chips)),
+        }
+        if self.peak_flops_per_chip and self.flops_per_step:
+            out["mfu"] = (self.flops_per_step / step_seconds
+                          / (self.peak_flops_per_chip * max(1, self.n_chips)))
+        return out
+
+
+def utilization_for(trainer, state, batch, tokens_per_step: int
+                    ) -> Utilization:
+    """Build the static accounting for one run: cost-analyze the compiled
+    step and pin the device peak.  Called once at startup when telemetry is
+    enabled (main.py)."""
+    import jax
+    devices = jax.devices()
+    kind = devices[0].device_kind
+    return Utilization(
+        flops_per_step=step_flops(trainer, state, batch),
+        tokens_per_step=int(tokens_per_step),
+        n_chips=max(1, len(devices)),
+        peak_flops_per_chip=peak_flops(kind),
+        device_kind=kind)

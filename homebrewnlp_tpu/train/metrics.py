@@ -66,12 +66,43 @@ class MetricWriter:
         self._n = 0
         self._t0 = time.time()
         self._last_step_time = self._t0
+        # utilization accounting (train/flops.py, set via set_utilization):
+        # per-row mfu/tokens_per_sec derived from step_seconds, plus run
+        # goodput = productive step seconds / wall seconds since run start
+        self._util = None
+        self._rows_in_run = 0
+        self._productive_s = 0.0
+        self.last_rates: typing.Dict[str, float] = {}
         self._tb = None
         try:  # optional TensorBoard backend
             from torch.utils.tensorboard import SummaryWriter  # noqa
             self._tb = SummaryWriter(os.path.join(model_path, "tb"))
         except Exception:
             pass
+
+    def set_utilization(self, util, run_start: typing.Optional[float] = None
+                        ) -> None:
+        """Arm the live MFU/goodput accounting (a ``train.flops.Utilization``):
+        every subsequent metric row carries ``mfu`` / ``tokens_per_sec`` /
+        ``goodput`` derived from its own ``step_seconds``.
+
+        ``run_start``: wall origin of the goodput denominator.  The caller
+        passes the loop's TRUE entry time — this writer is constructed
+        AFTER init/restore/compile, and a goodput that excluded exactly the
+        overhead it exists to expose would read ~1.0 on a compile-dominated
+        run."""
+        self._util = util
+        if run_start is not None and self._n == 0:
+            self._t0 = float(run_start)
+            self._last_step_time = self._t0
+
+    def goodput(self) -> float:
+        """Useful-step seconds / wall seconds since this writer (run)
+        started.  The first row of each run is excluded from the productive
+        numerator — its ``step_seconds`` spans compile + init, exactly the
+        overhead goodput exists to expose."""
+        wall = time.time() - self._t0
+        return self._productive_s / wall if wall > 0 else 0.0
 
     def write_run_start(self, resume_step: int, cfg_hash: str) -> None:
         """Run boundary marker: ``metrics.jsonl`` appends across restarts, so
@@ -82,6 +113,7 @@ class MetricWriter:
         self._f.write(json.dumps({
             "run_start": True, "resume_step": int(resume_step),
             "config_hash": cfg_hash, "wall_time": time.time()}) + "\n")
+        self._rows_in_run = 0
         self.flush()
 
     def write(self, step: int, metrics: typing.Dict[str, typing.Any],
@@ -108,6 +140,16 @@ class MetricWriter:
         scalars["wall_time"] = now
         scalars["step_seconds"] = now - self._last_step_time
         self._last_step_time = now
+        if self._util is not None:
+            self._rows_in_run += 1
+            if self._rows_in_run > 1:
+                # the run's first step_seconds spans compile/init/restore —
+                # not a training cadence; it stays out of both the rates and
+                # the productive-time numerator
+                self._productive_s += max(0.0, scalars["step_seconds"])
+                self.last_rates = self._util.rates(scalars["step_seconds"])
+                scalars.update(self.last_rates)
+            scalars["goodput"] = round(self.goodput(), 6)
         self._f.write(json.dumps(scalars) + "\n")
         self._n += 1
         if self._n % self.flush_every == 0:
@@ -165,13 +207,19 @@ class AsyncMetricWriter:
     """
 
     def __init__(self, writer: MetricWriter, window: int = 2,
-                 health=None, registry=None):
+                 health=None, registry=None, anomaly=None):
         """``health``/``registry`` (optional, docs/observability.md): each
         drained step reports to ``Health.step_completed`` (the /healthz +
         watchdog notion of progress — a step counts once its metrics
-        materialized) and a drain-latency histogram."""
+        materialized) and a drain-latency histogram.  ``anomaly`` (an
+        ``obs.device_telemetry.AnomalyMonitor``) consumes each drained
+        step's telemetry sentinels — counting skip_step skips, raising
+        ``AnomalyHalt`` under the halt policy — AFTER the row is written,
+        so the anomalous step itself is always in metrics.jsonl for the
+        post-mortem."""
         self.writer = writer
         self.window = max(0, int(window))
+        self._anomaly = anomaly
         self._pending: typing.Deque[typing.Tuple[int, float, dict]] = \
             collections.deque()
         self.last_loss: typing.Optional[float] = None
@@ -183,6 +231,17 @@ class AsyncMetricWriter:
 
     def write_run_start(self, resume_step: int, cfg_hash: str) -> None:
         self.writer.write_run_start(resume_step, cfg_hash)
+
+    def set_utilization(self, util,
+                        run_start: typing.Optional[float] = None) -> None:
+        self.writer.set_utilization(util, run_start=run_start)
+
+    def goodput(self) -> float:
+        return self.writer.goodput()
+
+    @property
+    def last_rates(self) -> typing.Dict[str, float]:
+        return self.writer.last_rates
 
     def write(self, step: int, metrics: typing.Dict[str, typing.Any]) -> None:
         self._pending.append((step, time.time(), metrics))
@@ -211,6 +270,9 @@ class AsyncMetricWriter:
         if loss is not None and getattr(loss, "size", 0) == 1:
             self.last_loss = float(loss)
         self.writer.write(step, host, wall_time=wall)
+        if self._anomaly is not None:
+            # after the write: a halt must not lose the anomalous row
+            self._anomaly.observe(step, host)
 
     def flush(self) -> None:
         while self._pending:

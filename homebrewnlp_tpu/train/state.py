@@ -13,9 +13,11 @@ import typing
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import Config
+from ..obs import device_telemetry
 from ..models import build, init_params
 from ..models.ctx import Ctx
 from ..nd import NT
@@ -153,8 +155,15 @@ class Trainer:
                 m["accuracy"] = o.accuracy
             return m
 
+        # device telemetry (obs/device_telemetry.py): in-graph numerics and
+        # the skip_step update mask.  With the knob off the step compiles
+        # WITHOUT the grad_scale input or any telemetry op — the pre-existing
+        # graph, bit-identical (the sync-parity goldens pin this).
+        telemetry = cfg.telemetry_interval > 0
+        skip_on_nonfinite = telemetry and cfg.anomaly_policy == "skip_step"
+
         def step_fn(state: TrainState, batch: typing.Dict[str, NT],
-                    rng: jax.Array):
+                    rng: jax.Array, grad_scale: jax.Array = None):
             batch = {k: constraint(t, mesh) for k, t in batch.items()}
             metrics = {}
             if accum <= 1:
@@ -198,8 +207,6 @@ class Trainer:
                 # keeps the replicas bit-synced (models.stack_pipeline_params)
                 from ..models import sync_shared_pipeline_grads
                 grads = sync_shared_pipeline_grads(cfg, grads, self.axes)
-            new_params, new_opt, lr = opt.update(
-                state.params, grads, state.opt_state, state.step)
 
             def norm_sq(name, g):
                 """Stage-replicated shared tensors hold the SAME summed grad
@@ -211,6 +218,27 @@ class Trainer:
                 if ("/shared_" in name and tuple(ax)[:1] == (PIPE_STAGE,)):
                     s = s / g.shape[0]
                 return s
+
+            if telemetry:
+                # grad_scale rides the fully-formed gradients (post
+                # accumulation/sync, pre optimizer): 1.0 in steady state
+                # (exact in IEEE — values unchanged), NaN under the
+                # "grads:nan@stepN" fault site so the anomaly path is
+                # drillable without wrecking params
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * grad_scale.astype(g.dtype), grads)
+                grads_ok, nonfinite = device_telemetry.grads_finite(grads)
+                skip = (~grads_ok) if skip_on_nonfinite else None
+                new_params, new_opt, lr, upd_sq = opt.update(
+                    state.params, grads, state.opt_state, state.step,
+                    skip=skip, collect_update_sq=True)
+                metrics.update(device_telemetry.collect(
+                    state.params, grads, upd_sq, grad_scale, nonfinite,
+                    applied=(grads_ok if skip_on_nonfinite else None),
+                    norm_sq_fn=norm_sq, groups=cfg.telemetry_groups))
+            else:
+                new_params, new_opt, lr = opt.update(
+                    state.params, grads, state.opt_state, state.step)
 
             gnorm = jnp.sqrt(sum(norm_sq(k, g) for k, g in grads.items()))
             # no "step" entry: the loop computes step indices on host
@@ -241,33 +269,50 @@ class Trainer:
 
         return jax.jit(step_fn, donate_argnums=(0,))
 
+    def step_extra_args(self, grad_scale: typing.Optional[float] = None
+                        ) -> typing.Tuple:
+        """Trailing step-function arguments beyond (state, batch, rng): the
+        telemetry gradient scale when device telemetry is enabled, else
+        nothing — so every caller (loop / bench / cost analysis / abstract
+        trace) stays signature-compatible with both compiles.  A host
+        ``np.float32`` (not a Python float): jit must treat it as a TRACED
+        input, or the one NaN-injection step would trigger a recompile."""
+        if self.cfg.telemetry_interval <= 0:
+            if grad_scale is not None:
+                raise ValueError("grad_scale requires telemetry_interval > 0")
+            return ()
+        return (np.float32(1.0 if grad_scale is None else grad_scale),)
+
     def step(self, state: TrainState, batch: typing.Dict[str, NT],
-             rng: jax.Array):
+             rng: jax.Array, grad_scale: typing.Optional[float] = None):
         if self._step_fn is None:
             self._step_fn = self._make_step()
+        args = (state, batch, rng) + self.step_extra_args(grad_scale)
         if self._compiled is not None:
             # AOT executable from step_cost_analysis (jit's dispatch cache is
             # separate, so calling the jit fn would compile a second time)
             try:
-                return self._compiled(state, batch, rng)
+                return self._compiled(*args)
             except (TypeError, ValueError):
                 # shapes/dtypes/shardings changed since the AOT compile —
                 # the exact exception type varies by jax version
                 self._compiled = None
         with self.mesh:
-            return self._step_fn(state, batch, rng)
+            return self._step_fn(*args)
 
     def step_cost_analysis(self, state: TrainState,
                            batch: typing.Dict[str, NT]
                            ) -> typing.Dict[str, float]:
         """XLA cost analysis (flops, bytes accessed) of the compiled train
         step.  The compiled executable is kept and reused by ``step`` so the
-        analysis does not cost a second compilation (bench.py)."""
+        analysis does not cost a second compilation (bench.py, and the live
+        MFU accounting in train/flops.py)."""
         if self._step_fn is None:
             self._step_fn = self._make_step()
         with self.mesh:
             self._compiled = self._step_fn.lower(
-                state, batch, jax.random.key(0)).compile()
+                state, batch, jax.random.key(0),
+                *self.step_extra_args()).compile()
         cost = self._compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax returns per-device list
             cost = cost[0] if cost else {}

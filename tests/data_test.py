@@ -237,6 +237,96 @@ def test_video_pipeline_exact_resume(tmp_path):
             np.testing.assert_array_equal(got[k], want[k], err_msg=k)
 
 
+def _write_video_shard_with_bad_frame(tmp_path, cfg, n_frames, bad_index):
+    """One video shard where frame ``bad_index`` carries undecodable JPEG
+    bytes (valid Example framing, garbage payload)."""
+    import cv2
+    rng = np.random.default_rng(5)
+    path = str(tmp_path / "video0000.tfrecord")
+    with RecordWriter(path) as w:
+        for j in range(n_frames):
+            if j == bad_index:
+                frame_bytes = b"\xff\xd8 definitely not a jpeg"
+            else:
+                img = rng.integers(0, 256, (cfg.frame_height, cfg.frame_width,
+                                            cfg.color_channels), np.uint8)
+                ok, enc = cv2.imencode(".jpg", img)
+                assert ok
+                frame_bytes = enc.tobytes()
+            w.write(encode_example({"frame": frame_bytes,
+                                    "concat": [int(j == 0)],
+                                    "skip_frame": [0]}))
+    return [path]
+
+
+def test_video_corrupt_budget_skips_frame_and_counts(tmp_path):
+    """ISSUE satellite (ROADMAP reliability item): a per-frame decode error
+    under corrupt_record_budget becomes a SKIPPED frame (zero payload,
+    vid masks False — the shape the model already handles), counted on
+    hbnlp_corrupt_records_total{pipeline="video"}; alignment and batch
+    count are unaffected."""
+    pytest.importorskip("cv2")
+    from homebrewnlp_tpu.data.video import VideoPipeline
+    from homebrewnlp_tpu.obs.registry import REGISTRY
+    cfg = mixer_config(model_mode="jannet", use_video=True, use_language=False,
+                       frame_height=32, frame_width=32, patch_size=16,
+                       sequence_length=4, experts=1, corrupt_record_budget=3)
+    paths = _write_video_shard_with_bad_frame(tmp_path, cfg, 12, bad_index=6)
+    counter = REGISTRY.counter("hbnlp_corrupt_records_total",
+                               labelnames=("pipeline",))
+    before = counter.value(pipeline="video")
+    pipe = VideoPipeline(cfg, sub_batch_size=2, paths=paths)
+    it = iter(pipe)
+    batch = next(it)
+    assert counter.value(pipeline="video") == before + 1
+    assert pipe.budget is not None and pipe.budget.spent == 1
+    # windows 0 and 1 cover frames 0..4 and 4..8: the bad frame (6) lands in
+    # window 1 at position 2, masked exactly like a real skip-frame
+    assert batch["frame"].shape[0] == 2
+    assert not batch["vid_msk_src"][1].all()
+    assert batch["vid_msk_src"][0].all()
+    # the substituted frame is all-zero payload
+    assert (batch["frame"][1][2] == 0).all()
+
+
+def test_video_strict_without_budget_raises(tmp_path):
+    pytest.importorskip("cv2")
+    from homebrewnlp_tpu.data.video import VideoPipeline
+    cfg = mixer_config(model_mode="jannet", use_video=True, use_language=False,
+                       frame_height=32, frame_width=32, patch_size=16,
+                       sequence_length=4, experts=1, corrupt_record_budget=0)
+    paths = _write_video_shard_with_bad_frame(tmp_path, cfg, 12, bad_index=2)
+    with pytest.raises(ValueError, match="undecodable"):
+        next(iter(VideoPipeline(cfg, sub_batch_size=2, paths=paths)))
+
+
+def test_video_budget_exhaustion_raises(tmp_path):
+    """A rotting shard (more bad frames than budget) must surface, not be
+    papered over."""
+    pytest.importorskip("cv2")
+    import cv2
+    from homebrewnlp_tpu.data.video import VideoPipeline
+    cfg = mixer_config(model_mode="jannet", use_video=True, use_language=False,
+                       frame_height=32, frame_width=32, patch_size=16,
+                       sequence_length=4, experts=1, corrupt_record_budget=1)
+    rng = np.random.default_rng(5)
+    path = str(tmp_path / "video0000.tfrecord")
+    with RecordWriter(path) as w:
+        for j in range(12):
+            if j in (3, 4):
+                frame_bytes = b"garbage"
+            else:
+                ok, enc = cv2.imencode(".jpg", rng.integers(
+                    0, 256, (cfg.frame_height, cfg.frame_width,
+                             cfg.color_channels), np.uint8))
+                frame_bytes = enc.tobytes()
+            w.write(encode_example({"frame": frame_bytes,
+                                    "concat": [int(j == 0)],
+                                    "skip_frame": [0]}))
+    with pytest.raises(OSError, match="budget exhausted"):
+        list(VideoPipeline(cfg, sub_batch_size=2, paths=[path]))
+
+
 def test_video_parallel_decode_matches_serial(tmp_path):
     cv2 = pytest.importorskip("cv2")
     from homebrewnlp_tpu.data import write_video_tfrecords

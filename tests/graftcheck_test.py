@@ -444,10 +444,69 @@ def test_ast_obs_in_trace_suppression_and_host_code_free(tmp_path,
 
 
 def test_ast_obs_in_trace_repo_is_clean():
-    """The shipped traced code (models/ops/infer/optim) carries ZERO obs
-    calls; the committed golden pins the empty count."""
+    """The shipped traced code (models/ops/infer/optim/train-step) carries
+    ZERO forbidden obs calls; the committed golden pins the empty count.
+    train/state.py is IN scope and imports the allowlisted device_telemetry
+    — proof the allowlist admits exactly that module and nothing else."""
     assert ast_rules.obs_in_trace_counts(REPO) == {}
     assert json.load(open(ast_rules.obs_in_trace_golden_path())) == {}
+    state_src = open(os.path.join(
+        REPO, "homebrewnlp_tpu", "train", "state.py")).read()
+    assert "device_telemetry" in state_src  # the allowlist is exercised
+
+
+def test_ast_obs_in_trace_device_telemetry_allowlist(tmp_path):
+    """ISSUE satellite: device_telemetry is the ONE obs module legal in
+    traced code — every import style of it passes, while spans/registry use
+    in the same files still fires."""
+    root = _mini_tree(tmp_path, models_src=(
+        "from ..obs import device_telemetry\n"
+        "from ..obs.device_telemetry import collect\n"
+        "import homebrewnlp_tpu.obs.device_telemetry as dt\n"
+        "def layer(g):\n"
+        "    ok, nf = device_telemetry.grads_finite(g)\n"   # allowed
+        "    c = collect(g, g, {}, 1.0, nf, ok, None)\n"    # allowed
+        "    return dt.thin(c, 0, 1)\n"), ops_src=(         # allowed
+        "import homebrewnlp_tpu.obs.device_telemetry\n"
+        "def kernel(g):\n"
+        "    return homebrewnlp_tpu.obs.device_telemetry.grads_finite(g)\n"))
+    assert ast_rules.obs_in_trace_counts(root) == {}
+    # the allowlist must not leak: spans use NEXT TO a device_telemetry
+    # import in the same file still counts
+    root = _mini_tree(tmp_path / "mixed", models_src=(
+        "from ..obs import device_telemetry\n"
+        "from ..obs import spans\n"
+        "def layer(g):\n"
+        "    with spans.span('bad'):\n"                      # forbidden
+        "        return device_telemetry.grads_finite(g)\n"))  # allowed
+    counts = ast_rules.obs_in_trace_counts(root)
+    assert counts == {"homebrewnlp_tpu/models/m.py": 1}, counts
+
+
+def test_ast_obs_in_trace_allowlist_cannot_shield_siblings(tmp_path):
+    """Review regression: a bare dotted import of the ALLOWLISTED module
+    must not whitelist a sibling obs call through the same root — the
+    chain filter decides per call site."""
+    root = _mini_tree(tmp_path, models_src=(
+        "import homebrewnlp_tpu.obs.device_telemetry\n"
+        "def layer(g):\n"
+        "    homebrewnlp_tpu.obs.spans.span('bad')\n"              # counts
+        "    return homebrewnlp_tpu.obs.device_telemetry.thin(g, 0, 1)\n"))
+    counts = ast_rules.obs_in_trace_counts(root)
+    assert counts == {"homebrewnlp_tpu/models/m.py": 1}, counts
+
+
+def test_ast_obs_in_trace_train_state_in_scope(tmp_path):
+    """train/state.py joined the traced scope: a registry call seeded there
+    fails the ratchet (the step function it builds IS traced code)."""
+    root = _mini_tree(tmp_path)
+    p = tmp_path / "homebrewnlp_tpu/train/state.py"
+    p.write_text("from ..obs.registry import REGISTRY\n"
+                 "def step_fn(s):\n"
+                 "    REGISTRY.counter('bad_total').inc()\n"
+                 "    return s\n")
+    counts = ast_rules.obs_in_trace_counts(root)
+    assert counts == {"homebrewnlp_tpu/train/state.py": 2}, counts
 
 
 def test_ast_rules_clean_on_repo():

@@ -540,6 +540,7 @@ def test_supervisor_exit_code_contract_and_no_jax():
     assert supervise.EXIT_PREEMPTED == rel.EXIT_PREEMPTED
     assert supervise.EXIT_GRACE_TIMEOUT == rel.EXIT_GRACE_TIMEOUT
     assert supervise.EXIT_CRASH_LOOP == rel.EXIT_CRASH_LOOP
+    assert supervise.EXIT_ANOMALY_HALT == rel.EXIT_ANOMALY_HALT
     import subprocess
     out = subprocess.run(
         [sys.executable, "-c",

@@ -17,10 +17,13 @@ itself safe, so this closes the loop:
 - progress resets both the failure count and the backoff.
 
 Counters flow through the obs registry
-(``hbnlp_supervisor_exits_total{outcome}``), rendered to
-``<model_path>/supervisor_metrics.prom`` on exit and served live on
-``--obs-port`` if given.  Exit-code contract + drill walkthrough:
-docs/reliability.md.
+(``hbnlp_supervisor_exits_total{outcome}``) along with cross-relaunch
+goodput (``hbnlp_supervisor_goodput`` = productive seconds / wall seconds,
+where only launch segments that advanced on-disk progress count as
+productive), rendered to ``<model_path>/supervisor_metrics.prom`` after
+every child exit and served live on ``--obs-port`` if given — so restarts
+land in the same dashboard as the child's MFU.  Exit-code contract + drill
+walkthrough: docs/reliability.md.
 
 Usage:
   python tools/supervise.py --model-path runs/flagship -- \\
@@ -65,6 +68,10 @@ REGISTRY = _registry.REGISTRY
 EXIT_PREEMPTED = 83
 EXIT_GRACE_TIMEOUT = 84
 EXIT_CRASH_LOOP = 85
+# device telemetry halted on non-finite gradients (anomaly_policy="halt",
+# docs/observability.md): crash semantics — relaunch with backoff so the
+# child resumes from its last good checkpoint, but a distinct outcome label
+EXIT_ANOMALY_HALT = 86
 
 LOG = logging.getLogger("homebrewnlp_tpu.supervise")
 
@@ -111,7 +118,9 @@ class Supervisor:
                  backoff_base_s: float = 1.0, backoff_max_s: float = 60.0,
                  max_restarts: int = 0,
                  sleep: typing.Callable[[float], None] = time.sleep,
-                 registry: typing.Optional[MetricsRegistry] = None):
+                 registry: typing.Optional[MetricsRegistry] = None,
+                 metrics_path: typing.Optional[str] = None,
+                 clock: typing.Callable[[], float] = time.monotonic):
         self.launch = launch
         self.progress = progress
         self.max_failures_no_progress = int(max_failures_no_progress)
@@ -120,29 +129,79 @@ class Supervisor:
         self.max_restarts = int(max_restarts)  # 0 = unlimited
         self.sleep = sleep
         self.registry = registry if registry is not None else REGISTRY
+        self.metrics_path = metrics_path
+        self.clock = clock
         self._exits = self.registry.counter(
             "hbnlp_supervisor_exits_total",
             "child exits seen by the supervisor, by outcome",
             labelnames=("outcome",))
+        # goodput across relaunches (the in-run figure lives on the child's
+        # own /metrics): wall covers backoff sleeps and dead children;
+        # productive covers only launch segments that ADVANCED on-disk
+        # progress — a restart loop reads as goodput -> 0 on the same
+        # dashboard that shows the child's MFU
+        self._t0 = self.clock()
+        self._productive_s = 0.0
+        self.registry.gauge(
+            "hbnlp_supervisor_wall_seconds",
+            "wall seconds since the supervisor started",
+            fn=lambda: self.clock() - self._t0)
+        self.registry.gauge(
+            "hbnlp_supervisor_productive_seconds",
+            "wall seconds inside launch segments that advanced on-disk "
+            "progress", fn=lambda: self._productive_s)
+        self.registry.gauge(
+            "hbnlp_supervisor_goodput",
+            "productive seconds / wall seconds across all relaunches",
+            fn=self.goodput)
         self.restarts = 0
+
+    def goodput(self) -> float:
+        wall = self.clock() - self._t0
+        return self._productive_s / wall if wall > 0 else 0.0
+
+    def write_metrics(self) -> None:
+        """Render the supervisor's registry to ``metrics_path`` (after every
+        child exit and on return): restarts and goodput stay visible in the
+        same dashboard as the child's MFU even between scrapes."""
+        if not self.metrics_path:
+            return
+        try:
+            os.makedirs(os.path.dirname(self.metrics_path) or ".",
+                        exist_ok=True)
+            with open(self.metrics_path, "w") as f:
+                f.write(self.registry.render())
+        except OSError as e:
+            LOG.warning("could not persist supervisor metrics: %r", e)
 
     def run(self) -> int:
         failures_no_progress = 0
         backoff = self.backoff_base_s
         last = self.progress()
         while True:
+            t_launch = self.clock()
             rc = self.launch()
+            segment_s = self.clock() - t_launch
             now = self.progress()
             advanced = now > last
             last = max(last, now)
+            if advanced:
+                self._productive_s += segment_s
             if rc == 0:
                 LOG.info("training completed cleanly at step %d "
-                         "(%d restart(s))", last, self.restarts)
+                         "(%d restart(s), goodput %.3f)", last,
+                         self.restarts, self.goodput())
                 self._exits.labels(outcome="clean").inc()
+                self.write_metrics()
                 return 0
             preempted = rc == EXIT_PREEMPTED
-            self._exits.labels(
-                outcome="preemption" if preempted else "crash").inc()
+            outcome = ("preemption" if preempted else
+                       "anomaly_halt" if rc == EXIT_ANOMALY_HALT else
+                       "crash")
+            self._exits.labels(outcome=outcome).inc()
+            # render AFTER the outcome counter: the on-disk file must show
+            # this exit during the (possibly long) next child lifetime
+            self.write_metrics()
             if advanced:
                 failures_no_progress = 0
                 backoff = self.backoff_base_s
@@ -155,6 +214,7 @@ class Supervisor:
                         "aborting with %d", failures_no_progress, last, rc,
                         EXIT_CRASH_LOOP)
                     self._exits.labels(outcome="crash_loop_abort").inc()
+                    self.write_metrics()
                     return EXIT_CRASH_LOOP
             self.restarts += 1
             if self.max_restarts and self.restarts > self.max_restarts:
@@ -216,7 +276,9 @@ def main(argv=None) -> int:
         lambda: last_step_progress(args.model_path),
         max_failures_no_progress=args.max_failures_no_progress,
         backoff_base_s=args.backoff_base, backoff_max_s=args.backoff_max,
-        max_restarts=args.max_restarts)
+        max_restarts=args.max_restarts,
+        metrics_path=os.path.join(args.model_path,
+                                  "supervisor_metrics.prom"))
     server = None
     if args.obs_port:
         # the exporter import pulls the full package (and jax); degrade to
@@ -230,13 +292,7 @@ def main(argv=None) -> int:
     try:
         return sup.run()
     finally:
-        try:
-            os.makedirs(args.model_path, exist_ok=True)
-            with open(os.path.join(args.model_path,
-                                   "supervisor_metrics.prom"), "w") as f:
-                f.write(sup.registry.render())
-        except OSError as e:
-            LOG.warning("could not persist supervisor metrics: %r", e)
+        sup.write_metrics()  # final render incl. the last exit's counters
         if server is not None:
             from homebrewnlp_tpu.obs.exporter import stop_server
             stop_server(server)
