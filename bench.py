@@ -54,9 +54,22 @@ the in-process jit caches, with the persistent XLA cache warm — the
 restart cost a user actually pays; ``hit`` flags whether it undercut half
 the cold step compile, ``cold_compile_s``).
 
+The low-precision PR (ISSUE 6) adds: per-workload ``compile_budget`` (+
+top-level ``compile_ok``) evaluating ``compile_and_warmup_s`` against the
+committed per-device budget in bench_compile_baseline.json (>20% over =
+fail; tools/compile_ratchet.py runs the same check in CI);
+``compile_cache_hit`` on every workload row (was flagship-only); a
+``step``/``drain`` split inside every row's ``phases_s``; a complete
+``flops_per_step``/``mfu`` under opaque pallas kernels (unfused-twin
+lower bound, flagged ``flops_lower_bound``/``mfu_lower_bound`` — no more
+``mfu: null``); and the ``quant`` probe on the 32mixer_group row
+(docs/performance.md "Low-precision compute"): int8 step-time/MFU delta
+plus the fixed-seed loss-trajectory accept gate.
+
 Env knobs (development / partial runs): ``HBNLP_BENCH_WORKLOADS`` is a
 comma list or ``all`` (default); ``HBNLP_BENCH_GUARD_STEPS`` overrides the
-guard length (0 disables).
+guard length (0 disables); ``HBNLP_BENCH_QUANT=0`` skips the quant probe,
+``HBNLP_BENCH_QUANT_DTYPE``/``_STEPS``/``_TOL`` tune it.
 """
 from __future__ import annotations
 
@@ -68,12 +81,21 @@ import jax
 
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_baseline.json")
+# committed per-device compile+warmup budgets (seconds per workload); the
+# compile ratchet fails any row >20% above its budget — the silent
+# 79 s -> 135 s slide of r04 -> r05 must not repeat (tools/compile_ratchet.py
+# enforces the same file in CI over the committed BENCH_r*.json lines)
+COMPILE_BASELINE_FILE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_compile_baseline.json")
+#: tolerated compile_and_warmup_s ratio vs the committed budget
+COMPILE_BUDGET_RATIO = 1.2
 
 # Peak table + MFU arithmetic shared with the LIVE utilization accounting
 # (homebrewnlp_tpu/train/flops.py): bench's offline mfu and the run's
 # /metrics mfu are the same math over the same cost-analyzed executable,
 # so the two figures cannot drift.
 from homebrewnlp_tpu.train.flops import peak_flops as _peak_flops  # noqa: E402
+from homebrewnlp_tpu.train.flops import unfused_twin_flops  # noqa: E402
 
 # The three reference workload definitions (BASELINE.md:19-21), batch shrunk
 # to one chip.  slice_dtype (device-resident param copy) is forced to bf16:
@@ -174,6 +196,26 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
         cost_algo = tr_algo.step_cost_analysis(state, batch)
         flops_algo = float(cost_algo.get("flops", 0.0)) or flops_exec
 
+    # complete hardware-flops figure even under opaque pallas kernels
+    # (BENCH_r05 reported flops_executed_partial + mfu null for the group
+    # workload): the unfused twin's executed count is an explicit LOWER
+    # BOUND on the fused step's (the kernels run the same math plus
+    # in-kernel backward recompute — train/flops.py::unfused_twin_flops),
+    # so the row carries a usable flops_per_step and a floor mfu, flagged
+    # flops_lower_bound instead of silently incomplete
+    flops_lower_bound = False
+    if kernel_opaque:
+        if cfg.reversible_remat_blocks or cfg.blocked_causal_map:
+            # the twin keeps remat/blocked-map exactly as timed; flops_algo
+            # above reset them, so it is NOT the right bound here
+            flops_exec = max(flops_exec,
+                             unfused_twin_flops(trainer, state, batch))
+        else:
+            # remat and blocked-map are off: the unfused twin IS the
+            # cfg_algo analysis already paid for — no third lowering
+            flops_exec = max(flops_exec, flops_algo)
+        flops_lower_bound = True
+
     # fixed seed schedule: step i always uses fold_in(rng, i), so the probe
     # loss is reproducible round over round
     step_i = 0
@@ -182,8 +224,12 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
         nonlocal step_i
         metrics = None
         for _ in range(n):
-            state, metrics = trainer.step(state, batch,
-                                          jax.random.fold_in(rng, step_i))
+            # per-step dispatch span: phases_s separates dispatch ("step")
+            # from the host pull closing each window ("drain"), so the
+            # group path's compile/feed/step split is visible per workload
+            with tracer.span("step"):
+                state, metrics = trainer.step(state, batch,
+                                              jax.random.fold_in(rng, step_i))
             step_i += 1
         return state, metrics
 
@@ -220,7 +266,8 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
             # blocking), so t_sync..t_end times only the transfer/sync
             jax.block_until_ready(state)
             t_sync = time.perf_counter()
-            window_loss = float(metrics["loss"])
+            with tracer.span("drain"):
+                window_loss = float(metrics["loss"])
             t_end = time.perf_counter()
         host_blocked.append(t_end - t_sync)
         window_dts.append(t_end - t0)
@@ -252,44 +299,48 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
         "phases_s": {k: round(v, 3) for k, v in
                      tracer.phase_totals().items()},
     }
+    if kernel_opaque:
+        # flops_per_step is the unfused twin's LOWER BOUND (see above) —
+        # the flags describe the flop count itself, peak table or not
+        row["flops_executed_partial"] = True  # r05-compatible flag
+        row["flops_lower_bound"] = flops_lower_bound
     if peak and flops_exec:
-        # a fused pallas kernel hides its in-kernel flops from XLA cost
-        # analysis: the executed count (and its mfu) would be nonsense, so
-        # only the algorithmic figure is reported for such workloads
+        # under opaque pallas kernels mfu inherits the lower bound — a
+        # floor, flagged, never null
+        row["mfu"] = round(flops_exec * n_steps / dt / (peak * n_chips), 4)
         if kernel_opaque:
-            row["flops_executed_partial"] = True
-        else:
-            row["mfu"] = round(flops_exec * n_steps / dt / (peak * n_chips),
-                               4)
+            row["mfu_lower_bound"] = True
         row["mfu_algorithmic"] = round(
             flops_algo * n_steps / dt / (peak * n_chips), 4)
     if probe_loss:
         row["loss_after_n_steps"] = round(loss_after, 4)
         row["n_steps_total"] = step_i
-        # compile_cache_hit: drop the in-process jit caches and re-lower +
-        # re-compile the exact step.  bench.main enables the persistent XLA
-        # cache, and the cold compile above just populated it, so this
-        # measures the warm-restart path: tracing/lowering re-runs, the XLA
-        # compile is served from disk.  A warm second bench run shows the
-        # same effect in compile_and_warmup_s itself.
-        t_warm = time.perf_counter()
-        if hasattr(jax, "clear_caches"):
-            jax.clear_caches()
-        tr_warm = Trainer(cfg)
-        tr_warm.axes = trainer.axes
-        tr_warm.optimizer = trainer.optimizer
-        tr_warm.step_cost_analysis(state, batch)
-        warm_s = time.perf_counter() - t_warm
-        # hit compares against the COLD lower+compile of the same step (not
-        # the whole init+warmup envelope, which would flatter a cold cache).
-        # When the cache was prewarmed, cold_compile_s was ITSELF served
-        # from disk (warm ~= "cold"), which is a hit, not a miss.
-        row["compile_cache_hit"] = {
-            "warm_compile_s": round(warm_s, 1),
-            "cold_compile_s": round(cold_compile_s, 1),
-            "cache_prewarmed": cache_prewarmed,
-            "hit": bool(cache_prewarmed or warm_s < 0.5 * cold_compile_s),
-        }
+    # compile_cache_hit (EVERY workload since the compile-ratchet PR; it
+    # was flagship-only before): drop the in-process jit caches and
+    # re-lower + re-compile the exact step.  bench.main enables the
+    # persistent XLA cache, and the cold compile above just populated it,
+    # so this measures the warm-restart path: tracing/lowering re-runs, the
+    # XLA compile is served from disk.  A warm second bench run shows the
+    # same effect in compile_and_warmup_s itself.
+    t_warm = time.perf_counter()
+    if hasattr(jax, "clear_caches"):
+        jax.clear_caches()
+    tr_warm = Trainer(cfg)
+    tr_warm.axes = trainer.axes
+    tr_warm.optimizer = trainer.optimizer
+    tr_warm.step_cost_analysis(state, batch)
+    warm_s = time.perf_counter() - t_warm
+    # hit compares against the COLD lower+compile of the same step (not
+    # the whole init+warmup envelope, which would flatter a cold cache).
+    # When the cache was prewarmed, cold_compile_s was ITSELF served
+    # from disk (warm ~= "cold"), which is a hit, not a miss.
+    row["compile_cache_hit"] = {
+        "warm_compile_s": round(warm_s, 1),
+        "cold_compile_s": round(cold_compile_s, 1),
+        "cache_prewarmed": cache_prewarmed,
+        "hit": bool(cache_prewarmed or warm_s < 0.5 * cold_compile_s),
+    }
+    if probe_loss:
         if os.environ.get("HBNLP_BENCH_TELEMETRY", "1") != "0":
             # device-telemetry overhead probe (docs/observability.md): the
             # same workload with in-graph numerics armed.  Acceptance:
@@ -303,6 +354,18 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
                     name, trainer, state, batch, flops_exec, row["value"])
             except Exception as e:  # noqa: BLE001 - must not kill the line
                 row["telemetry"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if (name == "32mixer_group"
+            and os.environ.get("HBNLP_BENCH_QUANT", "1") != "0"):
+        # int8 accept gate for the grouped-mixer chain (ISSUE 6): the
+        # quantized step's tok/s + ms_per_step delta vs this base row, and
+        # a numerics_guard-style fixed-seed loss-trajectory comparison.
+        # LAST probe in the row: its step calls donate `state`
+        try:
+            row["quant"] = _quant_probe(name, trainer, state, batch,
+                                        flops_algo, row["value"],
+                                        row["ms_per_step"])
+        except Exception as e:  # noqa: BLE001 - must not kill the line
+            row["quant"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     return row
 
 
@@ -347,6 +410,143 @@ def _telemetry_probe(name: str, trainer, state, batch, flops_base: float,
         "flops_ratio_vs_base": (round(flops_tel / flops_base, 4)
                                 if flops_base else None),
     }
+
+
+#: quant probe knobs (env-overridable for development runs)
+QUANT_PROBE_BLOCKS = ("bottleneck_group_linear",)
+QUANT_GATE_STEPS = int(os.environ.get("HBNLP_BENCH_QUANT_STEPS", "30"))
+QUANT_GATE_REL_TOL = float(os.environ.get("HBNLP_BENCH_QUANT_TOL", "0.1"))
+
+
+def evaluate_quant_gate(base_losses, quant_losses,
+                        rel_tol: float = QUANT_GATE_REL_TOL) -> dict:
+    """Pure accept-gate evaluation (unit-testable without a chip), in the
+    numerics_guard mold: the quantized trajectory must be finite, must
+    train (final < first), and must track the high-precision trajectory
+    within ``rel_tol`` relative deviation at every compared step.  A False
+    verdict is a measured REJECT — the knob stays default-off and the
+    numbers ride the line either way (repo perf culture)."""
+    if not base_losses or len(base_losses) != len(quant_losses):
+        return {"pass": False, "error": "trajectory length mismatch"}
+    finite = all(l == l and abs(l) != float("inf")
+                 for l in base_losses + quant_losses)
+    devs = [abs(q - b) / max(abs(b), 1.0)
+            for b, q in zip(base_losses, quant_losses)]
+    max_dev = max(devs) if devs else 0.0
+    trains = quant_losses[-1] < quant_losses[0]
+    return {"pass": bool(finite and trains and max_dev <= rel_tol),
+            "finite": bool(finite),
+            "trains": bool(trains),
+            "max_rel_dev": round(max_dev, 4),
+            "rel_tol": rel_tol,
+            "steps": len(base_losses),
+            "loss_first": round(quant_losses[0], 4),
+            "loss_final": round(quant_losses[-1], 4),
+            "loss_final_base": round(base_losses[-1], 4)}
+
+
+def _loss_trajectory(cfg, batch, n_steps: int):
+    """Fresh-init fixed-seed loss trajectory (one float per step) — the
+    deterministic comparison arm of the quant accept gate.  Same init seed
+    and rng schedule for both arms, so the only difference between the
+    base and quant trajectories is the quantized forward itself."""
+    from homebrewnlp_tpu.train import Trainer
+    tr = Trainer(cfg)
+    state = tr.init(batch)
+    rng = jax.random.key(3)
+    losses = []
+    for i in range(n_steps):
+        state, metrics = tr.step(state, batch, jax.random.fold_in(rng, i))
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+def _quant_probe(name: str, trainer, state, batch, flops_algo: float,
+                 base_tok_s: float, base_ms: float) -> dict:
+    """The int8 (or fp8, HBNLP_BENCH_QUANT_DTYPE) grouped-mixer probe:
+
+    1. timed windows of the quantized step against the SAME live state —
+       tok/s, ms_per_step, and their delta vs the base row (the mfu delta
+       follows from ms_per_step: both rows share flops_algorithmic);
+    2. the accept gate: two fresh-init fixed-seed loss trajectories (quant
+       off / on) compared by ``evaluate_quant_gate``.
+    """
+    from homebrewnlp_tpu.optim import Optimizer
+    from homebrewnlp_tpu.train import Trainer
+    from homebrewnlp_tpu.utils import load_config
+
+    qdtype = os.environ.get("HBNLP_BENCH_QUANT_DTYPE", "int8")
+    quant_over = dict(quant_blocks=list(QUANT_PROBE_BLOCKS),
+                      quant_dtype=qdtype)
+    cfg_q = load_config(f"configs/{name}.json", **_COMMON, **WORKLOADS[name],
+                        **quant_over)
+    tr = Trainer(cfg_q)
+    tr.axes = trainer.axes
+    tr.optimizer = Optimizer(cfg_q, trainer.axes)
+    tr.step_cost_analysis(state, batch)  # compile (kept AOT executable)
+    rng = jax.random.key(4)
+    for i in range(3):  # warmup
+        state, metrics = tr.step(state, batch, jax.random.fold_in(rng, i))
+    float(metrics["loss"])
+    n_steps, dts = 10, []
+    for w in range(3):
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            state, metrics = tr.step(state, batch,
+                                     jax.random.fold_in(rng, 100 + w * 16 + i))
+        jax.block_until_ready(state)
+        float(metrics["loss"])
+        dts.append(time.perf_counter() - t0)
+    dt = sorted(dts)[len(dts) // 2]
+    tokens = cfg_q.train_batch_size * cfg_q.sequence_length * n_steps
+    n_chips = max(1, len(jax.devices()))
+    tok_s = tokens / dt / n_chips
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    row = {
+        "quant_dtype": qdtype,
+        "quant_blocks": list(QUANT_PROBE_BLOCKS),
+        "value": round(tok_s, 2),
+        "ms_per_step": round(dt / n_steps * 1e3, 3),
+        "ratio_vs_base": round(tok_s / base_tok_s, 4) if base_tok_s else None,
+        "ms_delta_vs_base": (round(dt / n_steps * 1e3 - base_ms, 3)
+                             if base_ms else None),
+    }
+    if peak and flops_algo:
+        # same algorithmic flop count as the base row by construction, so
+        # the two mfu_algorithmic figures ARE the MFU delta
+        row["mfu_algorithmic"] = round(
+            flops_algo * n_steps / dt / (peak * n_chips), 4)
+    gate_steps = QUANT_GATE_STEPS
+    if gate_steps > 0:
+        cfg_base = load_config(f"configs/{name}.json", **_COMMON,
+                               **WORKLOADS[name])
+        row["accept"] = evaluate_quant_gate(
+            _loss_trajectory(cfg_base, batch, gate_steps),
+            _loss_trajectory(cfg_q, batch, gate_steps))
+    return row
+
+
+def evaluate_compile_budget(workloads: dict, budgets: dict,
+                            max_ratio: float = COMPILE_BUDGET_RATIO):
+    """Pure compile-time ratchet evaluation (unit-testable, shared with
+    tools/compile_ratchet.py): each workload's ``compile_and_warmup_s``
+    against its committed per-device budget.  Returns (per-workload budget
+    rows, all_pass).  Workloads without a recorded figure or budget are
+    skipped — absence is not a regression (e.g. a partial
+    HBNLP_BENCH_WORKLOADS run)."""
+    rows: dict = {}
+    ok = True
+    for nm, w in sorted(workloads.items()):
+        s = w.get("compile_and_warmup_s") if isinstance(w, dict) else None
+        base = (budgets or {}).get(nm)
+        if not isinstance(s, (int, float)) or not base:
+            continue
+        ratio = s / base
+        passed = bool(ratio <= max_ratio)
+        rows[nm] = {"baseline_s": base, "ratio": round(ratio, 3),
+                    "pass": passed}
+        ok = ok and passed
+    return rows, ok
 
 
 def ensure_real_corpus(pattern: str, builder=None):
@@ -487,6 +687,34 @@ def main() -> None:
             json.dump(baselines, f)
     baseline = baselines.get(device_kind, {}).get("value")
 
+    # compile-time ratchet: every workload's compile_and_warmup_s against
+    # the committed per-device budget (bench_compile_baseline.json).  A
+    # first run on an unknown device kind records its own budget (committed
+    # by the operator like bench_baseline.json); after that, >20% over
+    # budget fails the line's compile_ok and the CI ratchet
+    # (tools/compile_ratchet.py).
+    comp_baselines = {}
+    if os.path.exists(COMPILE_BASELINE_FILE):
+        with open(COMPILE_BASELINE_FILE) as f:
+            comp_baselines = json.load(f)
+    # self-record per WORKLOAD, not just per device kind: a workload added
+    # after the device's budget was first recorded (or missing from a
+    # partial first run) must gain a budget on its first successful
+    # measurement, or it would pass the ratchet unguarded forever
+    dev_budget = comp_baselines.setdefault(device_kind, {})
+    new_rows = {n: w["compile_and_warmup_s"] for n, w in workloads.items()
+                if isinstance(w, dict) and n not in dev_budget
+                and isinstance(w.get("compile_and_warmup_s"), (int, float))}
+    if new_rows:
+        dev_budget.update(new_rows)
+        with open(COMPILE_BASELINE_FILE, "w") as f:
+            json.dump(comp_baselines, f, indent=2, sort_keys=True)
+            f.write("\n")
+    budget_rows, compile_ok = evaluate_compile_budget(
+        workloads, comp_baselines.get(device_kind, {}))
+    for n, b in budget_rows.items():
+        workloads[n]["compile_budget"] = b
+
     record = {
         "metric": "tokens_per_sec_per_chip",
         # figure of record = the flagship's median-of-5 windows (continuity
@@ -511,6 +739,7 @@ def main() -> None:
         "compile_cache_hit": flag.get("compile_cache_hit"),
         "device": device_kind,
         "n_chips": n_chips,
+        "compile_ok": compile_ok,
         "workloads": workloads,
         "numerics_guard": guard,
     }

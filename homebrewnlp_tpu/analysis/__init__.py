@@ -31,8 +31,8 @@ from .trace import ConfigTraces, trace_config  # noqa: F401
 from .graph_rules import run_graph_rules  # noqa: F401
 from .ast_rules import run_ast_rules  # noqa: F401
 
-GRAPH_RULES = ("collective-census", "dtype-promotion", "donation",
-               "sharding-spec", "constant-bloat")
+GRAPH_RULES = ("collective-census", "dtype-promotion", "quant-dtype",
+               "donation", "sharding-spec", "constant-bloat")
 # "dtype-promotion" appears in both: the AST pass carries its static twin
 AST_RULES = ("axis-literal", "x-escape", "traced-rng", "partitionspec-axis",
              "dtype-promotion", "host-sync", "obs-in-trace", "bare-io")

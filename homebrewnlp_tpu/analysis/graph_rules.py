@@ -1,14 +1,19 @@
 """Graph auditors: rule passes over abstractly-traced step jaxprs.
 
-Five rules, each pinning an invariant that historically only failed at TPU
+Six rules, each pinning an invariant that historically only failed at TPU
 runtime (slow step, OOM, or silently wrong layout):
 
 - ``collective-census``: count data-moving collectives (+ sharding
-  constraints and half->f32 upcasts) per step and diff against the config's
-  golden budget file.  An accidental all-gather from a PartitionSpec mismatch
-  — or a new upcast in the hot path — shows up as a census diff.
+  constraints, half->f32 upcasts and quantized int8/fp8 ops) per step and
+  diff against the config's golden budget file.  An accidental all-gather
+  from a PartitionSpec mismatch — or a new upcast in the hot path —
+  shows up as a census diff.
 - ``dtype-promotion``: no f64/complex128 values anywhere in a step unless the
   config itself declares an f64 dtype policy.
+- ``quant-dtype``: int8/fp8 compute only inside the config's declared
+  ``quant_blocks`` scope (ops/quant.py) — a quantized op without the knob,
+  or a declared scope whose train step has no quantized dot (silent
+  high-precision fallback), is an error.
 - ``donation``: every TrainState buffer entering the train step must be
   donated (``donate_argnums``) — a dropped donation doubles peak HBM.
 - ``sharding-spec``: every mesh axis named by the sharding rule table or by
@@ -53,12 +58,37 @@ REPLICATED_PARAM_ELEMS = 1 << 23  # 8M elements (32 MB at f32)
 
 _F64 = (jnp.float64, jnp.complex128)
 
+#: quantized-compute dtypes the quant-dtype rule audits (ops/quant.py):
+#: int8 plus every fp8 flavor this toolchain knows.  Keys are np.dtype
+#: instances — an aval carries np.dtype, which compares equal to the jnp
+#: scalar type but does NOT hash equal, so a scalar-type-keyed dict would
+#: silently miss every hit.  Maps np.dtype -> census family ("int8"/"fp8").
+_QUANT_DTYPES: typing.Dict[typing.Any, str] = {np.dtype(jnp.int8): "int8"}
+for _fp8 in ("float8_e4m3fn", "float8_e5m2", "float8_e4m3b11_fnuz",
+             "float8_e4m3fnuz", "float8_e5m2fnuz"):
+    if hasattr(jnp, _fp8):
+        _QUANT_DTYPES[np.dtype(getattr(jnp, _fp8))] = "fp8"
+
+
+def _quant_family(dt) -> typing.Optional[str]:
+    if dt is None:
+        return None
+    try:
+        return _QUANT_DTYPES.get(np.dtype(dt))
+    except TypeError:
+        return None
+
 
 def census_of(step_trace) -> typing.Dict[str, typing.Any]:
-    """Static per-call-site counts of collectives and upcasts for one step."""
+    """Static per-call-site counts of collectives, upcasts and quantized
+    ops for one step.  The ``quant`` sub-dict (``<family>_dot`` quantized
+    dot_generals, ``<family>_cast`` quantize conversions) is present only
+    when nonzero, so pre-quant goldens stay byte-stable; quant-enabled
+    configs pin their counts like any other census key."""
     collectives: typing.Dict[str, int] = {}
     upcasts = 0
     n_eqns = 0
+    quant: typing.Dict[str, int] = {}
     for eqn in iter_eqns(step_trace.jaxpr):
         n_eqns += 1
         name = COLLECTIVE_PRIMS.get(eqn.primitive.name)
@@ -70,9 +100,22 @@ def census_of(step_trace) -> typing.Dict[str, typing.Any]:
             if (old is not None and new == jnp.float32
                     and old in (jnp.bfloat16, jnp.float16)):
                 upcasts += 1
-    return {"collectives": dict(sorted(collectives.items())),
-            "half_to_f32_upcasts": upcasts,
-            "n_eqns": n_eqns}
+            fam = _quant_family(new)
+            if fam is not None:
+                quant[f"{fam}_cast"] = quant.get(f"{fam}_cast", 0) + 1
+        elif eqn.primitive.name == "dot_general":
+            for v in eqn.invars:
+                fam = _quant_family(
+                    getattr(getattr(v, "aval", None), "dtype", None))
+                if fam is not None:
+                    quant[f"{fam}_dot"] = quant.get(f"{fam}_dot", 0) + 1
+                    break
+    out = {"collectives": dict(sorted(collectives.items())),
+           "half_to_f32_upcasts": upcasts,
+           "n_eqns": n_eqns}
+    if quant:
+        out["quant"] = dict(sorted(quant.items()))
+    return out
 
 
 def golden_path(config_name: str) -> str:
@@ -154,6 +197,15 @@ def check_collective_census(traces: ConfigTraces,
                 f"golden {want.get('half_to_f32_upcasts', 0)} — check the "
                 f"hot path for unintended promotions; if intended, "
                 f"re-record with --update-goldens"))
+        gq, wq = got.get("quant", {}), want.get("quant", {})
+        for key in sorted(set(gq) | set(wq)):
+            if gq.get(key, 0) != wq.get(key, 0):
+                findings.append(Finding(
+                    "collective-census", "error", _loc(traces, step),
+                    f"quantized-op count {key} {gq.get(key, 0)} != golden "
+                    f"{wq.get(key, 0)} — the quant scope changed shape "
+                    f"(ops/quant.py); if intended, re-record with "
+                    f"--update-goldens"))
     return findings
 
 
@@ -294,6 +346,45 @@ def check_constant_bloat(traces: ConfigTraces) -> typing.List[Finding]:
     return findings
 
 
+def check_quant_dtype(traces: ConfigTraces) -> typing.List[Finding]:
+    """Quantized-compute allowlist (ops/quant.py, docs/static_analysis.md):
+    the config's ``quant_blocks`` knob is the ONLY sanctioned source of
+    int8/fp8 compute.
+
+    - A quantized op (int8/fp8 ``dot_general`` or quantize cast) in a step
+      of a config that declares NO quant scope is an error — low-precision
+      math must never leak in implicitly (an accidental integer-promotion
+      dot has silently destroyed model quality before it showed in loss).
+    - A declared quant scope whose traced TRAIN step contains no quantized
+      ``dot_general`` is an error — the scope silently fell back to the
+      high-precision path (pattern typo, fused-kernel bypass, or a dtype
+      gate eating the knob), i.e. the run would report quantized speedups
+      it is not taking.
+    """
+    cfg = traces.cfg
+    declared = bool(getattr(cfg, "quant_blocks", ()))
+    findings: typing.List[Finding] = []
+    for step, st in sorted(traces.steps.items()):
+        quant = census_of(st).get("quant", {})
+        dots = sum(v for k, v in quant.items() if k.endswith("_dot"))
+        if not declared and quant:
+            findings.append(Finding(
+                "quant-dtype", "error", _loc(traces, step),
+                f"quantized ops in the graph ({quant}) but the config "
+                f"declares no quant scope (quant_blocks is empty) — int8/"
+                f"fp8 compute is only sanctioned through ops/quant.py "
+                f"behind the quant_blocks knob"))
+        if declared and step == "train" and dots == 0:
+            findings.append(Finding(
+                "quant-dtype", "error", _loc(traces, step),
+                f"quant_blocks={list(cfg.quant_blocks)} is declared but the "
+                f"traced train step contains no quantized dot_general — the "
+                f"scope silently fell back to the high-precision path "
+                f"(check the substrings against the layer scopes, and that "
+                f"no fused kernel bypasses linear())"))
+    return findings
+
+
 #: jax API names whose absence marks a known toolchain gap (older jax than
 #: the parallel modules target), as opposed to a real defect in model code
 _TOOLCHAIN_GAP_APIS = ("shard_map", "get_abstract_mesh", "pcast", "typeof",
@@ -321,6 +412,7 @@ def run_graph_rules(traces: ConfigTraces, update_goldens: bool = False,
     table = {
         "collective-census": lambda t: check_collective_census(t, update_goldens),
         "dtype-promotion": check_dtype_promotion,
+        "quant-dtype": check_quant_dtype,
         "donation": check_donation,
         "sharding-spec": check_sharding_specs,
         "constant-bloat": check_constant_bloat,

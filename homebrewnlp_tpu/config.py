@@ -172,6 +172,18 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # bytes lever for the group workload, ops/pallas_group.py).  Same
     # single-device guard as fused_mixer_block.
     fused_group_linear=False,
+    # quantized-compute scope (ops/quant.py, docs/performance.md
+    # "Low-precision compute"): layer-scope substrings whose DSL linears run
+    # the W8A8 quantized forward (dynamic in-graph scales, f32-accumulated
+    # int8/fp8 dot, bf16 backward), e.g. ["bottleneck_group_linear",
+    # "/group_linear"].  Empty (default) compiles the exact pre-quant graph
+    # — bit-identical loss sequence, parity-tested like telemetry_interval=0.
+    # The graftcheck quant-dtype rule pins both directions (a quant op
+    # outside the scope, or a declared scope with no quantized dot).
+    quant_blocks=(),
+    # forward quantization format: "int8" (symmetric, qmax 127) or "fp8"
+    # (e4m3, toolchain-gated)
+    quant_dtype="int8",
     # recursion depth for the blocked causal map decomposition
     # (models/layers.py::_blocked_map_rows): 0 = plain masked einsum; >0
     # carves the triangle into dense sub-blocks so XLA skips the masked
@@ -358,6 +370,23 @@ class Config:
             raise ValueError(
                 f"unknown anomaly_policy {self.anomaly_policy!r}; expected "
                 f"one of {ANOMALY_POLICIES}")
+        if isinstance(self.quant_blocks, str):
+            # a bare string would iterate per-CHARACTER below and silently
+            # quantize nearly every linear via single-letter substrings
+            raise ValueError(
+                "quant_blocks must be a list of layer-scope substrings, "
+                f"not a string (got {self.quant_blocks!r}; write "
+                f"[{self.quant_blocks!r}])")
+        self.quant_blocks = [str(b) for b in self.quant_blocks]
+        if any(not b for b in self.quant_blocks):
+            raise ValueError("quant_blocks entries must be non-empty layer-"
+                             "scope substrings (e.g. 'bottleneck_group_"
+                             "linear'); got an empty string")
+        from .ops.quant import QUANT_DTYPES
+        if self.quant_dtype not in QUANT_DTYPES:
+            raise ValueError(
+                f"unknown quant_dtype {self.quant_dtype!r}; this toolchain "
+                f"supports {sorted(QUANT_DTYPES)}")
         if self.watchdog_factor < 0:
             raise ValueError("watchdog_factor must be >= 0 "
                              "(0 = watchdog disabled)")

@@ -737,7 +737,9 @@ def fused_mixer_eligible(ctx, conf, x: NT) -> bool:
     cfg = ctx.cfg
     layer = conf.layer if isinstance(conf.layer, (list, tuple)) else None
     mesh = ctx.effective_mesh
+    from ..ops import quant
     return (cfg.fused_mixer_block
+            and not quant.pattern_quantized(cfg, MIXER_FUSED_PATTERN)
             and layer is not None and tuple(layer) == MIXER_FUSED_PATTERN
             and ctx.params is not None and ctx.decode is None
             and (mesh is None or mesh.size == 1)
@@ -817,7 +819,13 @@ def fused_group_eligible(ctx, conf, x: NT) -> bool:
     n_rows = (x.dim_size(x.names[0]) * x.dim_size(SEQUENCE)
               if SEQUENCE in x.names else 0)
     mesh = ctx.effective_mesh
+    from ..ops import quant
     return (cfg.fused_group_linear
+            # quantization wins over fusion: the pallas kernels run their
+            # own unquantized matmuls, so a quant-declared block must take
+            # the unfused chain where linear() applies the quantized path
+            # (the graftcheck quant-dtype rule would flag the fallback)
+            and not quant.pattern_quantized(cfg, GROUP_FUSED_PATTERN)
             and layer is not None and tuple(layer) == GROUP_FUSED_PATTERN
             and ctx.params is not None and ctx.decode is None
             and (mesh is None or mesh.size == 1)

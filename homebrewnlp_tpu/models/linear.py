@@ -13,6 +13,7 @@ import typing
 from .. import nd
 from ..config import HEADS, INTERMEDIATE, KEY, anonymize_name
 from ..nd import NT
+from ..ops import quant
 from ..ops.init import constant_init, default_fan_in, normal_init, orthogonal_init
 from .ctx import Args
 
@@ -84,11 +85,21 @@ def scalar_var(args: Args, value: float = 0.0, name: str = "rezero_var") -> NT:
 
 
 def linear(args: Args, old: typing.Sequence[Dim], new: typing.Sequence[Dim]) -> NT:
-    """y = einsum(x, W[old+new]) contracting ``old`` (reference backend.py:108-110)."""
+    """y = einsum(x, W[old+new]) contracting ``old`` (reference backend.py:108-110).
+
+    When the enclosing layer scope falls inside ``cfg.quant_blocks`` the
+    contraction runs the W8A8 quantized path (ops/quant.py: dynamic
+    in-graph scales, f32-accumulated int8/fp8 dot, high-precision
+    backward); otherwise — and always when the knob is unset — this is the
+    exact pre-quant ``nd.einsum`` graph."""
+    cfg = args.cfg
     w = args.ctx.scoped("orthogonal_var", orthogonal_var, args, list(old) + list(new), old)
     out_names = nd.dedup([n for n in args.tensor.names if n not in
                           {o[0] for o in old} - {f[0] for f in new}]
                          + [f[0] for f in new])
+    if (quant.eligible(cfg, args.tensor)
+            and quant.scope_matches(cfg.quant_blocks, args.ctx.path())):
+        return quant.quant_einsum(args.tensor, w, out_names, cfg.quant_dtype)
     return nd.einsum([args.tensor, w], out_names)
 
 

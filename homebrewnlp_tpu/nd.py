@@ -199,9 +199,12 @@ def broadcast_union(*tensors: NT) -> typing.List[NT]:
 _LETTERS = string.ascii_letters
 
 
-def einsum(inputs: typing.Sequence[NT], out_names: typing.Sequence[str],
-           precision=None) -> NT:
-    """Named einsum: contract all axes absent from ``out_names``."""
+def contraction_spec(inputs: typing.Sequence[NT],
+                     out_names: typing.Sequence[str]) -> str:
+    """The ``jnp.einsum`` spec string for a named contraction: axes mapped
+    to letters in first-appearance order, everything absent from
+    ``out_names`` contracted.  Shared by :func:`einsum` and its quantized
+    twin (ops/quant.py::quant_einsum) so the two cannot drift."""
     out_names = tuple(out_names)
     mapping: typing.Dict[str, str] = {}
     for t in inputs:
@@ -211,8 +214,15 @@ def einsum(inputs: typing.Sequence[NT], out_names: typing.Sequence[str],
     for n in out_names:
         if n not in mapping:
             raise ValueError(f"output axis {n} not present in any input")
-    spec = ",".join("".join(mapping[n] for n in t.names) for t in inputs)
-    spec += "->" + "".join(mapping[n] for n in out_names)
+    return (",".join("".join(mapping[n] for n in t.names) for t in inputs)
+            + "->" + "".join(mapping[n] for n in out_names))
+
+
+def einsum(inputs: typing.Sequence[NT], out_names: typing.Sequence[str],
+           precision=None) -> NT:
+    """Named einsum: contract all axes absent from ``out_names``."""
+    out_names = tuple(out_names)
+    spec = contraction_spec(inputs, out_names)
     # Accumulate half-precision matmuls in f32 (free on the MXU, strictly
     # better numerically — same policy as ops/losses.py) and cast the result
     # back to the input dtype so activation storage stays half-precision.

@@ -213,6 +213,87 @@ def test_f64_in_graph_detected(eight_devices):
     assert "f64" in findings[0].message
 
 
+# -- quant-dtype allowlist (ISSUE 6) ----------------------------------------
+
+def _quant_mixer_traces(**overrides):
+    from .backend import mixer_config
+    cfg = mixer_config(quant_blocks=["bottleneck_group_linear"], **overrides)
+    traces = atrace.trace_config(cfg, "tinyquant", steps=("train",))
+    assert not traces.errors, traces.errors
+    return traces
+
+
+def test_quant_census_counts_and_rule_clean(eight_devices):
+    """A declared quant scope shows int8 dots + casts in the census and the
+    quant-dtype rule passes; an undeclared config's census carries NO quant
+    key (goldens stay byte-stable)."""
+    traces = _quant_mixer_traces()
+    census = graph_rules.census_of(traces.steps["train"])
+    assert census["quant"]["int8_dot"] > 0
+    assert census["quant"]["int8_cast"] > 0
+    assert graph_rules.check_quant_dtype(traces) == []
+    from .backend import mixer_config
+    plain = atrace.trace_config(mixer_config(), "tinyplain",
+                                steps=("train",))
+    assert "quant" not in graph_rules.census_of(plain.steps["train"])
+    assert graph_rules.check_quant_dtype(plain) == []
+
+
+def test_quant_outside_declared_scope_is_error(eight_devices):
+    """Seeded regression: int8 ops in a graph whose config declares NO
+    quant scope fail the ratchet (the allowlist direction)."""
+    import dataclasses
+    traces = _quant_mixer_traces()
+    undeclared = dataclasses.replace(traces, cfg=tiny_config())
+    findings = graph_rules.check_quant_dtype(undeclared)
+    assert findings and all(f.severity == "error" for f in findings)
+    assert "quant_blocks is empty" in findings[0].message
+
+
+def test_quant_silent_fallback_is_error(eight_devices):
+    """Seeded regression: a declared scope that matches no layer (typo /
+    fused-kernel bypass) compiles zero quantized dots — an error, not a
+    silently-unquantized 'success'."""
+    from .backend import mixer_config
+    cfg = mixer_config(quant_blocks=["bottleneck_gruop_linear"])  # typo
+    traces = atrace.trace_config(cfg, "tinytypo", steps=("train",))
+    assert not traces.errors, traces.errors
+    findings = graph_rules.check_quant_dtype(traces)
+    assert findings and findings[0].severity == "error"
+    assert "silently fell back" in findings[0].message
+
+
+def test_quant_census_drift_detected(eight_devices, monkeypatch, tmp_path):
+    """The quant counts are ratcheted through the census golden: a pinned
+    int8_dot figure that stops matching the trace is an error."""
+    traces = _quant_mixer_traces()
+    monkeypatch.setattr(graph_rules, "GOLDENS_DIR", str(tmp_path))
+    graph_rules.check_collective_census(traces, update_goldens=True)
+    assert graph_rules.check_collective_census(traces) == []
+    path = graph_rules.golden_path("tinyquant")
+    golden = json.load(open(path))
+    golden["steps"]["train"]["quant"]["int8_dot"] += 2
+    json.dump(golden, open(path, "w"))
+    findings = graph_rules.check_collective_census(traces)
+    assert any(f.severity == "error" and "int8_dot" in f.message
+               for f in findings), [f.render() for f in findings]
+
+
+def test_quant_committed_config_golden_matches(eight_devices):
+    """The bundled 32mixer_group_int8 config: census golden (incl. the
+    pinned quant counts) matches and the quant-dtype rule is green, on a
+    shrunk twin of the real trace path."""
+    cfg = _load_config("32mixer_group_int8.json")
+    assert cfg.quant_blocks == ["bottleneck_group_linear"]
+    traces = atrace.trace_config(cfg, "32mixer_group_int8",
+                                 steps=("train",))
+    assert not traces.errors, traces.errors
+    assert graph_rules.check_quant_dtype(traces) == []
+    census = graph_rules.census_of(traces.steps["train"])
+    golden = json.load(open(graph_rules.golden_path("32mixer_group_int8")))
+    assert census["quant"] == golden["steps"]["train"]["quant"]
+
+
 # -- AST rules --------------------------------------------------------------
 
 def _mini_tree(tmp_path, models_src="", ops_src=""):

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Compile-time ratchet: fail on a >20% compile_and_warmup_s regression.
+
+The r04 -> r05 bench round slid compile+warmup from 79 s to 135 s with
+nothing guarding it (ROADMAP "Raw speed").  bench.py now records
+``compile_and_warmup_s`` per workload and evaluates it against the
+committed per-device budgets in ``bench_compile_baseline.json``; this tool
+re-runs the exact same evaluation (``bench.evaluate_compile_budget``) over
+a recorded bench line so CI can reject a regressing BENCH_r*.json — the
+slide cannot land silently again.
+
+Usage:
+  python tools/compile_ratchet.py                  # newest BENCH_r*.json
+  python tools/compile_ratchet.py --bench FILE     # a specific bench line
+  python tools/compile_ratchet.py --max-ratio 1.5  # override the tolerance
+
+Exit code 1 when any workload exceeds its budget, 0 otherwise (including
+when no bench line or no budget for the line's device kind exists — absence
+is not a regression; the budget self-records on first contact with a new
+device kind, see bench.main).
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def newest_bench_file() -> str:
+    """The highest-numbered committed BENCH_r*.json (the driver's record of
+    the latest bench round)."""
+    def round_no(p):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+    files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+                   key=round_no)
+    return files[-1] if files else ""
+
+
+def extract_record(path: str) -> dict:
+    """The bench JSON line from either a raw line file or the driver's
+    BENCH_r*.json wrapper ({"parsed": {...}} / {"tail": "...{line}\\n"})."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        return doc["parsed"]
+    if "workloads" in doc:
+        return doc
+    tail = doc.get("tail", "")
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "workloads" in rec:
+                return rec
+    return {}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--bench", default="",
+                   help="bench JSON (line or BENCH_r*.json); default: the "
+                        "newest committed BENCH_r*.json")
+    p.add_argument("--baseline", default=os.path.join(
+        REPO, "bench_compile_baseline.json"))
+    p.add_argument("--max-ratio", type=float, default=None,
+                   help="tolerated compile_and_warmup_s ratio "
+                        "(default: bench.COMPILE_BUDGET_RATIO = 1.2)")
+    args = p.parse_args(argv)
+
+    from bench import COMPILE_BUDGET_RATIO, evaluate_compile_budget
+    max_ratio = args.max_ratio or COMPILE_BUDGET_RATIO
+
+    path = args.bench or newest_bench_file()
+    if not path or not os.path.exists(path):
+        print("compile-ratchet: no bench record found; nothing to check")
+        return 0
+    record = extract_record(path)
+    workloads = record.get("workloads") or {}
+    device = record.get("device", "")
+    if not workloads:
+        print(f"compile-ratchet: no workload rows in {path}; nothing to "
+              "check")
+        return 0
+    with open(args.baseline) as f:
+        budgets = json.load(f).get(device, {})
+    if not budgets:
+        print(f"compile-ratchet: no committed budget for device "
+              f"{device!r}; record one in {os.path.basename(args.baseline)}")
+        return 0
+
+    rows, ok = evaluate_compile_budget(workloads, budgets, max_ratio)
+    for nm, b in rows.items():
+        mark = "ok  " if b["pass"] else "FAIL"
+        print(f"{mark} {nm}: compile_and_warmup "
+              f"{workloads[nm].get('compile_and_warmup_s')}s vs budget "
+              f"{b['baseline_s']}s (ratio {b['ratio']}, max {max_ratio})")
+    if not rows:
+        print("compile-ratchet: no comparable rows (missing "
+              "compile_and_warmup_s or budgets)")
+    if not ok:
+        print(f"compile-ratchet: REGRESSION — compile+warmup exceeded "
+              f"{max_ratio}x its committed budget ({path}).  If the "
+              f"regression is intended, update bench_compile_baseline.json "
+              f"with the new figure and justify it in docs/performance.md")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
