@@ -66,10 +66,21 @@ lower bound, flagged ``flops_lower_bound``/``mfu_lower_bound`` — no more
 (docs/performance.md "Low-precision compute"): int8 step-time/MFU delta
 plus the fixed-seed loss-trajectory accept gate.
 
+The static-analysis cost-model PR (ISSUE 7) adds: ``hbm_peak_bytes`` on
+EVERY workload row (max per-device ``memory_stats()`` peak, sampled right
+after the timed windows so a failing telemetry/quant probe can no longer
+drop it) and a ``resources`` validation hook — the graftcost prediction
+(``predicted_peak_bytes`` + per-component breakdown, analysis/
+cost_model.py) next to the measured peak and XLA's ``memory_analysis()``
+figures, with ``prediction_error`` riding the BENCH trajectory so the
+per-topology constants table (homebrewnlp_tpu/devices.py) is calibrated by
+every TPU round.
+
 Env knobs (development / partial runs): ``HBNLP_BENCH_WORKLOADS`` is a
 comma list or ``all`` (default); ``HBNLP_BENCH_GUARD_STEPS`` overrides the
 guard length (0 disables); ``HBNLP_BENCH_QUANT=0`` skips the quant probe,
-``HBNLP_BENCH_QUANT_DTYPE``/``_STEPS``/``_TOL`` tune it.
+``HBNLP_BENCH_QUANT_DTYPE``/``_STEPS``/``_TOL`` tune it;
+``HBNLP_BENCH_RESOURCES=0`` skips the cost-model prediction hook.
 """
 from __future__ import annotations
 
@@ -299,6 +310,23 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
         "phases_s": {k: round(v, 3) for k, v in
                      tracer.phase_totals().items()},
     }
+    # hbm_peak_bytes rides EVERY workload row, recorded immediately after
+    # the timed windows and BEFORE the telemetry/quant probes below — a
+    # probe failure (they donate `state` and can die on exotic toolchains)
+    # previously dropped the whole prediction-vs-measured comparison row
+    # (ISSUE 7 satellite).  None on backends without memory_stats (CPU).
+    row["hbm_peak_bytes"] = _hbm_peak_bytes()
+    # static cost-model validation hook (docs/static_analysis.md "Resource
+    # cost model"): the predicted per-device peak next to the measured
+    # memory_stats() peak and XLA's own memory analysis, so
+    # prediction_error joins the BENCH trajectory and the constants table
+    # in homebrewnlp_tpu/devices.py gets calibrated every TPU round
+    if os.environ.get("HBNLP_BENCH_RESOURCES", "1") != "0":
+        try:
+            row["resources"] = _resource_prediction(
+                name, cfg, trainer, row["hbm_peak_bytes"])
+        except Exception as e:  # noqa: BLE001 - must not kill the line
+            row["resources"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     if kernel_opaque:
         # flops_per_step is the unfused twin's LOWER BOUND (see above) —
         # the flags describe the flop count itself, peak table or not
@@ -367,6 +395,49 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
         except Exception as e:  # noqa: BLE001 - must not kill the line
             row["quant"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     return row
+
+
+def _hbm_peak_bytes():
+    """Max per-device ``memory_stats()`` peak, or None where the backend
+    exposes none (CPU).  Never raises — the field must survive any probe."""
+    try:
+        peaks = []
+        for d in jax.local_devices():
+            stats = d.memory_stats() or {}
+            peak = stats.get("peak_bytes_in_use", stats.get("bytes_in_use"))
+            if peak is not None:
+                peaks.append(int(peak))
+        return max(peaks) if peaks else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _resource_prediction(name: str, cfg, trainer, measured_peak):
+    """Static cost-model prediction for the workload's exact config (one
+    abstract re-trace, seconds) + the compiled step's XLA memory analysis,
+    with ``prediction_error`` vs the measured device peak when available."""
+    from homebrewnlp_tpu.analysis import cost_model, trace_config
+    traces = trace_config(cfg, name, steps=("train",))
+    res = cost_model.config_resources(traces).get("train")
+    out = {}
+    if res is not None:
+        out["predicted_peak_bytes"] = int(res.hbm["peak"])
+        out["predicted_hbm"] = {k: int(v) for k, v in res.hbm.items()}
+        out["verdict"] = res.verdict
+    compiled = getattr(trainer, "_compiled", None)
+    if compiled is not None:
+        try:
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                out["xla_temp_bytes"] = int(ma.temp_size_in_bytes)
+                out["xla_argument_bytes"] = int(ma.argument_size_in_bytes)
+        except Exception:  # noqa: BLE001 - optional on some backends
+            pass
+    if measured_peak and out.get("predicted_peak_bytes"):
+        out["measured_peak_bytes"] = int(measured_peak)
+        out["prediction_error"] = round(
+            out["predicted_peak_bytes"] / measured_peak - 1.0, 4)
+    return out
 
 
 def _telemetry_probe(name: str, trainer, state, batch, flops_base: float,

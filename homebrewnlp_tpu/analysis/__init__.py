@@ -28,12 +28,15 @@ docs/static_analysis.md).
 """
 from .findings import Finding, Severity, render_report, worst_severity  # noqa: F401
 from .trace import ConfigTraces, trace_config  # noqa: F401
-from .graph_rules import run_graph_rules  # noqa: F401
+from .graph_rules import check_golden_coverage, run_graph_rules  # noqa: F401
 from .ast_rules import run_ast_rules  # noqa: F401
 
 GRAPH_RULES = ("collective-census", "dtype-promotion", "quant-dtype",
-               "donation", "sharding-spec", "constant-bloat")
+               "donation", "sharding-spec", "constant-bloat",
+               "resource-budget")
 # "dtype-promotion" appears in both: the AST pass carries its static twin
 AST_RULES = ("axis-literal", "x-escape", "traced-rng", "partitionspec-axis",
              "dtype-promotion", "host-sync", "obs-in-trace", "bare-io")
-ALL_RULES = tuple(dict.fromkeys(GRAPH_RULES + AST_RULES))
+# tree-wide gates (run once per --all-configs audit, not per config)
+TREE_RULES = ("golden-coverage",)
+ALL_RULES = tuple(dict.fromkeys(GRAPH_RULES + AST_RULES + TREE_RULES))

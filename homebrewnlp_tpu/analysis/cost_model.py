@@ -1,0 +1,549 @@
+"""graftcost: static memory & communication cost model over traced jaxprs.
+
+The reference framework's whole value proposition is auditing pod-scale
+configs without a pod (PAPER.md: abstract tracing, SimdMeshImpl layout known
+ahead of time); graftcheck (PR 1) counts *which* collectives run — this
+module predicts *how much*: per-device HBM, bytes per mesh axis, and whether
+a workload is compute-, bandwidth-, or interconnect-bound, all from the
+abstract traces, in seconds, on a CPU.
+
+Per config x step (train / decode / prefill):
+
+- **peak HBM per device** (analysis/memory.py): exact param + optimizer-slot
+  bytes under the intended-mesh sharding, the input batch, KV-cache bytes
+  (decode/prefill, via ``infer/kv_cache.py::cache_shapes``), and the
+  activation/residual live-set peak from a linear scan over equation
+  liveness (donated-buffer reuse credited; reversible/remat/quant savings
+  fall out of the traced graph itself).
+- **collective payload bytes per mesh axis**: every census-counted
+  collective is *sized* (operand bytes, scan bodies multiplied by trip
+  count) and attributed to the mesh axes it crosses, then priced with an
+  alpha-beta estimate from the per-topology constants table
+  (``homebrewnlp_tpu/devices.py``).
+- **roofline verdict**: ``mxu`` / ``hbm`` / ``ici`` from the static matmul
+  flop count (``train/flops.py::jaxpr_flops``), an HBM-traffic proxy
+  (2 x every value produced, sharded), and the alpha-beta ICI time.
+
+Predictions are pinned by ratcheted goldens
+(``analysis/goldens/resources/<config>.json``) through the graftcheck
+``resource-budget`` rule: a config whose predicted peak grows past the
+recorded budget — or exceeds its ``target_device``'s HBM capacity — fails
+in CI before anything compiles.  bench.py records measured
+``memory_stats()`` peaks next to these predictions (``prediction_error``)
+so the constants table gets calibrated by every TPU round.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import typing
+
+from ..devices import DeviceSpec, resolve_device
+from ..train.flops import jaxpr_flops
+from .findings import Finding
+from .memory import (ScaledBytes, _sub_jaxprs, activation_divisor,
+                     aval_nbytes, classify_shape, liveness_peak,
+                     sharded_fraction)
+from .trace import COLLECTIVE_PRIMS, ConfigTraces, StepTrace
+
+GOLDENS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "goldens")
+
+#: growth past the recorded budget that fails the ratchet (regressions
+#: smaller than this are absorbed as noise; shrinks below 1/RATIO ask for a
+#: re-record via an info finding)
+RATCHET_RATIO = 1.10
+#: tolerated predicted-peak vs XLA temp-buffer-estimate ratio on the
+#: CPU-compilable configs (recorded in each golden; tightened after TPU
+#: calibration rounds)
+XLA_RATIO = 2.0
+#: device used for the roofline verdict when the config pins no
+#: target_device (the bench fleet's device kind)
+DEFAULT_VERDICT_DEVICE = "v5e"
+
+#: fraction of the payload that actually crosses a link, per collective on
+#: an n-way axis (ring algorithms): psum = reduce-scatter + all-gather
+_CHUNK_FACTORS = {
+    "psum": lambda n: 2.0 * (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / n,
+    "ppermute": lambda n: 1.0,
+    "pgather": lambda n: (n - 1) / n,
+    "sharding_constraint": lambda n: (n - 1) / n,  # worst-case reshard
+}
+
+
+def _collective_axes(eqn) -> typing.Tuple[str, ...]:
+    """Mesh axes one collective equation crosses."""
+    ax = eqn.params.get("axis_name", eqn.params.get("axes"))
+    if ax is None:
+        spec = getattr(eqn.params.get("sharding"), "spec", None)
+        if spec is None:
+            return ()
+        out = []
+        for part in spec:
+            for a in (part if isinstance(part, tuple) else (part,)):
+                if a is not None:
+                    out.append(a)
+        return tuple(out)
+    if isinstance(ax, str):
+        return (ax,)
+    return tuple(a for a in ax if isinstance(a, str))
+
+
+@dataclasses.dataclass
+class CommModel:
+    """Per-mesh-axis communication totals for one step."""
+    bytes_per_axis: typing.Dict[str, int]
+    count_per_axis: typing.Dict[str, int]
+
+    def times(self, imesh_shape: typing.Dict[str, int], spec: DeviceSpec
+              ) -> typing.Dict[str, float]:
+        """alpha-beta seconds per axis: beta uses the payload as already
+        chunk-factored by the walk; alpha charges one launch per call times
+        the ring hop count (an n-way ring collective is n-1 dependent
+        hops)."""
+        return {ax: (self.count_per_axis.get(ax, 0) * spec.alpha_s
+                     * max(1, int(imesh_shape.get(ax, 2)) - 1)
+                     + b / spec.ici_bw)
+                for ax, b in self.bytes_per_axis.items()}
+
+
+def _walk_comm_and_traffic(jaxpr, cfg, imesh, mult: int = 1,
+                           acc=None) -> typing.Tuple[CommModel, float]:
+    """One weighted walk collecting (a) per-axis collective payloads and
+    (b) the HBM-traffic proxy: 2 x every equation-produced byte (written
+    once, read about once), per-device via the activation divisor.  Scan
+    bodies multiply by trip count — unlike the census, which counts static
+    call sites, these figures are per-*execution* totals."""
+    if acc is None:
+        acc = (CommModel({}, {}), [0.0])
+    comm, traffic = acc
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    for eqn in inner.eqns:
+        fam = COLLECTIVE_PRIMS.get(eqn.primitive.name)
+        if fam is not None:
+            payload = sum(aval_nbytes(getattr(v, "aval", None))
+                          for v in eqn.invars)
+            for ax in _collective_axes(eqn):
+                n = int(imesh.shape.get(ax, 1))
+                if n <= 1:
+                    continue
+                moved = int(payload * mult
+                            * _CHUNK_FACTORS.get(fam, lambda n: 1.0)(n))
+                comm.bytes_per_axis[ax] = (
+                    comm.bytes_per_axis.get(ax, 0) + moved)
+                comm.count_per_axis[ax] = (
+                    comm.count_per_axis.get(ax, 0) + mult)
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is not None:
+                div = activation_divisor(getattr(aval, "shape", ()),
+                                         cfg, imesh)
+                traffic[0] += 2.0 * mult * aval_nbytes(aval) / div
+        sub_mult = mult
+        if eqn.primitive.name == "scan":
+            sub_mult = mult * int(eqn.params.get("length", 1) or 1)
+        for item in _sub_jaxprs(eqn):
+            _walk_comm_and_traffic(item, cfg, imesh, sub_mult,
+                                   (comm, traffic))
+    return comm, traffic[0]
+
+
+# -- per-step resource prediction --------------------------------------------
+
+@dataclasses.dataclass
+class StepResources:
+    """The prediction for one traced step (all byte figures per device on
+    the intended mesh; ``scaled`` components power the graftcost sweep)."""
+    hbm: typing.Dict[str, int]
+    comm: CommModel
+    flops_per_device: float
+    hbm_traffic_bytes: float
+    verdict: str
+    verdict_device: str
+    scaled: typing.Dict[str, typing.List[ScaledBytes]]
+
+    def as_golden(self) -> dict:
+        return {
+            "hbm": {k: int(v) for k, v in sorted(self.hbm.items())},
+            "collective_bytes_per_axis": {
+                k: int(v) for k, v in sorted(self.comm.bytes_per_axis.items())},
+            "flops_per_device": float(self.flops_per_device),
+            "verdict": self.verdict,
+        }
+
+
+def _params_slots_bytes(traces: ConfigTraces, imesh
+                        ) -> typing.Tuple[int, int, typing.List[ScaledBytes],
+                                          typing.List[ScaledBytes]]:
+    cfg = traces.cfg
+    p_dev = 0
+    p_scaled: typing.List[ScaledBytes] = []
+    for name, sds in traces.param_shapes.items():
+        frac = sharded_fraction(traces.param_axes.get(name, ()), imesh)
+        b = aval_nbytes(sds) * frac
+        p_dev += b
+        p_scaled.append(classify_shape(sds.shape, b, cfg))
+    s_dev = 0
+    s_scaled: typing.List[ScaledBytes] = []
+    for name, slots in traces.opt_state_shapes.items():
+        axes = traces.slot_axes.get(name, {})
+        for k, sds in slots.items():
+            frac = sharded_fraction(axes.get(k, ()), imesh)
+            b = aval_nbytes(sds) * frac
+            s_dev += b
+            s_scaled.append(classify_shape(sds.shape, b, cfg))
+    return int(p_dev), int(s_dev), p_scaled, s_scaled
+
+
+def _batch_bytes(cfg, imesh) -> typing.Tuple[int, typing.List[ScaledBytes]]:
+    from .trace import abstract_batch
+    total = 0
+    scaled: typing.List[ScaledBytes] = []
+    for t in abstract_batch(cfg).values():
+        div = activation_divisor(t.x.shape, cfg, imesh)
+        b = aval_nbytes(t.x) / div
+        total += b
+        scaled.append(classify_shape(t.x.shape, b, cfg))
+    return int(total), scaled
+
+
+def _kv_bytes(traces: ConfigTraces, imesh
+              ) -> typing.Tuple[int, typing.List[ScaledBytes]]:
+    """Per-device KV-cache bytes for the decode trace's batch-of-1 anchor;
+    scales linearly in batch x context by construction."""
+    from ..infer.kv_cache import cache_shapes
+    cfg = traces.cfg
+    params = traces.param_shapes
+    if cfg.pipeline_parallel > 1:
+        from ..models import pipeline_params_stacked, unstack_pipeline_params
+        import jax
+        if pipeline_params_stacked(cfg, params):
+            params = jax.eval_shape(
+                lambda p: unstack_pipeline_params(cfg, p), params)
+    shapes = cache_shapes(cfg, params, 1)
+    total = 0.0
+    scaled: typing.List[ScaledBytes] = []
+    for kv in shapes.values():
+        for sds in kv:
+            div = activation_divisor(sds.shape, cfg, imesh)
+            b = aval_nbytes(sds) / div
+            total += b
+            c = classify_shape(sds.shape, b, cfg)
+            # every cache row is per generated position and per sequence:
+            # force the batch x context scaling even at the batch-1 anchor
+            c.batch_exp = max(c.batch_exp, 1)
+            c.seq_exp = max(c.seq_exp, 1)
+            scaled.append(c)
+    return int(total), scaled
+
+
+def step_resources(traces: ConfigTraces, step: str, st: StepTrace, imesh,
+                   device_kind: str = "") -> StepResources:
+    cfg = traces.cfg
+    p_dev, s_dev, p_scaled, s_scaled = _params_slots_bytes(traces, imesh)
+    hbm: typing.Dict[str, int] = {"params": p_dev}
+    scaled: typing.Dict[str, typing.List[ScaledBytes]] = {
+        "params": p_scaled}
+    if step == "train":
+        hbm["opt_slots"] = s_dev
+        scaled["opt_slots"] = s_scaled
+    if step in ("train", "eval"):
+        # eval consumes the same full batch as train (liveness never
+        # counts jaxpr inputs — persistent state is accounted here)
+        b, b_scaled = _batch_bytes(cfg, imesh)
+        hbm["batch"] = b
+        scaled["batch"] = b_scaled
+    kv = 0
+    if step in ("decode", "prefill"):
+        try:
+            kv, kv_scaled = _kv_bytes(traces, imesh)
+            scaled["kv_cache"] = kv_scaled
+        except Exception:
+            kv, scaled["kv_cache"] = 0, []
+    hbm["kv_cache"] = kv
+    # transient live set: donated train steps write the new state into the
+    # donated input buffers; decode outputs are fresh allocations (the old
+    # and the updated cache genuinely coexist — the serving loop does not
+    # donate).  Prefill's outputs BEYOND the logits are the freshly written
+    # caches themselves — already accounted (with forced batch x context
+    # scaling) by the kv_cache term above, so counting them again as
+    # liveness outputs would double the KV term and halve the sweep's
+    # predicted max prompt length.
+    if step == "prefill":
+        inner = st.jaxpr.jaxpr if hasattr(st.jaxpr, "jaxpr") else st.jaxpr
+        live = liveness_peak(st.jaxpr, exclude_output_indices=set(
+            range(1, len(inner.outvars))))
+    else:
+        live = liveness_peak(st.jaxpr, exclude_outputs=(step == "train"))
+    act = 0.0
+    act_scaled: typing.List[ScaledBytes] = []
+    for aval in live.peak_live:
+        div = activation_divisor(getattr(aval, "shape", ()), cfg, imesh)
+        b = aval_nbytes(aval) / div
+        act += b
+        act_scaled.append(classify_shape(getattr(aval, "shape", ()), b, cfg))
+    hbm["activation_peak"] = int(act)
+    if step in ("decode", "prefill"):
+        # the decode/prefill traces run a batch of ONE (a batch dim of 1 is
+        # invisible to shape classification), but every serving buffer is
+        # per-request: impose linear batch scaling so the sweep can answer
+        # "what serving batch fits"
+        for c in act_scaled:
+            c.batch_exp = max(c.batch_exp, 1)
+    scaled["activation_peak"] = act_scaled
+    hbm["peak"] = int(sum(v for k, v in hbm.items() if k != "peak"))
+
+    comm, traffic = _walk_comm_and_traffic(st.jaxpr, cfg, imesh)
+    n_dev = 1
+    for v in imesh.shape.values():
+        n_dev *= max(1, int(v))
+    flops_dev = jaxpr_flops(st.jaxpr) / n_dev
+    verdict, vdev = _roofline(cfg, flops_dev, traffic, comm, imesh,
+                              device_kind)
+    return StepResources(hbm=hbm, comm=comm, flops_per_device=flops_dev,
+                         hbm_traffic_bytes=traffic, verdict=verdict,
+                         verdict_device=vdev, scaled=scaled)
+
+
+def _roofline(cfg, flops_dev: float, traffic: float, comm: CommModel,
+              imesh, device_kind: str = ""
+              ) -> typing.Tuple[str, str]:
+    """(verdict, device kind used).  MXU vs HBM vs ICI by which static time
+    estimate dominates on the target (or default-verdict) device."""
+    from ..train.flops import peak_flops
+    kind = device_kind or getattr(cfg, "target_device", "") \
+        or DEFAULT_VERDICT_DEVICE
+    spec = resolve_device(kind)
+    peak = peak_flops(kind)
+    if spec is None or not peak:
+        return "unknown", kind
+    t_mxu = flops_dev / peak
+    t_hbm = traffic / spec.hbm_bw
+    t_ici = sum(comm.times(dict(imesh.shape), spec).values())
+    times = {"mxu": t_mxu, "hbm": t_hbm, "ici": t_ici}
+    return max(times, key=times.get), kind
+
+
+def config_resources(traces: ConfigTraces, device_kind: str = ""
+                     ) -> typing.Dict[str, StepResources]:
+    from .graph_rules import intended_mesh
+    imesh = intended_mesh(traces.cfg)
+    return {name: step_resources(traces, name, st, imesh, device_kind)
+            for name, st in sorted(traces.steps.items())}
+
+
+# -- ratcheted goldens + the resource-budget rule ----------------------------
+
+def resources_golden_path(config_name: str) -> str:
+    return os.path.join(GOLDENS_DIR, "resources", config_name + ".json")
+
+
+def _loc(traces: ConfigTraces, step: str) -> str:
+    return f"configs/{traces.config_name}.json[{step}]"
+
+
+def format_bytes(b: float, width: int = 0) -> str:
+    """Human-readable bytes — the ONE renderer rule messages and the
+    graftcost sheet share (``width`` right-aligns for the table)."""
+    w = str(width) if width else ""
+    pad = "  " if width else ""
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(b) < 1024 or unit == "TiB":
+            if unit == "B":
+                return f"{int(b):{w}d} B{pad}"
+            return f"{b:{w}.2f} {unit}"
+        b /= 1024
+    return f"{b:.2f} TiB"
+
+
+def _fmt(b: float) -> str:
+    return format_bytes(b)
+
+
+def check_resource_budget(traces: ConfigTraces,
+                          update_goldens: bool = False
+                          ) -> typing.List[Finding]:
+    """The graftcheck rule: predicted resources vs the ratcheted golden,
+    plus the OOM-before-compile gate against ``cfg.target_device``."""
+    findings: typing.List[Finding] = []
+    try:
+        actual = config_resources(traces)
+    except Exception as e:  # a cost-model crash must name itself, not pass
+        return [Finding("resource-budget", "error",
+                        _loc(traces, "*"),
+                        f"cost model failed: {type(e).__name__}: {e}")]
+    path = resources_golden_path(traces.config_name)
+    target = str(getattr(traces.cfg, "target_device", "") or "")
+    spec = resolve_device(target) if target else None
+
+    # OOM-before-compile gate: independent of the golden, so an inflated
+    # context/batch fails even on a freshly re-recorded budget
+    if spec is not None:
+        for step, res in actual.items():
+            if res.hbm["peak"] > spec.hbm_bytes:
+                findings.append(Finding(
+                    "resource-budget", "error", _loc(traces, step),
+                    f"predicted peak HBM {_fmt(res.hbm['peak'])} exceeds "
+                    f"{target}'s {_fmt(spec.hbm_bytes)} per chip — OOM "
+                    f"before compile (params {_fmt(res.hbm['params'])}, "
+                    f"slots {_fmt(res.hbm.get('opt_slots', 0))}, "
+                    f"activations {_fmt(res.hbm['activation_peak'])}, "
+                    f"kv {_fmt(res.hbm['kv_cache'])}); shrink batch/context "
+                    f"or re-shard"))
+
+    if update_goldens:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        import jax
+        merged = {s: r.as_golden() for s, r in actual.items()}
+        if os.path.exists(path):
+            with open(path) as f:
+                for step, budget in json.load(f).get("steps", {}).items():
+                    merged.setdefault(step, budget)
+        with open(path, "w") as f:
+            json.dump({"config": traces.config_name,
+                       "jax": jax.__version__,
+                       "target_device": target,
+                       "intended_mesh": {k: int(v) for k, v in
+                                         _imesh_shape(traces).items()},
+                       "tolerance": {"ratchet": RATCHET_RATIO,
+                                     "xla": XLA_RATIO},
+                       "steps": merged}, f, indent=2, sort_keys=True)
+            f.write("\n")
+        findings.append(Finding(
+            "resource-budget", "info", path,
+            f"resources golden updated ({', '.join(actual) or 'no steps'})"))
+        return findings
+
+    if not os.path.exists(path):
+        findings.append(Finding(
+            "resource-budget", "error", _loc(traces, "*"),
+            f"no resources golden at {os.path.relpath(path)}; run "
+            f"`python tools/graftcheck.py --config configs/"
+            f"{traces.config_name}.json --update-goldens`"))
+        return findings
+    with open(path) as f:
+        golden = json.load(f)
+    ratchet = float(golden.get("tolerance", {}).get("ratchet", RATCHET_RATIO))
+    gsteps = golden.get("steps", {})
+    for step in sorted(set(actual) | set(gsteps)):
+        if step not in actual:
+            findings.append(Finding(
+                "resource-budget", "warning", _loc(traces, step),
+                "step present in resources golden but not traced this run "
+                f"({traces.errors.get(step, 'step skipped')})"))
+            continue
+        if step not in gsteps:
+            findings.append(Finding(
+                "resource-budget", "warning", _loc(traces, step),
+                "step traced but not pinned by the resources golden; record "
+                "it with --update-goldens to gate it"))
+            continue
+        got, want = actual[step].as_golden(), gsteps[step]
+        g_peak, w_peak = got["hbm"]["peak"], want["hbm"].get("peak", 0)
+        if w_peak and g_peak > w_peak * ratchet:
+            findings.append(Finding(
+                "resource-budget", "error", _loc(traces, step),
+                f"predicted peak HBM {_fmt(g_peak)} regressed past the "
+                f"golden budget {_fmt(w_peak)} (x{g_peak / w_peak:.2f} > "
+                f"ratchet x{ratchet}) — if intended, re-record with "
+                f"--update-goldens"))
+        elif w_peak and g_peak * ratchet < w_peak:
+            findings.append(Finding(
+                "resource-budget", "info", _loc(traces, step),
+                f"predicted peak HBM improved {_fmt(w_peak)} -> "
+                f"{_fmt(g_peak)}; re-record with --update-goldens to "
+                f"ratchet the gain"))
+        ga = got["collective_bytes_per_axis"]
+        wa = want.get("collective_bytes_per_axis", {})
+        for ax in sorted(set(ga) | set(wa)):
+            g, w = ga.get(ax, 0), wa.get(ax, 0)
+            if g > max(w, 1) * ratchet and g - w > 1024:
+                findings.append(Finding(
+                    "resource-budget", "error", _loc(traces, step),
+                    f"collective payload on mesh axis {ax!r} grew "
+                    f"{_fmt(w)} -> {_fmt(g)} past the ratchet — an "
+                    f"unplanned reshard or a fatter collective; if "
+                    f"intended, re-record with --update-goldens"))
+        if got["verdict"] != want.get("verdict", got["verdict"]):
+            findings.append(Finding(
+                "resource-budget", "warning", _loc(traces, step),
+                f"roofline verdict changed {want.get('verdict')!r} -> "
+                f"{got['verdict']!r} on {actual[step].verdict_device} — the "
+                f"workload's bottleneck moved; re-record if intended"))
+    return findings
+
+
+def _imesh_shape(traces: ConfigTraces) -> typing.Dict[str, int]:
+    from .graph_rules import intended_mesh
+    return dict(intended_mesh(traces.cfg).shape)
+
+
+# -- sweep model (tools/graftcost.py) ----------------------------------------
+
+@dataclasses.dataclass
+class SweepModel:
+    """Scaling model built from ONE traced anchor: every HBM component of
+    every step, classified by batch/sequence exponents, so sweeping context
+    1k -> 128k is arithmetic instead of 8 more traces.  The anchor ambiguity
+    (batch == seq) is surfaced via ``ambiguous``.  The train step anchors at
+    ``train_batch_size``; decode/prefill anchor at the serving batch of 1
+    their traces run."""
+    config_name: str
+    anchor_batch: int
+    anchor_seq: int
+    steps: typing.Dict[str, StepResources]
+    ambiguous: bool
+
+    def step_anchor_batch(self, step: str) -> int:
+        return self.anchor_batch if step == "train" else 1
+
+    def peak_at(self, step: str, batch: typing.Optional[int] = None,
+                context: typing.Optional[int] = None
+                ) -> typing.Dict[str, float]:
+        """Per-component HBM bytes at a scaled (batch, context) point."""
+        res = self.steps[step]
+        br = (batch / self.step_anchor_batch(step)) if batch else 1.0
+        sr = (context / self.anchor_seq) if context else 1.0
+        out = {k: sum(c.at(br, sr) for c in comps)
+               for k, comps in res.scaled.items()}
+        out["peak"] = sum(out.values())
+        return out
+
+
+def build_sweep_model(traces: ConfigTraces) -> SweepModel:
+    cfg = traces.cfg
+    return SweepModel(
+        config_name=traces.config_name,
+        anchor_batch=cfg.train_batch_size,
+        anchor_seq=cfg.sequence_length,
+        steps=config_resources(traces),
+        ambiguous=(cfg.train_batch_size == cfg.sequence_length))
+
+
+def first_exceeding(model: SweepModel, step: str, spec: DeviceSpec,
+                    points: typing.Sequence[int], key: str = "context",
+                    batch: typing.Optional[int] = None
+                    ) -> typing.Optional[int]:
+    """Smallest swept ``key`` value (``"context"`` or ``"batch"``) whose
+    predicted peak exceeds ``spec``'s HBM (None when every point fits).
+    The single source of the fits/OOM boundary — tools/graftcost.py and
+    the tests both call it."""
+    for v in sorted(points):
+        kw = {"batch": batch, "context": v} if key == "context" \
+            else {"batch": v}
+        if model.peak_at(step, **kw)["peak"] > spec.hbm_bytes:
+            return v
+    return None
+
+
+def first_context_exceeding(model: SweepModel, step: str, spec: DeviceSpec,
+                            contexts: typing.Sequence[int],
+                            batch: typing.Optional[int] = None
+                            ) -> typing.Optional[int]:
+    """Smallest swept context whose predicted peak exceeds ``spec``'s HBM
+    (None when every point fits) — the long-context planning entry point."""
+    return first_exceeding(model, step, spec, contexts, "context", batch)

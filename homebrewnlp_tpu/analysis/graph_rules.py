@@ -406,9 +406,41 @@ def check_trace_errors(traces: ConfigTraces) -> typing.List[Finding]:
     return findings
 
 
+def check_golden_coverage(config_names: typing.Sequence[str]
+                          ) -> typing.List[Finding]:
+    """Tree-wide gate (run under --all-configs): every bundled config must
+    have BOTH a census golden and a resources golden, and no golden may
+    outlive its config.  Previously a brand-new config silently skipped the
+    census until someone traced it by hand — coverage is now an invariant,
+    not a convention."""
+    from .cost_model import resources_golden_path
+    findings: typing.List[Finding] = []
+    names = set(config_names)
+    for kind, path_fn in (("census", golden_path),
+                          ("resources", resources_golden_path)):
+        have = set()
+        d = os.path.dirname(path_fn("_"))
+        if os.path.isdir(d):
+            have = {os.path.splitext(f)[0] for f in os.listdir(d)
+                    if f.endswith(".json")}
+        for name in sorted(names - have):
+            findings.append(Finding(
+                "golden-coverage", "error", f"configs/{name}.json",
+                f"config has no {kind} golden — it would silently skip the "
+                f"{kind} gate; run `python tools/graftcheck.py --config "
+                f"configs/{name}.json --update-goldens`"))
+        for name in sorted(have - names):
+            findings.append(Finding(
+                "golden-coverage", "warning", os.path.relpath(path_fn(name)),
+                f"orphan {kind} golden: no configs/{name}.json — delete it "
+                f"or restore the config"))
+    return findings
+
+
 def run_graph_rules(traces: ConfigTraces, update_goldens: bool = False,
                     rules: typing.Optional[typing.Sequence[str]] = None
                     ) -> typing.List[Finding]:
+    from .cost_model import check_resource_budget
     table = {
         "collective-census": lambda t: check_collective_census(t, update_goldens),
         "dtype-promotion": check_dtype_promotion,
@@ -416,6 +448,7 @@ def run_graph_rules(traces: ConfigTraces, update_goldens: bool = False,
         "donation": check_donation,
         "sharding-spec": check_sharding_specs,
         "constant-bloat": check_constant_bloat,
+        "resource-budget": lambda t: check_resource_budget(t, update_goldens),
     }
     findings = check_trace_errors(traces)
     for name, fn in table.items():

@@ -1,0 +1,299 @@
+"""Static per-device HBM accounting over abstractly-traced step jaxprs.
+
+Everything here works on :class:`~homebrewnlp_tpu.analysis.trace.ConfigTraces`
+— ShapeDtypeStructs and jaxprs, never allocated arrays — so predicting the
+peak HBM of a billion-parameter config costs seconds on a CPU.  Components:
+
+- **params / optimizer slots**: exact byte counts from the abstract param
+  and slot shapes, divided per device by the sharding the intended
+  (``tpu_size``) mesh would apply (``parallel/sharding.py`` rules).  On the
+  1-chip CPU-traceable configs these match the analytic count exactly
+  (pinned by tests/graftcost_test.py).
+- **activation/residual live set**: a linear scan over equation liveness of
+  the traced jaxpr — each equation-defined value is live from its defining
+  equation to its last use; the peak of the running byte total is the
+  transient-buffer estimate.  Sub-jaxprs (scan/pjit/while/custom_vjp bodies)
+  are scanned recursively and their internal peak charged at the calling
+  equation, which is how reversible blocks and remat show up as savings:
+  their recompute lives inside the backward body instead of spanning the
+  whole program.  Donated train-state outputs are excluded (they write into
+  the donated input buffers — the donation rule pins that they stay
+  donated).
+- **sharding heuristic for activations**: a live buffer's per-device size
+  divides by every intended mesh axis whose characteristic logical size
+  (batch -> data, sequence -> sequence_parallel, heads -> model, stage ->
+  pipeline) appears as one of its dimensions.  This is the idealized GSPMD
+  placement; the tolerance recorded in each resources golden absorbs the
+  approximation until TPU calibration tightens it.
+
+The scan also returns the live set *at* the peak with each buffer
+classified by how its dims scale in batch / sequence-length, which is what
+lets ``tools/graftcost.py`` sweep context 1k -> 128k in milliseconds instead
+of re-tracing every point.
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+import numpy as np
+
+Aval = typing.Any
+
+
+def aval_nbytes(aval) -> int:
+    """Bytes of one abstract value (0 for abstract tokens/opaque avals)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        # extended dtypes (PRNG keys): itemsize from the key data layout
+        itemsize = getattr(dtype, "itemsize", 4)
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except TypeError:  # symbolic dim — count as 1, caller calibrates
+            pass
+    return int(n) * int(itemsize)
+
+
+def _inner(jaxpr):
+    return jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+
+
+def _sub_jaxprs(eqn) -> typing.Iterator:
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        for item in vals:
+            if hasattr(item, "eqns") or (
+                    hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns")):
+                yield item
+
+
+@dataclasses.dataclass
+class LivenessResult:
+    peak_bytes: int
+    #: avals live at the peak program point (top-level intermediates plus
+    #: the live set of whichever sub-jaxpr was executing), for scaling
+    #: classification — NOT a complete allocation trace
+    peak_live: typing.List[Aval]
+
+
+#: primitives XLA reliably fuses into their consumer/producer: their outputs
+#: alias a buffer instead of materializing one.  The list is deliberately
+#: conservative (pure elementwise + layout-only ops); anything absent
+#: materializes, so omissions OVER-estimate peak rather than hide it.
+FUSIBLE_PRIMS = frozenset((
+    "add", "sub", "mul", "div", "neg", "max", "min", "rem", "pow",
+    "integer_pow", "exp", "log", "log1p", "expm1", "tanh", "logistic",
+    "sqrt", "rsqrt", "cbrt", "abs", "sign", "floor", "ceil", "round",
+    "erf", "erf_inv", "erfc", "sin", "cos", "clamp", "select_n",
+    "convert_element_type", "stop_gradient", "transpose", "reshape",
+    "squeeze", "expand_dims", "rev", "copy", "and", "or", "xor", "not",
+    "eq", "ne", "lt", "le", "gt", "ge", "is_finite", "square",
+    "broadcast_in_dim", "broadcast", "iota", "real", "imag",
+))
+
+
+def liveness_peak(jaxpr, exclude_outputs: bool = False,
+                  exclude_output_indices: typing.Optional[
+                      typing.Set[int]] = None) -> LivenessResult:
+    """Fusion-aware linear-scan liveness over one (Closed)Jaxpr: the peak
+    simultaneous byte total of equation-defined buffers.
+
+    Outputs of :data:`FUSIBLE_PRIMS` equations that are no larger than
+    their largest equation-defined operand *alias* that operand's buffer
+    (XLA fuses the elementwise chain; counting every norm/scale/activation
+    intermediate separately over-predicted ~5x on the CPU-compilable
+    configs).  Everything else materializes.  ``exclude_outputs`` models
+    donated-buffer reuse: the jaxpr's own output vars (the new TrainState
+    of a donated train step) are written into the donated argument buffers,
+    so they only count while a later equation still reads them.
+    ``exclude_output_indices`` excludes individual outvar positions the
+    caller accounts as persistent state elsewhere (the KV caches a prefill
+    writes).  Inputs and consts are never counted here — the caller
+    accounts params, slots, batch and caches as persistent state.
+    """
+    inner = _inner(jaxpr)
+    eqns = list(inner.eqns)
+    n = len(eqns)
+
+    # pass 1: aliasing (var id -> root buffer id) + per-root last use
+    root: typing.Dict[int, int] = {}
+
+    def find(vid: int) -> int:
+        while vid in root:
+            vid = root[vid]
+        return vid
+
+    defined_ids = set()
+    last_use: typing.Dict[int, int] = {}
+    def_site: typing.Dict[int, int] = {}
+    root_aval: typing.Dict[int, Aval] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if hasattr(v, "aval") and not hasattr(v, "val"):  # skip Literals
+                r = find(id(v))
+                if r in defined_ids:
+                    last_use[r] = i
+        fusible = eqn.primitive.name in FUSIBLE_PRIMS
+        # the largest equation-DEFINED operand this output may alias
+        # (aliasing a jaxpr input would hide the buffer entirely — inputs
+        # are accounted by the caller as persistent state)
+        host = None
+        if fusible:
+            best = -1
+            for v in eqn.invars:
+                if not hasattr(v, "aval") or hasattr(v, "val"):
+                    continue
+                r = find(id(v))
+                if r in defined_ids:
+                    b = aval_nbytes(root_aval.get(r))
+                    if b > best:
+                        best, host = b, r
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is None:
+                continue
+            defined_ids.add(id(v))
+            if (host is not None
+                    and aval_nbytes(aval) <= aval_nbytes(root_aval[host])):
+                root[id(v)] = host  # fused: rides the operand's buffer
+            else:
+                def_site[id(v)] = i
+                root_aval[id(v)] = aval
+    for idx, v in enumerate(inner.outvars):
+        if hasattr(v, "aval") and not hasattr(v, "val"):
+            excluded = exclude_outputs or (
+                exclude_output_indices is not None
+                and idx in exclude_output_indices)
+            r = find(id(v))
+            if r in defined_ids and not excluded:
+                last_use[r] = n  # live past the last equation
+
+    # pass 2: the sweep over materializing roots
+    live_bytes = 0
+    live: typing.Dict[int, Aval] = {}
+    peak = 0
+    peak_live: typing.List[Aval] = []
+    for i, eqn in enumerate(eqns):
+        for v in eqn.outvars:
+            vid = id(v)
+            if def_site.get(vid) != i:
+                continue  # aliased (or aval-less) — allocates nothing
+            if vid not in last_use:
+                continue  # dead value (DropVar/unused) — XLA elides it
+            live[vid] = root_aval[vid]
+            live_bytes += aval_nbytes(root_aval[vid])
+        # sub-jaxpr internal peak is charged while this equation runs;
+        # scan/while bodies execute one iteration at a time, so their
+        # internal transient set does not multiply by trip count
+        sub_peak, sub_live = 0, []
+        for sub in _sub_jaxprs(eqn):
+            r = liveness_peak(sub)
+            if r.peak_bytes > sub_peak:
+                sub_peak, sub_live = r.peak_bytes, r.peak_live
+        if live_bytes + sub_peak > peak:
+            peak = live_bytes + sub_peak
+            peak_live = list(live.values()) + list(sub_live)
+        # release roots whose last use was this equation
+        touched = {find(id(v)) for v in eqn.invars
+                   if hasattr(v, "aval") and not hasattr(v, "val")}
+        touched.update(find(id(v)) for v in eqn.outvars
+                       if hasattr(v, "aval"))
+        for r in touched:
+            if r in live and last_use.get(r, -1) <= i:
+                live_bytes -= aval_nbytes(live.pop(r))
+    return LivenessResult(int(peak), peak_live)
+
+
+# -- sharding-aware per-device division -------------------------------------
+
+def sharded_fraction(axis_names: typing.Sequence[str], imesh) -> float:
+    """1 / (product of intended-mesh axis sizes this parameter shards
+    over), via the same spec_for rules the real placement uses."""
+    from ..parallel.sharding import spec_for
+    spec = spec_for(tuple(axis_names), imesh)
+    denom = 1
+    for part in spec:
+        for ax in (part if isinstance(part, tuple) else (part,)):
+            if ax is not None:
+                denom *= int(imesh.shape.get(ax, 1))
+    return 1.0 / max(1, denom)
+
+
+def activation_divisor(shape: typing.Sequence[int], cfg, imesh) -> int:
+    """Idealized GSPMD divisor for one activation buffer: each intended
+    mesh axis (>1) divides the buffer once if its characteristic logical
+    size appears among the dims.  Heuristic — jaxpr vars carry no axis
+    names — recorded as such in docs/static_analysis.md."""
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
+    n_micro = max(1, cfg.grad_accumulation * cfg.macro_batching)
+    batch_sizes = {cfg.train_batch_size,
+                   cfg.train_batch_size * cfg.macro_batching,
+                   max(1, cfg.train_batch_size // n_micro)}
+    seq_sizes = {cfg.sequence_length, cfg.time_patch_size,
+                 cfg.language_token_patch}
+    char = {DATA_AXIS: batch_sizes, SEQ_AXIS: seq_sizes,
+            MODEL_AXIS: {cfg.heads}, PIPE_AXIS: {cfg.pipeline_parallel}}
+    dims = [int(d) for d in shape]
+    divisor = 1
+    for axis, sizes in char.items():
+        size = int(imesh.shape.get(axis, 1))
+        if size <= 1:
+            continue
+        hit = next((i for i, d in enumerate(dims) if d in sizes and d > 1),
+                   None)
+        if hit is not None:
+            dims.pop(hit)  # one mesh axis per matched dim
+            divisor *= size
+    return divisor
+
+
+# -- scaling classification (for the graftcost sweep) ------------------------
+
+@dataclasses.dataclass
+class ScaledBytes:
+    """Bytes at the traced anchor plus integer scaling exponents in
+    sequence length and batch: ``bytes(b, s) = bytes0 * (b/b0)**batch_exp
+    * (s/s0)**seq_exp``.  An attention-map logit buffer [batch, heads, s, s]
+    classifies as seq_exp=2 — the quadratic term long-context planning
+    cares about."""
+    bytes0: float
+    seq_exp: int = 0
+    batch_exp: int = 0
+
+    def at(self, batch_ratio: float, seq_ratio: float) -> float:
+        return (self.bytes0 * (batch_ratio ** self.batch_exp)
+                * (seq_ratio ** self.seq_exp))
+
+
+def classify_shape(shape: typing.Sequence[int], nbytes: float, cfg
+                   ) -> ScaledBytes:
+    """Classify one buffer's dims against the config's anchor sizes.
+    Sequence matches win over batch on ambiguous dims (long-context sweeps
+    are the primary consumer); anchors with batch == seq are flagged by the
+    caller."""
+    n_micro = max(1, cfg.grad_accumulation * cfg.macro_batching)
+    seq_sizes = {cfg.sequence_length, cfg.time_patch_size,
+                 cfg.language_token_patch}
+    batch_sizes = {cfg.train_batch_size,
+                   cfg.train_batch_size * cfg.macro_batching,
+                   max(1, cfg.train_batch_size // n_micro)}
+    seq_exp = batch_exp = 0
+    for d in shape:
+        d = int(d)
+        if d > 1 and d in seq_sizes:
+            seq_exp += 1
+        elif d > 1 and d in batch_sizes:
+            batch_exp += 1
+    return ScaledBytes(float(nbytes), seq_exp=seq_exp, batch_exp=batch_exp)
+
+
+def sum_scaled(components: typing.Iterable[ScaledBytes],
+               batch_ratio: float, seq_ratio: float) -> float:
+    return sum(c.at(batch_ratio, seq_ratio) for c in components)

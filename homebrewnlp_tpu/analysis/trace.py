@@ -51,9 +51,11 @@ COLLECTIVE_PRIMS: typing.Dict[str, str] = {
     "psum2": "psum",
     "psum_invariant": "psum",
     "all_gather": "all_gather",
+    "all_gather_invariant": "all_gather",
     "all_to_all": "all_to_all",
     "ppermute": "ppermute",
     "reduce_scatter": "reduce_scatter",
+    "psum_scatter": "reduce_scatter",
     "pgather": "pgather",
     "sharding_constraint": "sharding_constraint",
 }
@@ -61,7 +63,7 @@ COLLECTIVE_PRIMS: typing.Dict[str, str] = {
 
 @dataclasses.dataclass
 class StepTrace:
-    name: str  # "train" | "eval" | "decode"
+    name: str  # "train" | "eval" | "decode" | "prefill"
     jaxpr: typing.Any  # jax.core.ClosedJaxpr
     mesh: typing.Any
     args_info: typing.Any = None  # pytree of jax.stages.ArgInfo (train only)
@@ -77,6 +79,12 @@ class ConfigTraces:
     param_axes: typing.Dict[str, typing.Tuple[str, ...]]
     param_shapes: typing.Dict[str, typing.Any]  # name -> ShapeDtypeStruct
     errors: typing.Dict[str, str]  # step -> repr of trace failure
+    # abstract optimizer-slot shapes + their sharding axis names (for the
+    # cost model's exact param+slot byte accounting); {} when params failed
+    opt_state_shapes: typing.Dict[str, typing.Dict[str, typing.Any]] = (
+        dataclasses.field(default_factory=dict))
+    slot_axes: typing.Dict[str, typing.Dict[str, typing.Tuple[str, ...]]] = (
+        dataclasses.field(default_factory=dict))
 
 
 @contextlib.contextmanager
@@ -212,9 +220,11 @@ def _micro_sds(batch: typing.Dict[str, NT], n_micro: int
             for k, t in batch.items()}
 
 
-def trace_train(cfg: Config, mesh=None) -> typing.Tuple[StepTrace, dict, dict]:
+def trace_train(cfg: Config, mesh=None
+                ) -> typing.Tuple[StepTrace, dict, dict, dict, dict]:
     """Trace the full jitted train step (grads + optimizer update) against
-    abstract state.  Returns (StepTrace, param shapes, param axes)."""
+    abstract state.  Returns (StepTrace, param shapes, param axes,
+    optimizer-slot shapes, slot sharding axes)."""
     mesh = make_mesh(cfg) if mesh is None else mesh
     batch = abstract_batch(cfg)
     trainer = Trainer(cfg, mesh)
@@ -235,7 +245,8 @@ def trace_train(cfg: Config, mesh=None) -> typing.Tuple[StepTrace, dict, dict]:
     # TrainState subtree carries the donation bits the audit needs
     state_info = args_info[0][0]
     return (StepTrace("train", traced.jaxpr, mesh, args_info, state_info),
-            params, axes)
+            params, axes, dict(opt_state),
+            trainer.optimizer.slot_axis_names())
 
 
 def trace_eval(cfg: Config, params, mesh=None) -> StepTrace:
@@ -255,6 +266,29 @@ def trace_eval(cfg: Config, params, mesh=None) -> StepTrace:
 def decode_traceable(cfg: Config) -> bool:
     from ..infer.kv_cache import cache_eligible
     return bool(cfg.use_language) and not cfg.use_video and cache_eligible(cfg)
+
+
+def trace_prefill(cfg: Config, params, mesh=None) -> StepTrace:
+    """Trace the decode PREFILL: one full-length forward that writes every
+    prompt position's K/V at once (the serving cold path — its activation
+    peak, not the per-token step's, is what bounds prompt length)."""
+    from ..infer.kv_cache import _decode_logits
+    mesh = make_mesh(cfg) if mesh is None else mesh
+    names = ("batch", "sequence", "language_token_patch")
+    seq = cfg.sequence_length // cfg.token_patch_size
+    toks = jax.ShapeDtypeStruct((1, seq, cfg.token_patch_size), jnp.int32)
+    if cfg.pipeline_parallel > 1 and pipeline_params_stacked(cfg, params):
+        from ..models import unstack_pipeline_params
+        params = jax.eval_shape(
+            lambda p: unstack_pipeline_params(cfg, p), params)
+
+    def prefill(p, t):
+        return _decode_logits(cfg, p, t, jnp.int32(0), {}, seq, names)
+
+    with trace_compat():
+        jaxpr = jax.make_jaxpr(prefill)(
+            params, jnp.zeros(toks.shape, toks.dtype))
+    return StepTrace("prefill", jaxpr, mesh)
 
 
 def trace_decode(cfg: Config, params, mesh=None) -> StepTrace:
@@ -294,9 +328,12 @@ def trace_config(cfg: Config, config_name: str,
     errors: typing.Dict[str, str] = {}
     params: typing.Dict[str, typing.Any] = {}
     axes: typing.Dict[str, typing.Tuple[str, ...]] = {}
+    opt_shapes: typing.Dict[str, typing.Any] = {}
+    slot_axes: typing.Dict[str, typing.Any] = {}
     if "train" in steps:
         try:
-            out["train"], params, axes = trace_train(cfg, mesh)
+            out["train"], params, axes, opt_shapes, slot_axes = \
+                trace_train(cfg, mesh)
         except Exception as e:  # surfaces as a trace-failure finding
             errors["train"] = f"{type(e).__name__}: {e}"
     if not params:
@@ -316,4 +353,19 @@ def trace_config(cfg: Config, config_name: str,
             out["decode"] = trace_decode(cfg, params, mesh)
         except Exception as e:
             errors["decode"] = f"{type(e).__name__}: {e}"
-    return ConfigTraces(config_name, cfg, mesh, out, axes, params, errors)
+    if "prefill" in steps and params and decode_traceable(cfg):
+        try:
+            out["prefill"] = trace_prefill(cfg, params, mesh)
+        except Exception as e:
+            errors["prefill"] = f"{type(e).__name__}: {e}"
+    if params and not opt_shapes:
+        # no successful train trace to reuse the slot shapes from
+        try:
+            opt = Optimizer(cfg, axes)
+            opt_shapes = dict(jax.eval_shape(opt.init, params))
+            slot_axes = opt.slot_axis_names()
+        except Exception as e:
+            errors.setdefault("opt_state", f"{type(e).__name__}: {e}")
+    return ConfigTraces(config_name, cfg, mesh, out, axes, params, errors,
+                        opt_state_shapes=dict(opt_shapes),
+                        slot_axes=dict(slot_axes))

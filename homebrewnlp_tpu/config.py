@@ -298,6 +298,15 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     ),
     input_block_config=(),
     output_block_config=(),
+    # intended deployment device kind ("v5e", "v4", "v5p", ... — see
+    # homebrewnlp_tpu/devices.py) for the static cost model
+    # (docs/static_analysis.md "Resource cost model"): when set, the
+    # graftcheck resource-budget rule HARD-FAILS any config whose predicted
+    # per-device peak HBM exceeds this device's capacity — the OOM surfaces
+    # in CI seconds instead of after a ~2-minute TPU compile.  "" (default)
+    # skips the capacity gate; predictions and the roofline verdict are
+    # still recorded against the default verdict device.
+    target_device="",
     # parallelism (the reference's two knobs, plus TPU-native extensions)
     tpu_size=32,
     sequence_parallel=1,  # extension: size of the sequence-parallel mesh axis
@@ -387,6 +396,16 @@ class Config:
             raise ValueError(
                 f"unknown quant_dtype {self.quant_dtype!r}; this toolchain "
                 f"supports {sorted(QUANT_DTYPES)}")
+        self.target_device = str(self.target_device or "")
+        if self.target_device:
+            # a typoed device kind would silently skip the OOM-before-compile
+            # gate; surface it at config load (devices.py is a leaf import)
+            from .devices import known_kinds, resolve_device
+            if resolve_device(self.target_device) is None:
+                raise ValueError(
+                    f"unknown target_device {self.target_device!r}; known "
+                    f"kinds: {', '.join(known_kinds())} (or \"\" to skip "
+                    f"the HBM capacity gate)")
         if self.watchdog_factor < 0:
             raise ValueError("watchdog_factor must be >= 0 "
                              "(0 = watchdog disabled)")

@@ -1,0 +1,67 @@
+"""Per-topology device constants for the static cost model (analysis/).
+
+One small table, deliberately approximate: public per-chip HBM capacity,
+HBM bandwidth, and ICI (inter-chip interconnect) bandwidth per mesh
+direction, plus a per-collective latency constant for the alpha-beta
+estimate.  The numbers exist so "does this config fit / what is it bound
+by" can be answered BEFORE a ~2-minute TPU compile; they are calibrated
+against measured ``memory_stats()`` peaks and XLA cost analysis by
+bench.py's ``resources`` validation hook (``prediction_error`` rides the
+BENCH trajectory), and tightened as that data accrues.
+
+This module is a LEAF — no package imports — so ``config.py`` can validate
+the ``target_device`` knob and ``analysis/cost_model.py`` can price a graph
+without import cycles.  Peak FLOP/s stays in ``train/flops.py::PEAK_BF16``
+(the live-MFU source of truth); ``tests/graftcost_test.py`` pins that every
+kind here resolves there too.
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    kind: str  # canonical name, matched as a substring of device_kind
+    hbm_bytes: int  # per-chip HBM capacity
+    hbm_bw: float  # per-chip HBM bandwidth, bytes/s
+    ici_bw: float  # per-link ICI bandwidth, bytes/s (one mesh direction)
+    alpha_s: float = 1e-6  # per-collective launch/hop latency (alpha term)
+
+
+_GIB = 1024 ** 3
+
+#: Ordered like train/flops.py::PEAK_BF16 — more specific substrings first.
+#: Sources: public TPU system specs; ici_bw is the per-direction figure the
+#: alpha-beta model charges each mesh axis independently.
+DEVICE_TABLE: typing.Tuple[DeviceSpec, ...] = (
+    DeviceSpec("v6e", 32 * _GIB, 1640e9, 448e9),
+    DeviceSpec("trillium", 32 * _GIB, 1640e9, 448e9),
+    DeviceSpec("v5p", 95 * _GIB, 2765e9, 600e9),
+    DeviceSpec("v5e", 16 * _GIB, 819e9, 200e9),
+    DeviceSpec("v5 lite", 16 * _GIB, 819e9, 200e9),
+    DeviceSpec("v5litepod", 16 * _GIB, 819e9, 200e9),
+    DeviceSpec("v5", 95 * _GIB, 2765e9, 600e9),
+    DeviceSpec("v4", 32 * _GIB, 1228e9, 300e9),
+    DeviceSpec("v3", 32 * _GIB, 900e9, 162e9),
+    DeviceSpec("v2", 16 * _GIB, 700e9, 62e9),
+)
+
+#: kinds tools/graftcost.py sweeps by default (one per HBM class)
+SWEEP_KINDS = ("v5e", "v4", "v5p")
+
+
+def resolve_device(kind: str) -> typing.Optional[DeviceSpec]:
+    """Spec for a device kind (substring match, like
+    ``train/flops.py::peak_flops``); None for CPU/unknown — no capacity or
+    bandwidth claims are made there."""
+    k = kind.lower()
+    for spec in DEVICE_TABLE:
+        if spec.kind in k:
+            return spec
+    return None
+
+
+def known_kinds() -> typing.Tuple[str, ...]:
+    return tuple(s.kind for s in DEVICE_TABLE)

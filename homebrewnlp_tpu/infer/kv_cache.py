@@ -90,11 +90,15 @@ def _decode_logits(cfg: Config, params: dict, row: jnp.ndarray,
     return out.token_out.x, dc.caches
 
 
-def init_caches(cfg: Config, params: dict, batch_size: int,
-                seq: typing.Optional[int] = None
-                ) -> typing.Dict[str, tuple]:
-    """Zeroed cache pytree, discovered by abstract evaluation of one decode
-    step (no FLOPs run)."""
+def cache_shapes(cfg: Config, params: dict, batch_size: int,
+                 seq: typing.Optional[int] = None
+                 ) -> typing.Dict[str, tuple]:
+    """Abstract per-layer cache shapes (``{layer: (ShapeDtypeStruct, ...)}``)
+    for a ``batch_size`` x ``seq`` decode, discovered by abstract evaluation
+    of one decode step — no FLOPs run and no memory allocated, so the static
+    cost model (analysis/cost_model.py) prices serving KV HBM for any
+    batch x context point without touching a device.  ``params`` may be
+    ShapeDtypeStructs."""
     seq = cfg.sequence_length // cfg.token_patch_size if seq is None else seq
     names = ("batch", SEQUENCE, "language_token_patch")
     row = jax.ShapeDtypeStruct((batch_size, 1, cfg.token_patch_size), jnp.int32)
@@ -103,7 +107,30 @@ def init_caches(cfg: Config, params: dict, batch_size: int,
         return _decode_logits(cfg, params, jnp.zeros(row.shape, row.dtype),
                               jnp.int32(0), {}, seq, names)[1]
 
-    shapes = jax.eval_shape(probe, params)
+    return jax.eval_shape(probe, params)
+
+
+def cache_nbytes(shapes: typing.Dict[str, tuple]) -> int:
+    """Total bytes of a cache pytree from :func:`cache_shapes` — the
+    KV-cache term of the per-device HBM prediction (caches follow the
+    batch's data sharding, so divide by the data-axis size separately)."""
+    import numpy as np
+    total = 0
+    for kv in shapes.values():
+        for s in kv:
+            n = 1
+            for d in s.shape:
+                n *= int(d)
+            total += n * np.dtype(s.dtype).itemsize
+    return int(total)
+
+
+def init_caches(cfg: Config, params: dict, batch_size: int,
+                seq: typing.Optional[int] = None
+                ) -> typing.Dict[str, tuple]:
+    """Zeroed cache pytree, discovered by abstract evaluation of one decode
+    step (no FLOPs run)."""
+    shapes = cache_shapes(cfg, params, batch_size, seq)
     return {k: tuple(jnp.zeros(s.shape, s.dtype) for s in kv)
             for k, kv in shapes.items()}
 

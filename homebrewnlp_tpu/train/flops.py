@@ -46,6 +46,64 @@ def peak_flops(device_kind: str) -> typing.Optional[float]:
     return None
 
 
+def eqn_dot_flops(eqn) -> float:
+    """Multiply-add flops of one ``dot_general`` equation from its abstract
+    operand shapes (2 * batch * M * N * K), zero for anything else."""
+    if eqn.primitive.name != "dot_general":
+        return 0.0
+    try:
+        (contract, batch_dims) = eqn.params["dimension_numbers"]
+        (lc, rc), (lb, _rb) = contract, batch_dims
+        lhs = eqn.invars[0].aval.shape
+        rhs = eqn.invars[1].aval.shape
+    except Exception:
+        return 0.0
+    k = 1
+    for d in lc:
+        k *= int(lhs[d])
+    b = 1
+    for d in lb:
+        b *= int(lhs[d])
+    m = 1
+    for i, d in enumerate(lhs):
+        if i not in lc and i not in lb:
+            m *= int(d)
+    n = 1
+    for i, d in enumerate(rhs):
+        if i not in rc and i not in (_rb or ()):
+            n *= int(d)
+    return 2.0 * b * m * n * k
+
+
+def jaxpr_flops(jaxpr) -> float:
+    """Static matmul-flop count of a (Closed)Jaxpr — the compile-free twin
+    of the XLA cost analysis ``step_flops`` runs on the compiled step, used
+    by the analysis cost model's roofline verdict (analysis/cost_model.py).
+
+    ``dot_general`` dominates every workload here; elementwise/conv flops
+    are ignored (they are noise next to the matmuls and XLA fuses them into
+    the dots' memory traffic anyway).  Sub-jaxprs multiply by their trip
+    count: ``scan`` bodies by ``length`` (gradient accumulation, pipeline
+    ticks), everything else (pjit/custom_vjp/checkpoint/while/cond) by 1 —
+    a ``while`` with an unknowable trip count undercounts, which keeps the
+    figure a lower bound like the unfused-twin convention above."""
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    total = 0.0
+    for eqn in inner.eqns:
+        total += eqn_dot_flops(eqn)
+        mult = 1
+        if eqn.primitive.name == "scan":
+            mult = int(eqn.params.get("length", 1) or 1)
+        for v in eqn.params.values():
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for item in vals:
+                if hasattr(item, "eqns") or (
+                        hasattr(item, "jaxpr")
+                        and hasattr(item.jaxpr, "eqns")):
+                    total += mult * jaxpr_flops(item)
+    return total
+
+
 def step_flops(trainer, state, batch) -> float:
     """EXECUTED flops of the exact compiled train step (XLA cost analysis,
     same figure bench.py records as ``flops_per_step``).  The AOT executable

@@ -91,8 +91,9 @@ def test_trace_tiny_config_train_and_decode(eight_devices):
     census = graph_rules.census_of(traces.steps["train"])
     assert census["n_eqns"] > 0
     # clean tree: donation + dtype + sharding + const rules all quiet
+    # (golden-backed rules excluded: the ad-hoc "tiny" config has none)
     findings = [f for f in graph_rules.run_graph_rules(traces)
-                if f.rule != "collective-census"]
+                if f.rule not in ("collective-census", "resource-budget")]
     errors = [f for f in findings if f.severity == "error"]
     assert not errors, [f.render() for f in errors]
 
@@ -697,3 +698,120 @@ def test_ast_bare_io_repo_is_clean():
     path I/O call routes through reliability.retry or data/fs.py."""
     assert ast_rules.bare_io_counts(REPO) == {}
     assert json.load(open(ast_rules.bare_io_golden_path())) == {}
+
+
+# -- trace_compat shims (ISSUE 7 satellite) ----------------------------------
+
+def test_trace_compat_uninstalls_after_midcontext_raise():
+    """The trace-only jax API shims must be gone after an exception inside
+    the context — a half-traced config must never leave patched jax state
+    behind for the rest of the process."""
+    before = {name: (hasattr(obj, name), getattr(obj, name, None))
+              for obj, name in ((jax, "shard_map"), (jax.lax, "pcast"),
+                                (jax, "typeof"),
+                                (jax.sharding, "get_abstract_mesh"))}
+    with pytest.raises(RuntimeError, match="boom"):
+        with atrace.trace_compat():
+            # inside the context every shimmed surface exists
+            assert hasattr(jax, "shard_map")
+            assert hasattr(jax.lax, "pcast")
+            assert hasattr(jax, "typeof")
+            assert hasattr(jax.sharding, "get_abstract_mesh")
+            raise RuntimeError("boom")
+    for (obj, name), (had, val) in zip(
+            ((jax, "shard_map"), (jax.lax, "pcast"), (jax, "typeof"),
+             (jax.sharding, "get_abstract_mesh")), before.values()):
+        assert hasattr(obj, name) == had, name
+        if had:
+            assert getattr(obj, name) is val, name
+
+
+def test_collective_prims_cover_both_toolchain_spellings():
+    """Census normalization: the typed-shard_map toolchain spellings and the
+    legacy ones both land on one census family."""
+    P = atrace.COLLECTIVE_PRIMS
+    assert P["psum"] == P["psum2"] == P["psum_invariant"] == "psum"
+    assert P["all_gather"] == P["all_gather_invariant"] == "all_gather"
+    assert P["reduce_scatter"] == P["psum_scatter"] == "reduce_scatter"
+
+
+# -- golden-coverage gate (ISSUE 7 satellite) --------------------------------
+
+def test_golden_coverage_gate_detects_missing_and_orphans():
+    import glob as _glob
+    from homebrewnlp_tpu.analysis import check_golden_coverage
+    names = [os.path.splitext(os.path.basename(p))[0] for p in
+             _glob.glob(os.path.join(REPO, "configs", "*.json"))]
+    # the committed tree is fully covered
+    assert check_golden_coverage(names) == []
+    # a brand-new config without goldens is an ERROR for census AND resources
+    findings = check_golden_coverage(names + ["brand_new_config"])
+    errs = [f for f in findings if f.severity == "error"]
+    assert len(errs) == 2 and all("brand_new_config" in f.location
+                                  for f in errs)
+    assert {("census" in f.message, "resources" in f.message)
+            for f in errs} == {(True, False), (False, True)}
+    # a golden whose config was deleted is an orphan warning
+    findings = check_golden_coverage(names[1:])
+    orphans = [f for f in findings if f.severity == "warning"]
+    assert len(orphans) == 2 and all(names[0] in f.location for f in orphans)
+
+
+# -- CLI exit status (ISSUE 7 satellite) -------------------------------------
+
+def test_cli_exit_codes_and_severity_summary(tmp_path):
+    """Warnings-only runs exit 0 (1 only under --strict), error runs exit 1,
+    and the findings-by-severity summary line prints in every mode."""
+    cfg = dict(model_mode="gpt", use_video=False, sequence_length=16,
+               features_per_head=16, heads=2, depth=1, vocab_size=64,
+               train_batch_size=2, tpu_size=1,
+               memory_reduction_strategy="none",
+               intermediate_feed_forward_multiplier_multiplier=0.5,
+               block_config=[{"layer": ["norm-shift-scale",
+                                        "feed_forward-in:relu"]}])
+    path = tmp_path / "tmpnew.json"
+    path.write_text(json.dumps(cfg))
+    base = [sys.executable, os.path.join(REPO, "tools/graftcheck.py"),
+            "--config", str(path), "--graph-only"]
+    # no goldens for a brand-new config -> census error -> exit 1
+    proc = subprocess.run(base + ["--rules", "collective-census"],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "1 error(s)" in proc.stderr and "exit 1" in proc.stderr
+    # an eval-only trace is unpinned by the golden -> warnings only -> 0
+    warn = [sys.executable, os.path.join(REPO, "tools/graftcheck.py"),
+            "--config", os.path.join(REPO, "configs", "bpe65k_1chip.json"),
+            "--graph-only", "--steps", "eval",
+            "--rules", "collective-census"]
+    proc = subprocess.run(warn, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 error(s)" in proc.stderr and "exit 0" in proc.stderr
+    assert "warning(s)" in proc.stderr
+    # --strict promotes those warnings to a failing exit
+    proc = subprocess.run(warn + ["--strict"], capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "--strict promotes warnings" in proc.stderr
+
+
+# -- resource-budget through the CLI (ISSUE 7) -------------------------------
+
+def test_cli_golden_coverage_requires_all_configs():
+    """Explicitly requesting the tree-wide rule on a single config must
+    refuse (exit 2), not silently skip it and report a clean pass."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftcheck.py"),
+         "--config", os.path.join(REPO, "configs", "bpe65k_1chip.json"),
+         "--rules", "golden-coverage"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "requires --all-configs" in proc.stderr
+
+
+def test_cli_resource_budget_rule_selectable():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftcheck.py"),
+         "--list-rules"], capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0
+    assert "resource-budget" in proc.stdout
+    assert "golden-coverage" in proc.stdout

@@ -14,7 +14,9 @@ Usage:
   python tools/graftcheck.py --config configs/x.json  # one config
   python tools/graftcheck.py --ast-only               # source lint only
   python tools/graftcheck.py --all-configs --update-goldens
-Exit code: 1 if any ERROR finding (or any WARNING under --strict), else 0.
+Exit code: 1 if any ERROR finding (or any WARNING under --strict), 0 on
+warnings-only/clean runs, 2 on usage errors; a findings-by-severity summary
+line always prints to stderr.
 """
 import argparse
 import glob
@@ -45,7 +47,8 @@ def parse_args(argv=None):
     p.add_argument("--graph-only", action="store_true",
                    help="skip the AST rules")
     p.add_argument("--steps", default="train,decode",
-                   help="comma list of steps to trace (train,eval,decode)")
+                   help="comma list of steps to trace "
+                        "(train,eval,decode,prefill)")
     p.add_argument("--rules", default=None,
                    help="comma list restricting which rules run")
     p.add_argument("--update-goldens", action="store_true",
@@ -66,6 +69,8 @@ def main(argv=None) -> int:
             print(f"graph  {r}")
         for r in analysis.AST_RULES:
             print(f"ast    {r}")
+        for r in analysis.TREE_RULES:
+            print(f"tree   {r}")
         return 0
     rules = None
     if args.rules:
@@ -75,11 +80,18 @@ def main(argv=None) -> int:
             print(f"unknown rule(s) {', '.join(unknown)}; valid: "
                   f"{', '.join(analysis.ALL_RULES)}", file=sys.stderr)
             return 2
+    if rules is not None and "golden-coverage" in rules \
+            and not args.all_configs:
+        # tree-wide rule: without --all-configs it would silently not run
+        # and report a clean exit — refuse instead of false-passing
+        print("golden-coverage is a tree-wide rule; it requires "
+              "--all-configs", file=sys.stderr)
+        return 2
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
-    unknown_steps = sorted(set(steps) - {"train", "eval", "decode"})
+    unknown_steps = sorted(set(steps) - {"train", "eval", "decode", "prefill"})
     if unknown_steps:
         print(f"unknown step(s) {', '.join(unknown_steps)}; valid: "
-              f"train, eval, decode", file=sys.stderr)
+              f"train, eval, decode, prefill", file=sys.stderr)
         return 2
     config_paths = list(args.config)
     if args.all_configs:
@@ -114,6 +126,16 @@ def main(argv=None) -> int:
                 print(f"[graftcheck] {name}: "
                       f"{', '.join(sorted(traces.steps)) or 'no steps'} "
                       f"({time.time() - t1:.1f}s)", file=sys.stderr)
+    if args.all_configs and (rules is None or "golden-coverage" in rules):
+        # tree-wide coverage gate: every bundled config must carry both a
+        # census and a resources golden (a new config silently skipping
+        # its budgets was satellite bug #1), and no golden may outlive its
+        # config.  Needs no tracing, so it runs under --ast-only too; on
+        # graph runs it runs AFTER --update-goldens wrote files.
+        names = [os.path.splitext(os.path.basename(p))[0]
+                 for p in sorted(glob.glob(
+                     os.path.join(REPO, "configs", "*.json")))]
+        findings.extend(analysis.check_golden_coverage(names))
     if not args.graph_only:
         # the AST ratchet golden is tree-wide: only re-record it on a
         # tree-wide run (--all-configs / --ast-only), never as a side effect
@@ -125,10 +147,19 @@ def main(argv=None) -> int:
     print(analysis.render_report(findings, as_json=args.as_json))
     if not args.as_json:
         print(f"[graftcheck] total {time.time() - t0:.1f}s", file=sys.stderr)
-    worst = analysis.worst_severity(findings)
-    if worst == "error" or (args.strict and worst == "warning"):
-        return 1
-    return 0
+    # exit status by explicit severity COUNTS, not worst_severity string
+    # compare: errors -> 1, warnings-only -> 0 (1 only under --strict),
+    # clean/info -> 0.  The findings-by-severity summary prints to stderr in
+    # every mode (the JSON report on stdout stays machine-parseable).
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = sum(1 for f in findings if f.severity == "warning")
+    n_info = len(findings) - n_err - n_warn
+    rc = 1 if n_err or (args.strict and n_warn) else 0
+    print(f"[graftcheck] findings: {n_err} error(s), {n_warn} warning(s), "
+          f"{n_info} info -> exit {rc}"
+          + (" (--strict promotes warnings)" if args.strict and not n_err
+             and n_warn else ""), file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

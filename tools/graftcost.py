@@ -1,0 +1,262 @@
+#!/usr/bin/env python
+"""graftcost: static resource sheet for a config — no TPU, no XLA compile.
+
+Prints, per config x traced step (train / decode / prefill), the cost
+model's predictions (analysis/cost_model.py): per-device peak HBM broken
+into params / optimizer slots / batch / KV cache / activation live-set,
+collective payload bytes per mesh axis with an alpha-beta time estimate,
+the static matmul flop count, and the roofline verdict (MXU- vs HBM- vs
+ICI-bound) — then whether the config fits each device kind's HBM.
+
+``--sweep`` answers the long-context / serving planning questions without
+re-tracing: one traced anchor is classified into batch/sequence scaling
+components (analysis/memory.py), so sweeping context 1k -> 128k is
+arithmetic and the whole run takes seconds on a laptop CPU.
+
+Usage:
+  python tools/graftcost.py --config configs/32ctx_mixer.json
+  python tools/graftcost.py --all-configs
+  python tools/graftcost.py --config configs/32ctx_mixer.json \
+      --sweep context=1024..131072
+  python tools/graftcost.py --config configs/32big_mixer.json \
+      --sweep batch=8..1024 --devices v5e,v4,v5p
+  python tools/graftcost.py --config configs/x.json --json
+
+Exit code: 0 (informational; the enforcing gate is graftcheck's
+resource-budget rule), 2 on usage errors.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# same virtual mesh as graftcheck/tests so predictions are reproducible
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--config", action="append", default=[],
+                   help="config JSON to price (repeatable)")
+    p.add_argument("--all-configs", action="store_true")
+    p.add_argument("--steps", default="train,decode,prefill",
+                   help="comma list of steps (train,eval,decode,prefill)")
+    p.add_argument("--devices", default="v5e,v4,v5p",
+                   help="comma list of device kinds for fit checks / sweeps")
+    p.add_argument("--sweep", default="",
+                   help="'context=LO..HI' or 'batch=LO..HI' — geometric x2 "
+                        "sweep from one traced anchor")
+    p.add_argument("--sweep-step", default="",
+                   help="restrict the sweep to one step (default: all)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    return p.parse_args(argv)
+
+
+def fmt_bytes(b: float) -> str:
+    from homebrewnlp_tpu.analysis.cost_model import format_bytes
+    return format_bytes(b, width=7)
+
+
+def parse_sweep(spec: str):
+    """'context=1024..131072' -> ('context', [1024, 2048, ..., 131072])."""
+    key, _, rng = spec.partition("=")
+    key = key.strip()
+    if key not in ("context", "batch") or ".." not in rng:
+        raise ValueError(
+            f"bad --sweep {spec!r}; expected context=LO..HI or batch=LO..HI")
+    lo_s, _, hi_s = rng.partition("..")
+    lo, hi = int(lo_s), int(hi_s)
+    if lo <= 0 or hi < lo:
+        raise ValueError(f"bad --sweep range {rng!r}")
+    points, v = [], lo
+    while v < hi:
+        points.append(v)
+        v *= 2
+    points.append(hi)
+    return key, points
+
+
+def sheet(traces, devices, as_json: bool):
+    """One config's resource sheet (returns the JSON-able dict)."""
+    from homebrewnlp_tpu.analysis import cost_model
+    from homebrewnlp_tpu.devices import resolve_device
+    res = cost_model.config_resources(traces)
+    imesh = cost_model._imesh_shape(traces)
+    out = {"config": traces.config_name, "intended_mesh": imesh,
+           "target_device": getattr(traces.cfg, "target_device", ""),
+           "steps": {}, "fits": {}, "errors": dict(traces.errors)}
+    for step, r in res.items():
+        row = r.as_golden()
+        row["hbm_traffic_bytes"] = r.hbm_traffic_bytes
+        row["verdict_device"] = r.verdict_device
+        spec = resolve_device(r.verdict_device)
+        if spec is not None:
+            row["ici_time_s_per_axis"] = {
+                k: round(v, 6)
+                for k, v in r.comm.times(imesh, spec).items()}
+        out["steps"][step] = row
+    for kind in devices:
+        spec = resolve_device(kind)
+        if spec is None:
+            continue
+        out["fits"][kind] = {
+            step: bool(r.hbm["peak"] <= spec.hbm_bytes)
+            for step, r in res.items()}
+    if not as_json:
+        mesh_s = " ".join(f"{k}{v}" for k, v in imesh.items() if v > 1) or "1chip"
+        print(f"\n== {traces.config_name}  (intended mesh: {mesh_s})"
+              + (f"  target={out['target_device']}" if out["target_device"]
+                 else ""))
+        for step, r in res.items():
+            h = r.hbm
+            print(f"  {step:8s} peak {fmt_bytes(h['peak'])}/dev  = params "
+                  f"{fmt_bytes(h['params'])} + slots "
+                  f"{fmt_bytes(h.get('opt_slots', 0))} + batch "
+                  f"{fmt_bytes(h.get('batch', 0))} + kv "
+                  f"{fmt_bytes(h['kv_cache'])} + act "
+                  f"{fmt_bytes(h['activation_peak'])}   "
+                  f"[{r.verdict}-bound on {r.verdict_device}]")
+            if r.comm.bytes_per_axis:
+                axes = ", ".join(
+                    f"{ax}: {fmt_bytes(b).strip()}"
+                    for ax, b in sorted(r.comm.bytes_per_axis.items()))
+                print(f"           collectives/axis: {axes}")
+        for kind, fits in out["fits"].items():
+            verdict = " ".join(f"{s}:{'fits' if ok else 'OOM'}"
+                               for s, ok in fits.items())
+            print(f"           {kind:5s} -> {verdict}")
+        for step, err in traces.errors.items():
+            print(f"  {step:8s} trace failed: {err}")
+    return out
+
+
+def sweep(traces, devices, key, points, only_step, as_json: bool):
+    from homebrewnlp_tpu.analysis import cost_model
+    from homebrewnlp_tpu.devices import resolve_device
+    model = cost_model.build_sweep_model(traces)
+    out = {"config": traces.config_name, "sweep": key, "points": points,
+           "anchor": {"batch": model.anchor_batch,
+                      "context": model.anchor_seq},
+           "ambiguous_anchor": model.ambiguous, "steps": {}}
+    steps = [only_step] if only_step else sorted(model.steps)
+    for step in steps:
+        if step not in model.steps:
+            # a valid-but-untraced step (e.g. decode on a video config)
+            # must say so, not vanish into an empty sweep
+            print(f"[graftcost] {traces.config_name}: step {step!r} not "
+                  f"traced"
+                  + (f" ({traces.errors[step]})" if step in traces.errors
+                     else "") + " — no sweep rows", file=sys.stderr)
+            continue
+        rows = {}
+        for v in points:
+            kw = {"context": v} if key == "context" else {"batch": v}
+            rows[v] = model.peak_at(step, **kw)
+        srow = {"peaks": {v: int(r["peak"]) for v, r in rows.items()},
+                "first_exceeding": {}}
+        for kind in devices:
+            spec = resolve_device(kind)
+            if spec is None:
+                continue
+            srow["first_exceeding"][kind] = cost_model.first_exceeding(
+                model, step, spec, points, key)
+        out["steps"][step] = srow
+        if not as_json:
+            print(f"\n-- {traces.config_name} [{step}] sweep {key} "
+                  f"(anchor batch={model.anchor_batch} "
+                  f"context={model.anchor_seq}"
+                  + (", AMBIGUOUS anchor: batch == context" if model.ambiguous
+                     else "") + ")")
+            for v in points:
+                r = rows[v]
+                print(f"  {key}={v:<8d} peak {fmt_bytes(r['peak'])}/dev  "
+                      f"(kv {fmt_bytes(r.get('kv_cache', 0))}, act "
+                      f"{fmt_bytes(r.get('activation_peak', 0))})")
+            for kind, first in srow["first_exceeding"].items():
+                spec = resolve_device(kind)
+                print(f"  {kind:5s} ({fmt_bytes(spec.hbm_bytes).strip()}): "
+                      + (f"first {key} exceeding HBM = {first}" if first
+                         else f"fits at every swept {key}"))
+    return out
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    config_paths = list(args.config)
+    if args.all_configs:
+        config_paths += sorted(glob.glob(os.path.join(REPO, "configs",
+                                                      "*.json")))
+    if not config_paths:
+        print("nothing to do: pass --config or --all-configs",
+              file=sys.stderr)
+        return 2
+    try:
+        sweep_spec = parse_sweep(args.sweep) if args.sweep else None
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    devices = [d.strip() for d in args.devices.split(",") if d.strip()]
+    steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
+    valid_steps = {"train", "eval", "decode", "prefill"}
+    unknown = sorted(set(steps) - valid_steps)
+    if args.sweep_step and args.sweep_step not in valid_steps:
+        unknown.append(args.sweep_step)
+    if unknown:
+        # a typoed step would otherwise trace nothing and print an empty
+        # sheet with exit 0 — same validation contract as graftcheck
+        print(f"unknown step(s) {', '.join(unknown)}; valid: "
+              f"{', '.join(sorted(valid_steps))}", file=sys.stderr)
+        return 2
+
+    import contextlib
+
+    from homebrewnlp_tpu.config import Config
+    from homebrewnlp_tpu.analysis import trace_config
+    results = []
+    t0 = time.time()
+    # under --json, config/mesh WARNING prints must not corrupt the
+    # machine-readable stdout stream
+    quiet = (contextlib.redirect_stdout(sys.stderr) if args.as_json
+             else contextlib.nullcontext())
+    for path in config_paths:
+        name = os.path.splitext(os.path.basename(path))[0]
+        with open(path) as f:
+            raw = json.load(f)
+        raw.pop("_comment", None)
+        with quiet:
+            try:
+                cfg = Config(raw)
+            except Exception as e:
+                results.append({"config": name,
+                                "error": f"{type(e).__name__}: {e}"})
+                continue
+            traces = trace_config(cfg, name, steps=steps)
+            if sweep_spec is not None:
+                results.append(sweep(traces, devices, sweep_spec[0],
+                                     sweep_spec[1], args.sweep_step,
+                                     args.as_json))
+            else:
+                results.append(sheet(traces, devices, args.as_json))
+    if args.as_json:
+        print(json.dumps(results, indent=2))
+    else:
+        print(f"\n[graftcost] total {time.time() - t0:.1f}s", file=sys.stderr)
+    if args.sweep_step and not any(r.get("steps") for r in results):
+        # an explicitly requested sweep step that no config traced is an
+        # empty answer, not a clean one
+        print(f"[graftcost] --sweep-step {args.sweep_step}: no config "
+              f"traced that step", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
