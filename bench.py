@@ -76,11 +76,31 @@ figures, with ``prediction_error`` riding the BENCH trajectory so the
 per-topology constants table (homebrewnlp_tpu/devices.py) is calibrated by
 every TPU round.
 
+The graftprof PR (ISSUE 8) adds a per-workload ``profile`` sub-dict: each
+row auto-arms a ``jax.profiler`` window over ``HBNLP_BENCH_PROFILE_STEPS``
+(default 5) steady-state steps — no hand-set ``profile_start`` needed —
+and attributes the captured device time (obs/profile.py,
+docs/observability.md "Profile attribution"): an ``ms_per_step``
+decomposition into mxu + hbm + comm + idle, top-K ops, per-scope ms, the
+comm fraction, and a ``reconcile`` block comparing each measured component
+against graftcost's static alpha-beta / roofline estimate
+(per-component ``prediction_error`` — how the constants table in
+homebrewnlp_tpu/devices.py gets calibrated for *time*, the way the
+``resources`` hook calibrates it for bytes).  Attribution drift is gated
+by the committed per-device-kind baseline ``bench_profile_baseline.json``
+(same shape + self-record semantics as the compile ratchet): any
+decomposition fraction moving more than 0.15 absolute, or scope coverage
+dropping more than 0.15, fails the row's ``baseline`` and the top-level
+``profile_ok``.  The probe skips cleanly when the toolchain never writes
+the profiler plugin directory.
+
 Env knobs (development / partial runs): ``HBNLP_BENCH_WORKLOADS`` is a
 comma list or ``all`` (default); ``HBNLP_BENCH_GUARD_STEPS`` overrides the
 guard length (0 disables); ``HBNLP_BENCH_QUANT=0`` skips the quant probe,
 ``HBNLP_BENCH_QUANT_DTYPE``/``_STEPS``/``_TOL`` tune it;
-``HBNLP_BENCH_RESOURCES=0`` skips the cost-model prediction hook.
+``HBNLP_BENCH_RESOURCES=0`` skips the cost-model prediction hook;
+``HBNLP_BENCH_PROFILE=0`` skips the profile probe,
+``HBNLP_BENCH_PROFILE_STEPS`` sizes its window.
 """
 from __future__ import annotations
 
@@ -100,6 +120,13 @@ COMPILE_BASELINE_FILE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "bench_compile_baseline.json")
 #: tolerated compile_and_warmup_s ratio vs the committed budget
 COMPILE_BUDGET_RATIO = 1.2
+# committed per-device-kind device-time attribution baseline (graftprof):
+# category fractions + scope coverage per workload; drift past the
+# tolerance fails the row's profile baseline and the line's profile_ok
+PROFILE_BASELINE_FILE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_profile_baseline.json")
+#: steps in the per-workload profile capture window
+PROFILE_PROBE_STEPS = int(os.environ.get("HBNLP_BENCH_PROFILE_STEPS", "5"))
 
 # Peak table + MFU arithmetic shared with the LIVE utilization accounting
 # (homebrewnlp_tpu/train/flops.py): bench's offline mfu and the run's
@@ -316,6 +343,19 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
     # previously dropped the whole prediction-vs-measured comparison row
     # (ISSUE 7 satellite).  None on backends without memory_stats (CPU).
     row["hbm_peak_bytes"] = _hbm_peak_bytes()
+
+    _res_cache: list = []
+
+    def static_train_resources():
+        # ONE abstract re-trace (seconds) shared by the resources and
+        # profile hooks below; lazy so either hook can be env-skipped
+        if not _res_cache:
+            from homebrewnlp_tpu.analysis import cost_model, trace_config
+            traces = trace_config(cfg, name, steps=("train",))
+            _res_cache.append(cost_model.config_resources(traces)
+                              .get("train"))
+        return _res_cache[0]
+
     # static cost-model validation hook (docs/static_analysis.md "Resource
     # cost model"): the predicted per-device peak next to the measured
     # memory_stats() peak and XLA's own memory analysis, so
@@ -324,9 +364,20 @@ def bench_workload(name: str, probe_loss: bool = False) -> dict:
     if os.environ.get("HBNLP_BENCH_RESOURCES", "1") != "0":
         try:
             row["resources"] = _resource_prediction(
-                name, cfg, trainer, row["hbm_peak_bytes"])
+                trainer, row["hbm_peak_bytes"], static_train_resources())
         except Exception as e:  # noqa: BLE001 - must not kill the line
             row["resources"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    # graftprof device-time attribution (module docstring; ISSUE 8): a
+    # short auto-armed profiler window over the live state, parsed into
+    # the ms_per_step decomposition + prediction_error vs graftcost.
+    # Steps through trainer.step donate-and-return `state`, so the probe
+    # hands the post-window state back for the probes below
+    if os.environ.get("HBNLP_BENCH_PROFILE", "1") != "0":
+        try:
+            row["profile"], state = _profile_probe(
+                name, cfg, trainer, state, batch, static_train_resources)
+        except Exception as e:  # noqa: BLE001 - must not kill the line
+            row["profile"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     if kernel_opaque:
         # flops_per_step is the unfused twin's LOWER BOUND (see above) —
         # the flags describe the flop count itself, peak table or not
@@ -412,13 +463,11 @@ def _hbm_peak_bytes():
         return None
 
 
-def _resource_prediction(name: str, cfg, trainer, measured_peak):
-    """Static cost-model prediction for the workload's exact config (one
-    abstract re-trace, seconds) + the compiled step's XLA memory analysis,
-    with ``prediction_error`` vs the measured device peak when available."""
-    from homebrewnlp_tpu.analysis import cost_model, trace_config
-    traces = trace_config(cfg, name, steps=("train",))
-    res = cost_model.config_resources(traces).get("train")
+def _resource_prediction(trainer, measured_peak, res):
+    """Static cost-model prediction for the workload's exact config
+    (``res`` = the shared ``static_train_resources()`` StepResources) +
+    the compiled step's XLA memory analysis, with ``prediction_error``
+    vs the measured device peak when available."""
     out = {}
     if res is not None:
         out["predicted_peak_bytes"] = int(res.hbm["peak"])
@@ -438,6 +487,83 @@ def _resource_prediction(name: str, cfg, trainer, measured_peak):
         out["prediction_error"] = round(
             out["predicted_peak_bytes"] / measured_peak - 1.0, 4)
     return out
+
+
+def _profile_probe(name: str, cfg, trainer, state, batch, static_res):
+    """One auto-armed capture window (docs/observability.md "Profile
+    attribution"): profile ``PROFILE_PROBE_STEPS`` steps of the workload's
+    live state, dump the AOT executable's op->scope sidecar, attribute the
+    device time, and reconcile the measured mxu/hbm/comm split against
+    graftcost's static estimate (``static_res`` = the shared lazy
+    ``static_train_resources`` callable).  Returns ``(profile row,
+    state)`` — the steps donate state buffers, so the caller must adopt
+    the new state; once the window has stepped, parse/attribution
+    failures are contained in the row's ``error`` field rather than
+    raised, so the donated-and-returned state is never lost to the
+    caller's except handler.  Skips cleanly (``skipped`` field) when the
+    toolchain writes no profiler plugin directory."""
+    import shutil
+    import tempfile
+
+    from homebrewnlp_tpu.obs import profile as profile_mod
+
+    n = PROFILE_PROBE_STEPS
+    rng = jax.random.key(5)
+    tmp = tempfile.mkdtemp(prefix=f"bench_prof_{name}_")
+    stepped = False
+    try:
+        try:
+            jax.profiler.start_trace(tmp)
+            try:
+                for i in range(n):
+                    state, metrics = trainer.step(state, batch,
+                                                  jax.random.fold_in(rng, i))
+                jax.block_until_ready(state)
+                stepped = True
+            finally:
+                jax.profiler.stop_trace()
+            profile_mod.write_op_map_for(trainer, tmp)
+            summary = profile_mod.capture_summary(tmp, n_steps=n)
+        except Exception as e:  # noqa: BLE001 - see docstring
+            if not stepped:
+                raise
+            return {"error": f"{type(e).__name__}: {e}"[:300]}, state
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if summary is None:
+        return {"skipped": "no profiler trace written "
+                           "(plugin directory absent)"}, state
+    steps = max(1, n)
+    scopes_ms = {k: round(v * 1e3 / steps, 4)
+                 for k, v in list(summary.scopes_s.items())[:8]}
+    row = {
+        "n_steps": n,
+        "ms_per_step": summary.decomposition_ms_per_step,
+        "fractions": summary.fractions,
+        "comm_fraction": summary.fractions.get("comm", 0.0),
+        "attributed_category_frac": summary.attributed_category_frac,
+        "attributed_scope_frac": summary.attributed_scope_frac,
+        "top_ops": summary.top_ops[:5],
+        "scopes_ms": scopes_ms,
+        "collectives_s": summary.collectives_s,
+    }
+    # measured vs graftcost static estimate — per-component
+    # prediction_error; null on CPU/unknown kinds, where the constants
+    # table makes no time claims
+    try:
+        from homebrewnlp_tpu.analysis import cost_model
+        from homebrewnlp_tpu.analysis.graph_rules import intended_mesh
+        res = static_res()
+        pred = None
+        kind = jax.devices()[0].device_kind
+        if res is not None:
+            pred = cost_model.step_static_times(
+                res, dict(intended_mesh(cfg).shape), kind)
+        row["reconcile"] = profile_mod.reconcile(summary, pred)
+        row["prediction_device"] = kind if pred is not None else None
+    except Exception as e:  # noqa: BLE001 - reconcile is best-effort
+        row["reconcile"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    return row, state
 
 
 def _telemetry_probe(name: str, trainer, state, batch, flops_base: float,
@@ -786,6 +912,30 @@ def main() -> None:
     for n, b in budget_rows.items():
         workloads[n]["compile_budget"] = b
 
+    # attribution-drift ratchet (graftprof): per-device-kind committed
+    # baseline of decomposition fractions + scope coverage, self-recorded
+    # on a workload's first successful capture (operator commits it, like
+    # the compile budget); after that, drift past the tolerance fails the
+    # row and the line's profile_ok
+    from homebrewnlp_tpu.obs.profile import (baseline_entry,
+                                             evaluate_profile_baseline)
+    prof_baselines = {}
+    if os.path.exists(PROFILE_BASELINE_FILE):
+        with open(PROFILE_BASELINE_FILE) as f:
+            prof_baselines = json.load(f)
+    dev_prof = prof_baselines.setdefault(device_kind, {})
+    new_prof = {n: baseline_entry(w["profile"]) for n, w in workloads.items()
+                if isinstance(w, dict) and isinstance(w.get("profile"), dict)
+                and "fractions" in w["profile"] and n not in dev_prof}
+    if new_prof:
+        dev_prof.update(new_prof)
+        with open(PROFILE_BASELINE_FILE, "w") as f:
+            json.dump(prof_baselines, f, indent=2, sort_keys=True)
+            f.write("\n")
+    prof_rows, profile_ok = evaluate_profile_baseline(workloads, dev_prof)
+    for n, b in prof_rows.items():
+        workloads[n]["profile"]["baseline"] = b
+
     record = {
         "metric": "tokens_per_sec_per_chip",
         # figure of record = the flagship's median-of-5 windows (continuity
@@ -811,6 +961,7 @@ def main() -> None:
         "device": device_kind,
         "n_chips": n_chips,
         "compile_ok": compile_ok,
+        "profile_ok": profile_ok,
         "workloads": workloads,
         "numerics_guard": guard,
     }

@@ -309,23 +309,52 @@ def step_resources(traces: ConfigTraces, step: str, st: StepTrace, imesh,
                          verdict_device=vdev, scaled=scaled)
 
 
+def static_step_times(flops_dev: float, traffic_bytes: float,
+                      comm: CommModel,
+                      imesh_shape: typing.Dict[str, int],
+                      device_kind: str
+                      ) -> typing.Optional[typing.Dict[str, typing.Any]]:
+    """Static per-step seconds on one device kind: ``mxu`` (flops / peak),
+    ``hbm`` (traffic proxy / bandwidth), ``ici`` (alpha-beta total, with
+    the per-axis split under ``ici_per_axis``).  None for CPU/unknown
+    kinds — no bandwidth claims are made there.  The ONE time model both
+    the roofline verdict and graftprof's measured-vs-predicted
+    reconciliation (obs/profile.py::reconcile) consume, so the two cannot
+    disagree about what graftcost predicted."""
+    from ..train.flops import peak_flops
+    spec = resolve_device(device_kind)
+    peak = peak_flops(device_kind)
+    if spec is None or not peak:
+        return None
+    per_axis = comm.times(dict(imesh_shape), spec)
+    return {"mxu": flops_dev / peak,
+            "hbm": traffic_bytes / spec.hbm_bw,
+            "ici": sum(per_axis.values()),
+            "ici_per_axis": per_axis}
+
+
+def step_static_times(res: "StepResources",
+                      imesh_shape: typing.Dict[str, int],
+                      device_kind: str
+                      ) -> typing.Optional[typing.Dict[str, typing.Any]]:
+    """:func:`static_step_times` over an already-built prediction."""
+    return static_step_times(res.flops_per_device, res.hbm_traffic_bytes,
+                             res.comm, imesh_shape, device_kind)
+
+
 def _roofline(cfg, flops_dev: float, traffic: float, comm: CommModel,
               imesh, device_kind: str = ""
               ) -> typing.Tuple[str, str]:
     """(verdict, device kind used).  MXU vs HBM vs ICI by which static time
     estimate dominates on the target (or default-verdict) device."""
-    from ..train.flops import peak_flops
     kind = device_kind or getattr(cfg, "target_device", "") \
         or DEFAULT_VERDICT_DEVICE
-    spec = resolve_device(kind)
-    peak = peak_flops(kind)
-    if spec is None or not peak:
+    times = static_step_times(flops_dev, traffic, comm, dict(imesh.shape),
+                              kind)
+    if times is None:
         return "unknown", kind
-    t_mxu = flops_dev / peak
-    t_hbm = traffic / spec.hbm_bw
-    t_ici = sum(comm.times(dict(imesh.shape), spec).values())
-    times = {"mxu": t_mxu, "hbm": t_hbm, "ici": t_ici}
-    return max(times, key=times.get), kind
+    ranked = {k: times[k] for k in ("mxu", "hbm", "ici")}
+    return max(ranked, key=ranked.get), kind
 
 
 def config_resources(traces: ConfigTraces, device_kind: str = ""

@@ -121,6 +121,46 @@ def train(cfg, args) -> None:
         raise SystemExit(EXIT_PREEMPTED)
 
 
+def _finalize_profile(cfg, args, trainer, obs) -> None:
+    """graftprof post-processing of a just-stopped ``--profile`` capture
+    (docs/observability.md "Profile attribution"): dump the HLO op->scope
+    sidecar from the kept AOT step executable, parse the Chrome trace into
+    a category/scope attribution summary, persist it as
+    ``<model_path>/profile_summary.json`` (the watchdog stall dump inlines
+    it, ``tools/graftprof.py`` renders it), and feed the live exporter
+    (``hbnlp_step_time_ms`` + per-category fractions on /metrics, comm
+    fraction on /healthz).  Best-effort end to end: a malformed or absent
+    trace (some toolchains never write the plugin directory) degrades to a
+    log line, never an exception — the training result is already in."""
+    from .obs import profile as profile_mod
+    from .train import color_print
+    try:
+        profile_mod.write_op_map_for(trainer, args.profile)
+        summary = profile_mod.capture_summary(args.profile,
+                                              n_steps=cfg.profile_steps)
+    except Exception as e:  # noqa: BLE001 - never fail the run for this
+        color_print(f"graftprof summary failed: {type(e).__name__}: {e}")
+        return
+    if summary is None:
+        color_print(f"no profiler trace found under {args.profile} "
+                    "(plugin directory absent); skipping graftprof summary")
+        return
+    try:
+        path = summary.save(os.path.join(cfg.model_path,
+                                         "profile_summary.json"))
+        d = summary.decomposition_ms_per_step
+        color_print(
+            f"graftprof: {d.get('total', 0.0):.3f} ms/step = "
+            f"mxu {d.get('mxu', 0.0):.3f} + hbm {d.get('hbm', 0.0):.3f} + "
+            f"comm {d.get('comm', 0.0):.3f} + idle {d.get('idle', 0.0):.3f} "
+            f"(scope coverage {summary.attributed_scope_frac:.0%}) -> {path}")
+    except Exception as e:  # noqa: BLE001
+        color_print(f"graftprof summary write failed: {e}")
+        return
+    if obs.enabled:
+        obs.record_profile(summary)
+
+
 def _train_loop(cfg, args, obs, grace) -> None:
     """Async-dispatch step loop (docs/performance.md): step indices are
     computed ON HOST (``step0 + (u - u0) * m`` — no device value is read on
@@ -209,6 +249,19 @@ def _train_loop(cfg, args, obs, grace) -> None:
         color_print(f"device telemetry on: {util.flops_per_step:.3e} "
                     f"flops/step ({util.device_kind}), anomaly_policy="
                     f"{cfg.anomaly_policy}")
+    if args.profile and trainer._compiled is None:
+        # graftprof attribution (docs/observability.md "Profile
+        # attribution") needs the step executable's HLO metadata to map
+        # trace events back to model scopes: AOT-compile now (the loop
+        # reuses the kept executable, so this is the same compile the
+        # first step would have paid — not an extra one) and the op-map
+        # sidecar below comes for free.  Best-effort: a failing AOT path
+        # only degrades per-scope attribution, never the run.
+        try:
+            trainer.step_cost_analysis(state, template_gb)
+        except Exception as e:
+            color_print(f"profile op-map pre-compile failed ({e}); "
+                        "per-scope attribution will be unavailable")
     del template_gb  # release the init batch's device buffers for the run
     # deferred metrics drain: debug_train_step keeps the reference's
     # synchronous per-step prints, so it forces the window to 0
@@ -329,6 +382,7 @@ def _train_loop(cfg, args, obs, grace) -> None:
                 jax.profiler.stop_trace()
                 tracing = False
                 color_print(f"profiler trace written to {args.profile}")
+                _finalize_profile(cfg, args, trainer, obs)
             if cfg.debug_train_step or (u + 1) % 10 == 0:
                 # debug_train_step: per-step prints (reference run.py:252-261)
                 # showing the most recent COMPLETED loss — never a blocking
@@ -376,6 +430,7 @@ def _train_loop(cfg, args, obs, grace) -> None:
         writer.flush()
         jax.profiler.stop_trace()
         color_print(f"profiler trace written to {args.profile}")
+        _finalize_profile(cfg, args, trainer, obs)
     if ckpt is not None:
         # on a grace exit this IS the grace checkpoint (save() waits on the
         # orbax barrier before writing sidecar + manifest, so returning

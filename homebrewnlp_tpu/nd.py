@@ -39,21 +39,39 @@ def known_axes() -> typing.FrozenSet[str]:
 
 
 # -- scope provider ----------------------------------------------------------
-# Best-effort pointer at the model scope currently being built (pushed/popped
-# by models/ctx.py's scope stack).  Purely diagnostic: NT errors raised while
-# a scope is active name the enclosing parameter path, so an analyzer finding
-# or a trace-time rank mismatch points at the offending layer instead of only
-# at anonymous shapes.
+# Pointer at the model scope currently being built (pushed/popped by
+# models/ctx.py's scope stack).  Two consumers: NT errors raised while a
+# scope is active name the enclosing parameter path (diagnostics), and every
+# push mirrors into ``jax.named_scope`` so compiled HLO instruction metadata
+# (``op_name``) carries the layer path end to end — obs/profile.py joins
+# profiler trace events against that metadata for per-layer device-time
+# attribution (docs/observability.md "Profile attribution").
 _SCOPE_STACK: typing.List[str] = []
+_NAMED_SCOPE_CMS: typing.List[typing.Optional[typing.ContextManager]] = []
 
 
 def push_scope(name: str) -> None:
     _SCOPE_STACK.append(name)
+    # '@' is MLIR-special (symbol refs): a name containing it is scrubbed
+    # from op_name entirely, so the depth token "@d0_x" emits as "d0_x"
+    cm: typing.Optional[typing.ContextManager] = None
+    try:
+        cm = jax.named_scope(name.replace("@", ""))
+        cm.__enter__()
+    except Exception:
+        cm = None
+    _NAMED_SCOPE_CMS.append(cm)
 
 
 def pop_scope() -> None:
     if _SCOPE_STACK:
         _SCOPE_STACK.pop()
+        cm = _NAMED_SCOPE_CMS.pop()
+        if cm is not None:
+            try:
+                cm.__exit__(None, None, None)
+            except Exception:
+                pass
 
 
 def current_scope() -> str:

@@ -76,6 +76,10 @@ class Obs:
         self._started = False
         self._steps = None
         self._tokens = None
+        # latest graftprof window figures (record_profile): merged into the
+        # /healthz utilization payload next to mfu/tokens_per_sec
+        self._profile_extra: typing.Dict[str, float] = {}
+        self._util_watched = False
 
     @classmethod
     def from_config(cls, cfg) -> "Obs":
@@ -222,8 +226,55 @@ class Obs:
         self.registry.gauge(
             "hbnlp_flops_per_step", "per-step FLOPs of the compiled train "
             "step (XLA cost analysis)", fn=lambda: util.flops_per_step)
+        self._util_watched = True
         self.health.set_utilization_probe(
-            lambda: dict(writer.last_rates, goodput=writer.goodput()))
+            lambda: dict(writer.last_rates, goodput=writer.goodput(),
+                         **self._profile_extra))
+
+    def record_profile(self, summary) -> None:
+        """Publish the most recent graftprof window (docs/observability.md
+        "Profile attribution") on the live surfaces:
+
+        - ``hbnlp_step_time_ms{stat=...}`` — the measured ms_per_step
+          decomposition (``total``/``mxu``/``hbm``/``comm``/``idle``) plus
+          the ``busy``/``wall`` window stats;
+        - ``hbnlp_profile_time_fraction{category=...}`` — the same split
+          as fractions of the device wall window;
+        - ``hbnlp_profile_attributed_fraction{kind=...}`` — how much of
+          the device time the capture could attribute (category / scope);
+        - ``comm_fraction`` under /healthz ``utilization`` (merged next to
+          mfu/tokens_per_sec when telemetry runs, standalone otherwise).
+
+        Plain value gauges (not callbacks): they freeze at their last
+        window automatically, so close() needs no special-casing."""
+        step_ms = self.registry.gauge(
+            "hbnlp_step_time_ms", "graftprof ms-per-step decomposition of "
+            "the most recent profile window", labelnames=("stat",))
+        d = summary.decomposition_ms_per_step
+        for stat in ("total", "mxu", "hbm", "comm", "idle"):
+            step_ms.labels(stat=stat).set(float(d.get(stat, 0.0)))
+        steps = max(1, summary.n_steps or 1)
+        step_ms.labels(stat="busy").set(summary.busy_s * 1e3 / steps)
+        step_ms.labels(stat="wall").set(summary.wall_s * 1e3 / steps)
+        frac = self.registry.gauge(
+            "hbnlp_profile_time_fraction", "per-category fraction of the "
+            "device wall window (most recent profile capture)",
+            labelnames=("category",))
+        for cat, v in summary.fractions.items():
+            frac.labels(category=cat).set(float(v))
+        attr = self.registry.gauge(
+            "hbnlp_profile_attributed_fraction", "device time the capture "
+            "attributed to a known category / named scope",
+            labelnames=("kind",))
+        attr.labels(kind="category").set(summary.attributed_category_frac)
+        attr.labels(kind="scope").set(summary.attributed_scope_frac)
+        self._profile_extra["comm_fraction"] = float(
+            summary.fractions.get("comm", 0.0))
+        if not self._util_watched and self.health is not None:
+            # no telemetry this run: the profile figures ARE the
+            # utilization story /healthz can tell
+            self.health.set_utilization_probe(
+                lambda: dict(self._profile_extra))
 
     def sample_device_memory(self) -> None:
         """Refresh per-device memory gauges (called each checkpoint window;

@@ -330,6 +330,17 @@ def dump_diagnostics(model_path: str, health: typing.Optional[Health] = None,
     mem = device_memory_stats()
     lines.append("device_memory_stats: "
                  + (json.dumps(mem, indent=1) if mem else "(unavailable)"))
+    # latest graftprof window (main.py writes it at profiler stop): where
+    # device time was going BEFORE the stall is exactly the third artifact
+    # a hang post-mortem wants next to thread stacks and memory
+    summary_path = os.path.join(model_path, "profile_summary.json")
+    if os.path.exists(summary_path):
+        try:
+            with open(summary_path) as f:
+                lines.append("profile_summary: "
+                             + json.dumps(json.load(f), sort_keys=True))
+        except Exception as e:
+            lines.append(f"profile_summary: (unreadable: {e})")
     names = {t.ident: t.name for t in threading.enumerate()}
     lines.append("")
     for ident, frame in sorted(sys._current_frames().items()):

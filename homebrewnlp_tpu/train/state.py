@@ -229,16 +229,20 @@ class Trainer:
                     lambda g: g * grad_scale.astype(g.dtype), grads)
                 grads_ok, nonfinite = device_telemetry.grads_finite(grads)
                 skip = (~grads_ok) if skip_on_nonfinite else None
-                new_params, new_opt, lr, upd_sq = opt.update(
-                    state.params, grads, state.opt_state, state.step,
-                    skip=skip, collect_update_sq=True)
+                # named scope: optimizer ops attribute to their own row in
+                # graftprof's per-scope table instead of "(toplevel)"
+                with jax.named_scope("optimizer"):
+                    new_params, new_opt, lr, upd_sq = opt.update(
+                        state.params, grads, state.opt_state, state.step,
+                        skip=skip, collect_update_sq=True)
                 metrics.update(device_telemetry.collect(
                     state.params, grads, upd_sq, grad_scale, nonfinite,
                     applied=(grads_ok if skip_on_nonfinite else None),
                     norm_sq_fn=norm_sq, groups=cfg.telemetry_groups))
             else:
-                new_params, new_opt, lr = opt.update(
-                    state.params, grads, state.opt_state, state.step)
+                with jax.named_scope("optimizer"):
+                    new_params, new_opt, lr = opt.update(
+                        state.params, grads, state.opt_state, state.step)
 
             gnorm = jnp.sqrt(sum(norm_sq(k, g) for k, g in grads.items()))
             # no "step" entry: the loop computes step indices on host
