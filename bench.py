@@ -94,19 +94,37 @@ dropping more than 0.15, fails the row's ``baseline`` and the top-level
 ``profile_ok``.  The probe skips cleanly when the toolchain never writes
 the profiler plugin directory.
 
+The serving-SLO PR (ISSUE 9) adds a ``serving`` workload row: the REST
+server comes up in-process on live (fresh-init) params, tools/graftload.py
+drives it closed-loop with a fixed-seed prompt corpus, and the row records
+client-measured e2e percentiles + goodput tok/s next to the server's own
+TTFT / queue-wait / engine-busy histogram percentiles, the client-vs-server
+reconciliation verdict, and ``serialization_overhead_s`` (client p50 e2e −
+engine-busy p50 — the number the future continuous-batching PR must
+shrink).  The core latency/goodput fields are recorded BEFORE the
+server-scrape/reconcile sub-sections, so a probe failure cannot drop the
+baseline comparison (same ordering discipline as ``hbm_peak_bytes``).
+Latency/goodput drift is gated by the committed per-device-kind
+``bench_serve_baseline.json`` (self-records on first contact, like the
+compile budget): p50 e2e growing past 1.5x, or goodput dropping below
+2/3x, fails the row's ``baseline`` and the top-level ``serve_ok``.
+
 Env knobs (development / partial runs): ``HBNLP_BENCH_WORKLOADS`` is a
 comma list or ``all`` (default); ``HBNLP_BENCH_GUARD_STEPS`` overrides the
 guard length (0 disables); ``HBNLP_BENCH_QUANT=0`` skips the quant probe,
 ``HBNLP_BENCH_QUANT_DTYPE``/``_STEPS``/``_TOL`` tune it;
 ``HBNLP_BENCH_RESOURCES=0`` skips the cost-model prediction hook;
 ``HBNLP_BENCH_PROFILE=0`` skips the profile probe,
-``HBNLP_BENCH_PROFILE_STEPS`` sizes its window.
+``HBNLP_BENCH_PROFILE_STEPS`` sizes its window; ``HBNLP_BENCH_SERVE=0``
+skips the serving row, ``HBNLP_BENCH_SERVE_CONFIG``/``_REQUESTS``/
+``_CONCURRENCY``/``_RESPONSE_LEN`` shape it.
 """
 from __future__ import annotations
 
 import json
 import os
 import time
+import typing
 
 import jax
 
@@ -127,6 +145,29 @@ PROFILE_BASELINE_FILE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "bench_profile_baseline.json")
 #: steps in the per-workload profile capture window
 PROFILE_PROBE_STEPS = int(os.environ.get("HBNLP_BENCH_PROFILE_STEPS", "5"))
+
+# committed per-device-kind serving baseline (p50 e2e latency + goodput);
+# self-records on first contact like the compile budget, then drift past
+# the ratios below fails the serving row's baseline and the line's serve_ok
+SERVE_BASELINE_FILE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_serve_baseline.json")
+#: tolerated p50 e2e growth vs the committed serving baseline
+SERVE_LATENCY_RATIO = 1.5
+#: tolerated goodput floor vs the committed serving baseline
+SERVE_GOODPUT_RATIO = 2.0 / 3.0
+#: serving-row shape (env-overridable for development/smoke runs).  An
+#: overridden shape never SELF-RECORDS a baseline: a smoke run on a fresh
+#: device kind would otherwise commit its shape as the baseline and leave
+#: every later default-shape run skipping the ratchet as "shape differs".
+SERVE_SHAPE_OVERRIDDEN = any(
+    os.environ.get(k) for k in
+    ("HBNLP_BENCH_SERVE_CONFIG", "HBNLP_BENCH_SERVE_REQUESTS",
+     "HBNLP_BENCH_SERVE_CONCURRENCY", "HBNLP_BENCH_SERVE_RESPONSE_LEN"))
+SERVE_CONFIG = os.environ.get("HBNLP_BENCH_SERVE_CONFIG", "32big_mixer")
+SERVE_REQUESTS = int(os.environ.get("HBNLP_BENCH_SERVE_REQUESTS", "24"))
+SERVE_CONCURRENCY = int(os.environ.get("HBNLP_BENCH_SERVE_CONCURRENCY", "4"))
+SERVE_RESPONSE_LEN = int(os.environ.get("HBNLP_BENCH_SERVE_RESPONSE_LEN",
+                                        "16"))
 
 # Peak table + MFU arithmetic shared with the LIVE utilization accounting
 # (homebrewnlp_tpu/train/flops.py): bench's offline mfu and the run's
@@ -723,6 +764,127 @@ def _quant_probe(name: str, trainer, state, batch, flops_algo: float,
     return row
 
 
+def bench_serving() -> dict:
+    """The ``serving`` workload row (docs/observability.md "Serving SLOs"):
+    bring the REST server up in-process on live fresh-init params, drive it
+    with tools/graftload.py (closed loop, fixed-seed corpus), and record
+    client-side latency/goodput next to the server's own SLO histograms.
+
+    Field-ordering contract: the core fields the baseline gate consumes
+    (``e2e_p50_s``, ``goodput_tok_s``) are written into the row BEFORE the
+    server-scrape/reconcile sub-sections, each of which is contained — a
+    scrape failure lands in ``server.error`` without dropping the gate."""
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import graftload
+
+    from homebrewnlp_tpu.models import init_params
+    from homebrewnlp_tpu.obs.registry import MetricsRegistry
+    from homebrewnlp_tpu.serve import RestAPI, serve
+    from homebrewnlp_tpu.utils import load_config, random_text_batch
+
+    t0 = time.perf_counter()
+    cfg = load_config(f"configs/{SERVE_CONFIG}.json", **_COMMON,
+                      train_batch_size=1)
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    # a dedicated registry: the serving histograms this row reconciles
+    # against must contain exactly this run's requests, not the training
+    # workloads' REST leftovers
+    reg = MetricsRegistry()
+    api = RestAPI(cfg, params)
+    server = serve(cfg, None, port=0, background=True, registry=reg,
+                   obs_port=0, api=api)
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        murl = f"http://127.0.0.1:{server._obs_server.server_address[1]}"
+        # prompts must leave room to generate: TTFT/decode need tokens
+        max_prompt = max(4, min(64, cfg.sequence_length - SERVE_RESPONSE_LEN))
+        # warmup: pay the sampler compile OUTSIDE the HTTP/SLO path (a
+        # direct engine call records nothing), so the registry this row
+        # scrapes holds exactly the timed requests and the steady-state
+        # percentiles are honest; timed apart as compile_and_warmup_s
+        api.wrapper.complete([1, 2, 3], 0.0, SERVE_RESPONSE_LEN)
+        compile_and_warmup_s = time.perf_counter() - t0
+        report = graftload.drive(
+            url, metrics_url=murl, n_requests=SERVE_REQUESTS,
+            concurrency=SERVE_CONCURRENCY, vocab=cfg.vocab_size,
+            min_prompt=4, max_prompt=max_prompt,
+            response_len=SERVE_RESPONSE_LEN, seed=2)
+    finally:
+        server.shutdown()
+        server.server_close()
+        # the wrapper's daemon workers pin wrapper -> engine -> params (the
+        # full serving-config weights) through every later bench section
+        # unless told to exit
+        api.wrapper.close()
+    c = report["client"]
+    e2e = c.get("e2e_s") or {}
+    row = {
+        # core fields FIRST (the baseline gate and the driver's trajectory
+        # read these; everything after is contained best-effort detail)
+        "config": SERVE_CONFIG,
+        "value": c.get("goodput_tok_s"),  # the row's figure of record
+        "goodput_tok_s": c.get("goodput_tok_s"),
+        "e2e_p50_s": e2e.get("p50"),
+        "e2e_p95_s": e2e.get("p95"),
+        "requests_per_s": c.get("requests_per_s"),
+        "truncated": c.get("truncated", False),
+        "error_rate": c.get("error_rate"),
+        "n_requests": c.get("n_requests"),
+        "n_rejected": c.get("n_rejected"),
+        "concurrency": SERVE_CONCURRENCY,
+        "response_len": SERVE_RESPONSE_LEN,
+        "compile_and_warmup_s": round(compile_and_warmup_s, 1),
+    }
+    srv = report.get("server") or {}
+    if isinstance(srv, dict) and "error" not in srv:
+        for key, out_key in (("ttft_s", "ttft"), ("queue_wait_s",
+                                                  "queue_wait"),
+                             ("engine_s", "engine"),
+                             ("decode_tokens_per_sec", "decode_rate")):
+            if isinstance(srv.get(key), dict):
+                row[f"{out_key}_p50"] = srv[key].get("p50")
+                row[f"{out_key}_p95"] = srv[key].get("p95")
+    if "server" in report:
+        row["server"] = srv
+    if "reconcile" in report:
+        row["reconcile"] = report["reconcile"]
+        over = report["reconcile"].get("serialization_overhead_s")
+        if over is not None:
+            row["serialization_overhead_s"] = over
+    return row
+
+
+def evaluate_serve_baseline(row: dict, baseline: dict,
+                            max_latency_ratio: float = SERVE_LATENCY_RATIO,
+                            min_goodput_ratio: float = SERVE_GOODPUT_RATIO):
+    """Pure serving-ratchet evaluation (unit-testable without a server):
+    the row's p50 e2e latency and goodput tok/s against the committed
+    per-device baseline.  Returns (gate row or None, ok).  A missing
+    figure or baseline is skipped — absence is not a regression (the
+    baseline self-records on first contact, bench.main)."""
+    if not isinstance(row, dict) or not baseline:
+        return None, True
+    out: dict = {}
+    ok = True
+    e2e, base_e2e = row.get("e2e_p50_s"), baseline.get("e2e_p50_s")
+    if isinstance(e2e, (int, float)) and base_e2e:
+        ratio = e2e / base_e2e
+        passed = bool(ratio <= max_latency_ratio)
+        out["e2e_p50"] = {"baseline_s": base_e2e, "ratio": round(ratio, 3),
+                          "pass": passed}
+        ok = ok and passed
+    good, base_good = row.get("goodput_tok_s"), baseline.get("goodput_tok_s")
+    if isinstance(good, (int, float)) and base_good:
+        ratio = good / base_good
+        passed = bool(ratio >= min_goodput_ratio)
+        out["goodput"] = {"baseline_tok_s": base_good,
+                          "ratio": round(ratio, 3), "pass": passed}
+        ok = ok and passed
+    return (out or None), ok
+
+
 def evaluate_compile_budget(workloads: dict, budgets: dict,
                             max_ratio: float = COMPILE_BUDGET_RATIO):
     """Pure compile-time ratchet evaluation (unit-testable, shared with
@@ -856,6 +1018,61 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 - one workload must not kill the line
             workloads[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
+    # serving workload row + its ratchet, evaluated HERE — before the
+    # guard and the compile/profile ratchet sections below — so a failure
+    # in any later probe cannot drop the serving baseline comparison
+    # (the hbm_peak_bytes ordering discipline, ISSUE 9 satellite)
+    serve_ok: typing.Optional[bool] = None
+    if os.environ.get("HBNLP_BENCH_SERVE", "1") != "0":
+        try:
+            workloads["serving"] = bench_serving()
+        except Exception as e:  # noqa: BLE001
+            workloads["serving"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        srow = workloads["serving"]
+        # a row without usable core figures (every request failed, server
+        # never came up cleanly, graftload abandoned a live worker) must
+        # FAIL the gate, not skip it — serve_ok exists to catch exactly
+        # that class of regression
+        serve_ok = ("error" not in srow
+                    and not srow.get("truncated")
+                    and isinstance(srow.get("e2e_p50_s"), (int, float))
+                    and isinstance(srow.get("goodput_tok_s"), (int, float)))
+        if (isinstance(srow.get("e2e_p50_s"), (int, float))
+                and not srow.get("truncated")):
+            serve_baselines = {}
+            if os.path.exists(SERVE_BASELINE_FILE):
+                with open(SERVE_BASELINE_FILE) as f:
+                    serve_baselines = json.load(f)
+            kind = jax.devices()[0].device_kind
+            dev_serve = serve_baselines.setdefault(kind, {})
+            # latency/goodput only compare like against like: the baseline
+            # remembers the workload shape it was recorded under, and an
+            # env-overridden run (HBNLP_BENCH_SERVE_*, smoke/dev shapes)
+            # skips the ratchet instead of failing it spuriously
+            shape = {"config": SERVE_CONFIG, "n_requests": SERVE_REQUESTS,
+                     "concurrency": SERVE_CONCURRENCY,
+                     "response_len": SERVE_RESPONSE_LEN}
+            if not dev_serve and not SERVE_SHAPE_OVERRIDDEN:
+                # first contact at the DEFAULT shape: self-record (operator
+                # commits); an overridden smoke shape must not become the
+                # baseline every default run then skips against
+                dev_serve.update({
+                    "e2e_p50_s": srow["e2e_p50_s"],
+                    "goodput_tok_s": srow.get("goodput_tok_s"),
+                    "shape": shape,
+                    "recorded": time.time()})
+                with open(SERVE_BASELINE_FILE, "w") as f:
+                    json.dump(serve_baselines, f, indent=2, sort_keys=True)
+                    f.write("\n")
+            if dev_serve.get("shape", shape) == shape:
+                gate, gate_ok = evaluate_serve_baseline(srow, dev_serve)
+                if gate is not None:
+                    srow["baseline"] = gate
+                serve_ok = serve_ok and gate_ok
+            else:
+                srow["baseline"] = {"skipped": "workload shape differs "
+                                               "from the recorded baseline"}
+
     guard_steps = int(os.environ.get("HBNLP_BENCH_GUARD_STEPS", "300"))
     guard = None
     if guard_steps:
@@ -962,6 +1179,8 @@ def main() -> None:
         "n_chips": n_chips,
         "compile_ok": compile_ok,
         "profile_ok": profile_ok,
+        # serving ratchet verdict (None = row skipped via HBNLP_BENCH_SERVE)
+        "serve_ok": serve_ok,
         "workloads": workloads,
         "numerics_guard": guard,
     }

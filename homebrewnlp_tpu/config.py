@@ -327,6 +327,17 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     sampling_top_p=1.0,
     num_of_sample=10,
     web_workers=1,
+    # serving SLO knobs (docs/observability.md "Serving SLOs").
+    # serve_queue_deadline_s: a request whose ENGINE-QUEUE wait exceeds this
+    # is rejected (REST: 503 + Retry-After) instead of hanging the client
+    # behind the serialized engine; 0 = wait forever (the reference's
+    # Manager-queue behavior)
+    serve_queue_deadline_s=0.0,
+    # serve_queue_limit: >0 sheds load at ADMISSION — a completion request
+    # arriving with this many requests already queued is rejected
+    # immediately (REST: 503 + Retry-After) without waiting out the
+    # deadline; 0 = unbounded queue
+    serve_queue_limit=0,
     equal_debugging_items_per_check=16,
     debug_sample=False,
     default_sleep_duration=0.1,
@@ -406,6 +417,14 @@ class Config:
                     f"unknown target_device {self.target_device!r}; known "
                     f"kinds: {', '.join(known_kinds())} (or \"\" to skip "
                     f"the HBM capacity gate)")
+        if float(self.serve_queue_deadline_s) < 0:
+            raise ValueError("serve_queue_deadline_s must be >= 0 "
+                             "(0 = requests wait in the engine queue forever)")
+        self.serve_queue_deadline_s = float(self.serve_queue_deadline_s)
+        if int(self.serve_queue_limit) < 0:
+            raise ValueError("serve_queue_limit must be >= 0 "
+                             "(0 = unbounded engine queue)")
+        self.serve_queue_limit = int(self.serve_queue_limit)
         if self.watchdog_factor < 0:
             raise ValueError("watchdog_factor must be >= 0 "
                              "(0 = watchdog disabled)")

@@ -135,15 +135,23 @@ def init_caches(cfg: Config, params: dict, batch_size: int,
             for k, kv in shapes.items()}
 
 
-def make_cached_text_sampler(cfg: Config, params: dict):
+def make_cached_text_sampler(cfg: Config, params: dict,
+                             first_token_callback: typing.Optional[
+                                 typing.Callable] = None):
     """Jitted KV-cached sampler with the same signature as
     ``make_text_sampler``: (token_x NT, initial_pos, temperature, rng,
-    end_iterations) -> int32 tokens."""
+    end_iterations[, first_token_tag]) -> int32 tokens.
+
+    ``first_token_callback``: the serving-SLO TTFT hook (host
+    ``(tag, token)``), fired exactly once — on the FIRST generated
+    position, i.e. after the one-shot prompt prefill above has run — so
+    TTFT measured here covers prefill + first incremental step, matching
+    the rebuild sampler's semantics."""
     if not cache_eligible(cfg):
         raise ValueError("config is not KV-cache eligible; use make_text_sampler")
 
     def fn(params, token_x: NT, initial_pos, temperature, rng,
-           end_iterations=None):
+           end_iterations=None, first_token_tag=0):
         names = token_x.names
         toks = token_x.x.astype(jnp.int32)
         seq_axis = names.index(SEQUENCE)
@@ -182,6 +190,16 @@ def make_cached_text_sampler(cfg: Config, params: dict):
             new_row = jnp.where(write, sampled.astype(toks.dtype), cur)
             toks = jax.lax.dynamic_update_slice_in_dim(
                 toks, new_row, jnp.minimum(nxt, seq - 1), seq_axis)
+            if first_token_callback is not None:
+                # the first generated position is max(initial_pos, 1): the
+                # loop starts one row early (start = initial_pos - 1) to
+                # source the last prompt row's logits, and an empty prompt
+                # generates from row 1 (row 0 is the random-pad seed row)
+                from .sampler import _fire_first_token
+                _fire_first_token(
+                    first_token_callback, first_token_tag,
+                    write & (nxt == jnp.maximum(jnp.int32(initial_pos), 1)),
+                    new_row)
             return nxt, toks, caches, key
 
         def cond(carry):

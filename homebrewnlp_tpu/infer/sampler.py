@@ -87,21 +87,47 @@ def _gumbel_argmax(logits: jnp.ndarray, temperature, key: jax.Array,
     return jnp.argmax(logits + temperature * gumbel, axis=-1).astype(jnp.int32)
 
 
+def _fire_first_token(callback, tag, fire: jnp.ndarray, token: jnp.ndarray
+                      ) -> None:
+    """Host-notify the first sampled token (docs/observability.md "Serving
+    SLOs"): an UNORDERED ``jax.debug.callback`` gated by ``fire``, with the
+    sampled token as an operand so XLA cannot hoist it ahead of the
+    sampling computation it reports on.  ``tag`` is a TRACED request id —
+    one compilation serves every request; the host side (the engine's
+    dispatcher) resolves it to the per-request TTFT callback.  Fires at
+    most once per sampler call by construction (``fire`` is true only on
+    the first generated position)."""
+    jax.lax.cond(
+        fire,
+        lambda t: jax.debug.callback(callback, jnp.asarray(tag, jnp.int32), t),
+        lambda t: None,
+        token.reshape(-1)[0])
+
+
 def autoregressive_text(cfg: Config, params: dict, token_x: NT,
                         initial_pos: typing.Union[int, jnp.ndarray],
                         temperature: typing.Optional[float] = None,
                         end_iterations: typing.Optional[int] = None,
-                        rng: typing.Optional[jax.Array] = None) -> jnp.ndarray:
+                        rng: typing.Optional[jax.Array] = None,
+                        first_token_callback: typing.Optional[
+                            typing.Callable] = None,
+                        first_token_tag=0) -> jnp.ndarray:
     """Fill ``token_x`` from ``initial_pos`` to ``end_iterations``.
 
     ``token_x``: int NT [batch, sequence, token_patch].  Returns the filled
-    int32 array of the same shape."""
+    int32 array of the same shape.  ``first_token_callback`` (host fn
+    ``(tag, token)``), when given, is invoked from the graph exactly once —
+    on the FIRST generated position — so serving can measure TTFT; with a
+    full prompt (nothing to generate) it never fires.  None (the default,
+    and every training/analysis path) keeps the pre-callback graph
+    byte-identical — census goldens see no new equations."""
     temperature = (cfg.sampling_temperature if temperature is None
                    else temperature)
     end = cfg.sequence_length if end_iterations is None else end_iterations
     rng = jax.random.key(0) if rng is None else rng
     names = token_x.names
     seq_axis = names.index(SEQUENCE)
+    pos0 = jnp.asarray(initial_pos, jnp.int32)
 
     batch_template = {"token_x": None,
                       "token_y": NT(jnp.zeros_like(token_x.x), names)}
@@ -125,6 +151,13 @@ def autoregressive_text(cfg: Config, params: dict, token_x: NT,
         onehot = onehot.reshape((1, toks.shape[seq_axis])
                                 + (1,) * (toks.ndim - 2))
         new_toks = (sampled * onehot + toks * (1 - onehot)).astype(toks.dtype)
+        if first_token_callback is not None:
+            # the first loop iteration (pos == pos0) writes the first
+            # generated row — this rebuild path's whole forward doubles as
+            # the prompt "prefill", so TTFT covers it
+            _fire_first_token(
+                first_token_callback, first_token_tag, pos == pos0,
+                jax.lax.dynamic_slice_in_dim(new_toks, pos, 1, seq_axis))
         return pos + 1, new_toks, key
 
     def cond(carry):
@@ -224,19 +257,28 @@ def make_single_forward(cfg: Config, params: dict):
     return jit_bound(fn, params)
 
 
-def make_text_sampler(cfg: Config, params: dict):
+def make_text_sampler(cfg: Config, params: dict,
+                      first_token_callback: typing.Optional[
+                          typing.Callable] = None):
     """Jitted sampler: (token_x NT, initial_pos, temperature, rng,
-    end_iterations) -> int32 tokens.  initial_pos / temperature /
-    end_iterations are traced so one compilation serves every prompt and
-    response length (the reference feeds them via infeed placeholders,
-    src/run/dataloader_placement.py:234-271).  ``params`` are a jit
-    argument, not closed-over constants (see make_single_forward)."""
+    end_iterations[, first_token_tag]) -> int32 tokens.  initial_pos /
+    temperature / end_iterations are traced so one compilation serves every
+    prompt and response length (the reference feeds them via infeed
+    placeholders, src/run/dataloader_placement.py:234-271).  ``params`` are
+    a jit argument, not closed-over constants (see make_single_forward).
+
+    ``first_token_callback`` (host ``(tag, token)``) arms the serving-SLO
+    TTFT hook: the graph notifies the host once, at the first generated
+    position, carrying the TRACED ``first_token_tag`` request id — one
+    compilation serves every request (docs/observability.md)."""
 
     def fn(params, token_x: NT, initial_pos, temperature, rng,
-           end_iterations=None):
+           end_iterations=None, first_token_tag=0):
         end = (jnp.int32(cfg.sequence_length) if end_iterations is None
                else end_iterations)
         return autoregressive_text(cfg, params, token_x, initial_pos,
-                                   temperature, end_iterations=end, rng=rng)
+                                   temperature, end_iterations=end, rng=rng,
+                                   first_token_callback=first_token_callback,
+                                   first_token_tag=first_token_tag)
 
     return jit_bound(fn, params)

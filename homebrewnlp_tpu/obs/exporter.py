@@ -253,6 +253,9 @@ class _ObsServer(ThreadingHTTPServer):
     daemon_threads = True
     registry: MetricsRegistry
     health: typing.Optional[Health]
+    #: optional serving-SLO summary callable (serve/slo.py::ServeSLO.summary)
+    #: merged into /healthz as the ``slo`` block
+    slo_probe: typing.Optional[typing.Callable[[], dict]] = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -275,6 +278,14 @@ class _Handler(BaseHTTPRequestHandler):
             # "the engine is alive"
             snap = health.snapshot() if health is not None else \
                 {"status": "metrics-only", "last_completed_step": None}
+            probe = getattr(self.server, "slo_probe", None)
+            if probe is not None:
+                # serving SLO summary (p50/p95/p99 per phase + error rate)
+                # next to liveness — one curl answers "alive AND meeting SLO"
+                try:
+                    snap["slo"] = probe()
+                except Exception:  # noqa: BLE001 - must not break the probe
+                    snap["slo"] = None
             status = 503 if snap["status"] == "stalled" else 200
             self._send(status, json.dumps(snap).encode(), "application/json")
         else:
@@ -286,12 +297,17 @@ class _Handler(BaseHTTPRequestHandler):
 
 def start_server(port: int, registry: typing.Optional[MetricsRegistry] = None,
                  health: typing.Optional[Health] = None,
-                 host: str = "127.0.0.1") -> _ObsServer:
+                 host: str = "127.0.0.1",
+                 slo_probe: typing.Optional[typing.Callable[[], dict]] = None
+                 ) -> _ObsServer:
     """Start the exporter on a daemon thread; ``port=0`` binds an ephemeral
-    port (read it back from ``server.server_address[1]``)."""
+    port (read it back from ``server.server_address[1]``).  ``slo_probe``
+    (the REST layer's ``ServeSLO.summary``) adds a ``slo`` block to
+    /healthz."""
     server = _ObsServer((host, port), _Handler)
     server.registry = registry if registry is not None else REGISTRY
     server.health = health
+    server.slo_probe = slo_probe
     thread = threading.Thread(target=server.serve_forever,
                               name="obs-exporter", daemon=True)
     server._thread = thread

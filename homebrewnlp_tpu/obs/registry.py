@@ -32,6 +32,70 @@ DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
 
+def bucket_quantile(buckets: typing.Sequence[float],
+                    counts: typing.Sequence[float],
+                    q: float) -> typing.Optional[float]:
+    """Bucket-interpolated quantile over a Prometheus-style histogram — the
+    ONE percentile implementation /healthz, graftload and bench share
+    (docs/observability.md "Serving SLOs").
+
+    ``buckets`` are the finite upper bounds; ``counts`` are NON-cumulative
+    per-bucket observation counts with one trailing entry for the +Inf
+    bucket (``len(counts) == len(buckets) + 1``).  Semantics follow
+    ``histogram_quantile``: linear interpolation inside the bucket holding
+    the target rank (lower edge 0 for the first bucket); a rank landing in
+    the +Inf bucket returns the highest finite bound — the estimator can
+    never invent a value above what the buckets resolve.  None when the
+    histogram is empty."""
+    counts = [float(c) for c in counts]
+    total = sum(counts)
+    if total <= 0:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    rank = q * total
+    cum = 0.0
+    for j, c in enumerate(counts[:-1]):
+        prev = cum
+        cum += c
+        if cum >= rank and c > 0:
+            lo = 0.0 if j == 0 else float(buckets[j - 1])
+            hi = float(buckets[j])
+            return lo + (hi - lo) * (rank - prev) / c
+    return float(buckets[-1])  # +Inf bucket: clamp to the last finite edge
+
+
+def sample_quantile(samples: typing.Sequence[float], q: float
+                    ) -> typing.Optional[float]:
+    """Exact order-statistic quantile with linear interpolation (numpy's
+    default) over raw samples — the client-side arm of the same shared
+    percentile surface (graftload computes these over its own wall-clock
+    timestamps and reconciles against :func:`bucket_quantile` of the
+    server's histogram).  None on an empty sample set."""
+    xs = sorted(float(s) for s in samples)
+    if not xs:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    pos = q * (len(xs) - 1)
+    lo = int(math.floor(pos))
+    hi = int(math.ceil(pos))
+    if lo == hi:
+        return xs[lo]
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+
+def bucket_width_at(buckets: typing.Sequence[float], value: float) -> float:
+    """Width of the histogram bucket a value falls into — the resolution
+    floor of any bucket-interpolated quantile at that point, used as the
+    reconciliation tolerance (a client-vs-server disagreement smaller than
+    one bucket is not measurable by the histogram)."""
+    lo = 0.0
+    for b in buckets:
+        if value <= float(b):
+            return float(b) - lo
+        lo = float(b)
+    return float("inf")  # +Inf bucket: the histogram resolves nothing here
+
+
 def _fmt(v: float) -> str:
     if v == math.inf:
         return "+Inf"
@@ -139,6 +203,13 @@ class Counter(_Metric):
             child = self._children.get(key)
             return child[0] if child else 0.0
 
+    def items(self) -> typing.Dict[tuple, float]:
+        """{label-values tuple: value} snapshot across every child — lets a
+        consumer aggregate without knowing the label values in advance
+        (e.g. the SLO error rate summing 5xx statuses)."""
+        with self._registry._lock:
+            return {k: v[0] for k, v in self._children.items()}
+
     def _render_child(self, values, child):
         return [f"{self.name}{_label_str(self.labelnames, values)} "
                 f"{_fmt(child[0])}"]
@@ -226,6 +297,38 @@ class Histogram(_Metric):
         with self._registry._lock:
             child = self._children.get(key)
             return child["count"] if child else 0
+
+    def snapshot(self, **labels) -> dict:
+        """{"counts", "sum", "count"} copy of one child (non-cumulative
+        bucket counts, +Inf last) — all zeros when never observed."""
+        key = tuple(str(labels[n]) for n in self.labelnames) if labels else ()
+        with self._registry._lock:
+            child = self._children.get(key)
+            if child is None:
+                return {"counts": [0] * (len(self.buckets) + 1),
+                        "sum": 0.0, "count": 0}
+            return {"counts": list(child["counts"]), "sum": child["sum"],
+                    "count": child["count"]}
+
+    def quantile(self, q: float, **labels) -> typing.Optional[float]:
+        """Bucket-interpolated quantile of one child (:func:`bucket_quantile`
+        — the shared implementation).  With labels declared but none given,
+        aggregates across every child (the all-paths latency view)."""
+        with self._registry._lock:
+            if self.labelnames and not labels:
+                merged = [0.0] * (len(self.buckets) + 1)
+                for child in self._children.values():
+                    for i, c in enumerate(child["counts"]):
+                        merged[i] += c
+                counts = merged
+            else:
+                key = (tuple(str(labels[n]) for n in self.labelnames)
+                       if labels else ())
+                child = self._children.get(key)
+                if child is None:
+                    return None
+                counts = list(child["counts"])
+        return bucket_quantile(self.buckets, counts, q)
 
     def _render_child(self, values, child):
         lines = []

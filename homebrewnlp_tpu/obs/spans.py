@@ -118,6 +118,17 @@ class SpanTracer:
             return wrapped
         return deco
 
+    def add(self, name: str, t0: float, t1: float, **args) -> None:
+        """Record an already-measured span from explicit ``perf_counter``
+        timestamps.  A request's phase trail (serve/slo.py) is stamped
+        across three threads — handler, queue worker, JAX callback — and
+        only assembled once the request finishes; this records each phase
+        retroactively on the calling thread's track, which a live context
+        manager cannot do."""
+        if t1 < t0:
+            t0, t1 = t1, t0
+        self._record(name, t0, t1, args)
+
     def _record(self, name: str, t0: float, t1: float, args: dict) -> None:
         th = threading.current_thread()
         with self._lock:
@@ -201,6 +212,13 @@ def span(name: str, **args):
     if t is None:
         return NULL_SPAN
     return t.span(name, **args)
+
+
+def add(name: str, t0: float, t1: float, **args) -> None:
+    """Retroactive span on the ambient tracer; no-op when tracing is off."""
+    t = _TRACER
+    if t is not None:
+        t.add(name, t0, t1, **args)
 
 
 def traced(name: str):

@@ -2,8 +2,10 @@
 renderers, similarity debug (JAX re-design of /root/reference/src/
 interface.py + src/rest_api.py)."""
 from .interface import (ByteTokenizer, CompletionEngine,  # noqa: F401
-                        InterfaceWrapper, tokenizer_for)
+                        InterfaceWrapper, QueueDeadlineExceeded,
+                        tokenizer_for)
 from .repl import repl  # noqa: F401
 from .rest import RestAPI, serve  # noqa: F401
+from .slo import RequestRecord, ServeSLO  # noqa: F401
 from .sample import (depatchify, render_text_samples, render_video,  # noqa: F401
                      similarity_score)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import typing
 
 import jax
@@ -22,6 +23,29 @@ from ..config import Config
 from ..data.feed import TEXT_AXES
 from ..infer.sampler import make_text_sampler
 from ..nd import NT
+from . import slo
+
+
+class QueueDeadlineExceeded(RuntimeError):
+    """A completion request spent longer than ``cfg.serve_queue_deadline_s``
+    waiting on the serialized engine queue (or arrived past
+    ``serve_queue_limit`` and was shed at admission).  The REST layer maps
+    this to 503 + Retry-After (docs/observability.md "Serving SLOs")."""
+
+    def __init__(self, waited_s: float, deadline_s: float, queue_depth: int,
+                 shed: bool = False):
+        self.waited_s = float(waited_s)
+        self.deadline_s = float(deadline_s)
+        self.queue_depth = int(queue_depth)
+        self.shed = bool(shed)
+        if shed:
+            msg = (f"engine queue full ({queue_depth} waiting >= "
+                   f"serve_queue_limit); request shed at admission")
+        else:
+            msg = (f"queue wait {waited_s:.2f}s exceeded "
+                   f"serve_queue_deadline_s={deadline_s:g}s "
+                   f"({queue_depth} still queued)")
+        super().__init__(msg)
 
 
 class ByteTokenizer:
@@ -118,11 +142,21 @@ class CompletionEngine:
     overwrites)."""
 
     def __init__(self, cfg: Config, params: dict,
-                 force_rebuild: bool = False):
+                 force_rebuild: bool = False,
+                 first_token_callback: typing.Optional[
+                     typing.Callable] = None):
         """``force_rebuild`` pins the rebuild-everything sampler even for
         KV-cache-eligible configs (the similarity debug mode exercises the
-        production rebuild path, reference interface.py:283-302)."""
+        production rebuild path, reference interface.py:283-302).
+
+        ``first_token_callback`` (host ``(tag, token)``) arms the serving
+        TTFT hook in every sampler this engine compiles: the graph notifies
+        the host at the first generated position, carrying the request id
+        the ambient :mod:`slo` record supplied.  None (the default, and
+        every non-serving caller) keeps the sampler graphs byte-identical
+        to the pre-hook ones."""
         self.cfg = cfg
+        self._first_token_cb = first_token_callback
         from ..models import pipeline_params_stacked, unstack_pipeline_params
         if pipeline_params_stacked(cfg, params):
             # pipeline-trained checkpoints store body params stage-stacked;
@@ -143,8 +177,10 @@ class CompletionEngine:
     def _make_sampler(self, cfg: Config):
         from ..infer.kv_cache import cache_eligible, make_cached_text_sampler
         if cache_eligible(cfg) and not self._force_rebuild:
-            return make_cached_text_sampler(cfg, self.params)
-        return make_text_sampler(cfg, self.params)
+            return make_cached_text_sampler(
+                cfg, self.params, first_token_callback=self._first_token_cb)
+        return make_text_sampler(cfg, self.params,
+                                 first_token_callback=self._first_token_cb)
 
     def _sampler_for(self, top_k, top_p):
         """Per-request truncation: the knobs are compile-time static, so
@@ -191,14 +227,33 @@ class CompletionEngine:
             end_row = rows
         else:
             end_row = min(rows, -(-(len(prompt) + max_tokens) // patch))
-        out = self._sampler_for(top_k, top_p)(
-            NT(toks, TEXT_AXES), np.int32(prompt_rows),
-            np.float32(cfg.sampling_temperature if temperature is None
-                       else temperature),
-            sample_key, np.int32(end_row))
-        out = np.asarray(out).reshape(-1)
         end = (rows * patch if max_tokens is None
                else min(rows * patch, len(prompt) + max_tokens))
+        # TTFT hook: route the graph's first-token callback to the ambient
+        # request record (set by the InterfaceWrapper worker) via its id —
+        # the tag is a TRACED argument, so every request shares one
+        # compilation.  Tag 0 = no request / hook unarmed (never dispatched).
+        rec = slo.current()
+        tag = (rec.rid if rec is not None and self._first_token_cb is not None
+               else 0)
+        if rec is not None:
+            rec.tokens_generated = max(0, end - len(prompt))
+        if tag:
+            slo.register_first_token(tag, rec.mark_first_token)
+        try:
+            out = self._sampler_for(top_k, top_p)(
+                NT(toks, TEXT_AXES), np.int32(prompt_rows),
+                np.float32(cfg.sampling_temperature if temperature is None
+                           else temperature),
+                sample_key, np.int32(end_row), np.int32(tag))
+            out = np.asarray(out).reshape(-1)
+        finally:
+            if tag:
+                try:  # flush any in-flight debug callback before unrouting
+                    jax.effects_barrier()
+                except Exception:  # noqa: BLE001 - older toolchains
+                    pass
+                slo.unregister_first_token(tag)
         return out[:end]
 
     def complete_text(self, prompt: str, temperature=None, max_tokens=None,
@@ -208,55 +263,145 @@ class CompletionEngine:
         return self.tokenizer.decode(out[len(ids):])
 
 
+class _Job:
+    """One queued completion: callable + args, the 1-slot result queue, the
+    ambient SLO record snapshotted at enqueue, and the two state events the
+    queue-deadline protocol needs.  ``cancelled`` is only honored while the
+    job is still queued — a worker that already set ``started`` finishes
+    the engine call (its result is simply dropped; the race window between
+    the caller's started-check and the worker's cancelled-check is one
+    instruction wide, so the waste is rare and bounded by one request)."""
+
+    __slots__ = ("fn", "args", "out", "rec", "t_enq", "started", "cancelled",
+                 "retired")
+
+    def __init__(self, fn, args, rec):
+        self.fn = fn
+        self.args = args
+        self.out: "queue.Queue[tuple]" = queue.Queue(1)
+        self.rec = rec
+        self.t_enq = time.monotonic()
+        self.started = threading.Event()
+        self.cancelled = threading.Event()
+        self.retired = False  # left the pending count (claimed OR cancelled)
+
+
 class InterfaceWrapper:
     """Async facade over the engine (reference interface.py:231-280):
     ``complete(..., asynchronous=True)`` returns a handle whose ``fetch()``
     blocks for the result.  ``workers`` (cfg.web_workers, reference
     rest_api.py:86) sets the number of worker threads; ``fetch`` polls its
     result queue every cfg.default_sleep_duration seconds (the reference's
-    Manager-dict poll, interface.py:243)."""
+    Manager-dict poll, interface.py:243).
+
+    Serving-SLO duties (docs/observability.md "Serving SLOs"): the ambient
+    request record is stamped at enqueue (queue depth), claim (queue wait
+    ends / engine busy starts) and completion (engine busy ends), and
+    carried across the thread hop so the engine's TTFT hook can resolve the
+    request id.  ``queue_deadline_s``/``queue_limit`` (default: the
+    config's ``serve_*`` knobs) bound the wait: a request still unclaimed
+    past the deadline — or arriving with ``queue_limit`` jobs already
+    waiting — raises :class:`QueueDeadlineExceeded` instead of hanging."""
 
     def __init__(self, engine: CompletionEngine,
                  workers: typing.Optional[int] = None,
-                 sleep_duration: typing.Optional[float] = None):
+                 sleep_duration: typing.Optional[float] = None,
+                 queue_deadline_s: typing.Optional[float] = None,
+                 queue_limit: typing.Optional[int] = None):
         self.engine = engine
         cfg = engine.cfg
         self.sleep_duration = (cfg.default_sleep_duration
                                if sleep_duration is None else sleep_duration)
+        self.queue_deadline_s = float(
+            getattr(cfg, "serve_queue_deadline_s", 0.0)
+            if queue_deadline_s is None else queue_deadline_s)
+        self.queue_limit = int(getattr(cfg, "serve_queue_limit", 0)
+                               if queue_limit is None else queue_limit)
         n = max(1, int(cfg.web_workers if workers is None else workers))
-        self._q: "queue.Queue[tuple]" = queue.Queue()
+        self._q: "queue.Queue[typing.Optional[_Job]]" = queue.Queue()
+        # live backlog, not _q.qsize(): deadline-cancelled jobs stay in _q
+        # until a worker pops them, and counting those corpses would shed
+        # healthy arrivals, inflate hbnlp_serve_queue_depth, and overprice
+        # Retry-After for as long as the workers stay busy
+        self._pending = 0
+        self._pending_lock = threading.Lock()
         self._threads = []
         for _ in range(n):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()
             self._threads.append(t)
 
+    def queue_depth(self) -> int:
+        with self._pending_lock:
+            return self._pending
+
+    def _retire(self, job: _Job) -> None:
+        # exactly-once under the claim/cancel race (worker sets started
+        # while fetch sets cancelled): whoever gets here first counts
+        with self._pending_lock:
+            if not job.retired:
+                job.retired = True
+                self._pending -= 1
+
     def _worker(self):
         while True:
-            item = self._q.get()
-            if item is None:
+            job = self._q.get()
+            if job is None:
                 self._q.put(None)  # let sibling workers drain too
                 return
-            fn, args, out = item
+            self._retire(job)
+            if job.cancelled.is_set():
+                continue  # caller gave up while queued (deadline 503)
+            job.started.set()
+            rec = job.rec
+            # the record travels with the job: the engine (this thread)
+            # resolves slo.current() for the TTFT tag
+            prev = slo.set_current(rec)
+            if rec is not None:
+                rec.mark_started()
             try:
-                out.put(("ok", fn(*args)))
+                result = ("ok", job.fn(*job.args))
             except Exception as e:  # propagate to caller
-                out.put(("err", e))
+                result = ("err", e)
+            # engine-done must be stamped BEFORE the result is published:
+            # the handler's finish() runs the instant fetch() wakes, and an
+            # unstamped record silently drops its engine/decode observations
+            if rec is not None:
+                rec.mark_engine_done()
+            slo.set_current(prev)
+            job.out.put(result)
 
     def complete(self, prompt: typing.Sequence[int], temperature: float = 0.0,
                  response_len: int = 64, asynchronous: bool = False,
                  top_k: typing.Optional[int] = None,
                  top_p: typing.Optional[float] = None):
-        out: "queue.Queue[tuple]" = queue.Queue(1)
-        self._q.put((self.engine.complete_tokens,
-                     (prompt, temperature, response_len, top_k, top_p), out))
+        depth = self.queue_depth()
+        if self.queue_limit and depth >= self.queue_limit:
+            raise QueueDeadlineExceeded(0.0, self.queue_deadline_s, depth,
+                                        shed=True)
+        rec = slo.current()
+        if rec is not None:
+            rec.mark_enqueued(queue_depth=depth)
+        job = _Job(self.engine.complete_tokens,
+                   (prompt, temperature, response_len, top_k, top_p), rec)
+        with self._pending_lock:
+            self._pending += 1
+        self._q.put(job)
+        deadline = self.queue_deadline_s
 
         def fetch():
             while True:
                 try:
-                    status, value = out.get(timeout=self.sleep_duration)
+                    status, value = job.out.get(timeout=self.sleep_duration)
                     break
                 except queue.Empty:
+                    waited = time.monotonic() - job.t_enq
+                    if (deadline and waited > deadline
+                            and not job.started.is_set()):
+                        job.cancelled.set()
+                        self._retire(job)
+                        raise QueueDeadlineExceeded(waited, deadline,
+                                                    self.queue_depth())
                     continue
             if status == "err":
                 raise value

@@ -23,7 +23,9 @@ from ..config import Config
 from ..obs import exporter as obs_exporter
 from ..obs import spans
 from ..obs.registry import REGISTRY
-from .interface import CompletionEngine, InterfaceWrapper
+from . import slo as slo_mod
+from .interface import (CompletionEngine, InterfaceWrapper,
+                        QueueDeadlineExceeded)
 
 LOG = logging.getLogger("homebrewnlp_tpu.serve.rest")
 
@@ -39,7 +41,8 @@ def request_metrics(registry=None):
     return (reg.counter("hbnlp_serve_requests_total", "REST requests "
                         "served", labelnames=("method", "path", "status")),
             reg.histogram("hbnlp_serve_request_seconds",
-                          "REST request latency", labelnames=("path",)))
+                          "REST request latency", labelnames=("path",),
+                          buckets=slo_mod.SERVE_LATENCY_BUCKETS))
 
 
 def _sanitize_tokens(tokens: typing.Sequence[int], vocab: int) -> typing.List[int]:
@@ -50,7 +53,11 @@ def _sanitize_tokens(tokens: typing.Sequence[int], vocab: int) -> typing.List[in
 class RestAPI:
     def __init__(self, cfg: Config, params: dict):
         self.cfg = cfg
-        self.engine = CompletionEngine(cfg, params)
+        # the engine's samplers carry the TTFT hook: the graph notifies the
+        # host at the first sampled token, tagged with the request id the
+        # ambient SLO record supplies (docs/observability.md "Serving SLOs")
+        self.engine = CompletionEngine(
+            cfg, params, first_token_callback=slo_mod.dispatch_first_token)
         self.wrapper = InterfaceWrapper(self.engine)
 
     # -- endpoints -----------------------------------------------------------
@@ -104,9 +111,13 @@ class RestAPI:
 class _ApiServer(ThreadingHTTPServer):
     """REST server owning an optional obs exporter: any teardown path —
     ``shutdown()``, ``server_close()``, or the context-manager exit (which
-    calls ``server_close``) — also stops the exporter, exactly once."""
+    calls ``server_close``) — also stops the exporter, exactly once, and
+    detaches this server's queue probe from the SLO gauges (the registry
+    outlives the server; a still-bound probe would pin the engine and its
+    params forever)."""
 
     _obs_server = None
+    _slo_probe = None
 
     def shutdown(self):
         super().shutdown()
@@ -120,33 +131,58 @@ class _ApiServer(ThreadingHTTPServer):
         obs, self._obs_server = self._obs_server, None
         if obs is not None:
             obs_exporter.stop_server(obs)
+        probe, self._slo_probe = self._slo_probe, None
+        if probe is not None:
+            self.slo.clear_queue_probe(probe)
 
 
 def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
           port: int = 8000, background: bool = False, api=None,
-          registry=None):
+          registry=None, obs_port: typing.Optional[int] = None):
     """``api`` (tests) substitutes a prebuilt endpoint object; ``registry``
     overrides the process-default obs registry the request log records to.
-    When ``cfg.obs_port`` is set, a /metrics + /healthz exporter runs
-    alongside and is torn down with the returned server (docs/
-    observability.md)."""
+    When ``cfg.obs_port`` is set — or ``obs_port`` is passed explicitly
+    (0 = ephemeral, for tests/bench) — a /metrics + /healthz exporter runs
+    alongside, its ``/healthz`` carrying the ``slo`` summary block, and is
+    torn down with the returned server (docs/observability.md).
+
+    Every request gets an id and a phase-attributed SLO record
+    (parse -> queue wait -> prefill -> decode -> respond, serve/slo.py);
+    a completion whose engine-queue wait exceeds
+    ``cfg.serve_queue_deadline_s`` (or that arrives past
+    ``serve_queue_limit``) is answered 503 with a Retry-After hint instead
+    of hanging."""
     api = api if api is not None else RestAPI(cfg, params)
     endpoints = getattr(api, "ENDPOINTS", RestAPI.ENDPOINTS)
     req_count, req_latency = request_metrics(registry)
+    serve_slo = slo_mod.ServeSLO(registry)
+    wrapper = getattr(api, "wrapper", None)
+    # one bound-method object, installed AND remembered: clear_queue_probe
+    # compares by identity, and each `wrapper.queue_depth` access makes a
+    # fresh bound method
+    slo_probe = (wrapper.queue_depth
+                 if wrapper is not None and hasattr(wrapper, "queue_depth")
+                 else None)
+    if slo_probe is not None:
+        serve_slo.set_queue_probe(slo_probe)
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
-            t0 = time.perf_counter()
             name = self.path.strip("/")
+            known = name in endpoints
+            label = f"/{name}" if known else "other"
+            rec = serve_slo.begin(label)
+            prev = slo_mod.set_current(rec)
             status = 500
             try:
-                if name not in endpoints:
+                if not known:
                     status = 404
                     self.send_error(404)
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length) or b"{}")
+                    rec.mark_parsed()
                     with spans.span(f"serve/{name}"):
                         result = getattr(api, name)(body)
                     payload = json.dumps(result).encode()
@@ -156,19 +192,35 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
+                except QueueDeadlineExceeded as e:
+                    # the engine queue is the serialization bottleneck this
+                    # module measures; when it exceeds the configured
+                    # deadline the client gets a retryable answer, not a hang
+                    status = 503
+                    retry = serve_slo.retry_after_s(e.deadline_s)
+                    payload = json.dumps(
+                        {"error": str(e), "retry_after_s": retry}).encode()
+                    self.send_response(503)
+                    self.send_header("Retry-After", str(retry))
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                 except Exception as e:
                     status = 500
                     self.send_error(500, str(e))
             finally:
+                slo_mod.set_current(prev)
                 # structured per-request record: registry metrics + a
-                # debug-level log line, quiet on stdout by default
-                label = f"/{name}" if name in endpoints else "other"
-                dt = time.perf_counter() - t0
+                # debug-level log line, quiet on stdout by default; finish()
+                # closes the SLO record (phase histograms + span trail)
+                dt = time.perf_counter() - rec.t_arrival
                 req_count.labels(method="POST", path=label,
                                  status=str(status)).inc()
                 req_latency.labels(path=label).observe(dt)
-                LOG.debug("request method=POST path=%s status=%d "
-                          "latency_ms=%.1f", label, status, dt * 1e3)
+                serve_slo.finish(rec, status)
+                LOG.debug("request id=%d method=POST path=%s status=%d "
+                          "latency_ms=%.1f", rec.rid, label, status, dt * 1e3)
 
         def log_message(self, fmt, *args):
             # per-request records go through the registry metrics; raw
@@ -176,11 +228,15 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
             LOG.debug("%s %s", self.address_string(), fmt % args)
 
     server = _ApiServer((host, port), Handler)
-    if cfg is not None and getattr(cfg, "obs_port", 0):
+    server.slo = serve_slo  # tests/bench read summaries off the live server
+    server._slo_probe = slo_probe
+    eff_obs = (obs_port if obs_port is not None
+               else (getattr(cfg, "obs_port", 0) if cfg is not None else 0))
+    if obs_port is not None or eff_obs:
         try:
             server._obs_server = obs_exporter.start_server(
-                cfg.obs_port, registry=registry if registry is not None
-                else REGISTRY)
+                eff_obs, registry=registry if registry is not None
+                else REGISTRY, slo_probe=serve_slo.summary)
         except OSError:
             server.server_close()  # don't leak the bound REST socket
             raise

@@ -1,0 +1,384 @@
+"""Per-request serving SLO instrumentation (docs/observability.md
+"Serving SLOs").
+
+The REST layer serializes every sampler call behind the engine queue
+(faithful to the reference's Manager-queue bridge) — fine for one user, the
+bottleneck for many.  Before continuous batching can replace it, that cost
+has to be *measured*: every request gets an id and a phase-attributed trail
+
+    parse -> queue_wait -> prefill -> decode -> respond
+
+recorded as a :class:`RequestRecord` whose stamps come from three different
+threads (the HTTP handler parses and responds, an ``InterfaceWrapper``
+worker runs the engine, a JAX host callback marks the first sampled token).
+On completion the record feeds:
+
+- registry histograms — TTFT, queue wait, engine busy, decode tokens/s —
+  next to the existing ``hbnlp_serve_request_seconds`` e2e histogram, plus
+  the ``hbnlp_serve_inflight`` gauge, all on ``/metrics``;
+- the span tracer (``obs/spans.py``), as a per-phase trail tagged with the
+  request id, so an ``obs_spans`` capture shows each request's anatomy on
+  the Perfetto timeline;
+- ``summary()`` — p50/p95/p99 per phase + error rate — mirrored under
+  ``/healthz`` ``slo`` (quantiles via the shared bucket-interpolated
+  estimator, ``obs.registry.bucket_quantile``).
+
+Phase semantics: **TTFT** is measured from request *arrival* (what a caller
+experiences), so it includes parse + queue wait + prefill + the first
+decode step.  **queue_wait** is the time between enqueue and an engine
+worker claiming the request — the serialization cost this module exists to
+expose, split out of the e2e number that used to hide it.  **prefill** is
+engine start -> first token; **decode** is first token -> engine done.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+import time
+import typing
+
+from ..obs import spans
+from ..obs.registry import (DEFAULT_BUCKETS, REGISTRY, Histogram,
+                            MetricsRegistry, bucket_quantile)
+
+#: decode-rate buckets (tokens/second) — latency buckets make no sense here
+DECODE_RATE_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                       500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+#: latency buckets for every serving SLO histogram: DEFAULT_BUCKETS
+#: resolution below 60 s plus a tail out to 600 s — a serialized engine on
+#: a slow host (the committed CPU bench operating point sits past 60 s)
+#: must still land in a finite bucket, or every server-side percentile
+#: clamps to 60 and serialization overhead becomes clamp error.  Shared
+#: with rest.request_metrics: bucket sets are first-registration-wins, so
+#: both registration sites must agree.
+SERVE_LATENCY_BUCKETS = DEFAULT_BUCKETS + (120.0, 300.0, 600.0)
+
+_REQUEST_IDS = itertools.count(1)
+_CURRENT = threading.local()
+
+
+class RequestRecord:
+    """Mutable per-request stamp sheet.  Each ``mark_*`` records a
+    ``time.perf_counter`` instant; writers are on different threads but
+    each field has exactly one writer, and ``mark_first_token`` keeps the
+    FIRST stamp (the JAX callback contract is at-most-once anyway)."""
+
+    __slots__ = ("rid", "path", "t_arrival", "t_parsed", "t_enqueued",
+                 "t_started", "t_first_token", "t_engine_done", "t_finished",
+                 "queue_depth", "tokens_generated", "status")
+
+    def __init__(self, rid: int, path: str = ""):
+        self.rid = rid
+        self.path = path
+        self.t_arrival = time.perf_counter()
+        self.t_parsed: typing.Optional[float] = None
+        self.t_enqueued: typing.Optional[float] = None
+        self.t_started: typing.Optional[float] = None
+        self.t_first_token: typing.Optional[float] = None
+        self.t_engine_done: typing.Optional[float] = None
+        self.t_finished: typing.Optional[float] = None
+        self.queue_depth: typing.Optional[int] = None
+        self.tokens_generated: typing.Optional[int] = None
+        self.status: typing.Optional[int] = None
+
+    # -- stamps (one writer each) -------------------------------------------
+    def mark_parsed(self) -> None:
+        self.t_parsed = time.perf_counter()
+
+    def mark_enqueued(self, queue_depth: typing.Optional[int] = None) -> None:
+        self.t_enqueued = time.perf_counter()
+        self.queue_depth = queue_depth
+
+    def mark_started(self) -> None:
+        self.t_started = time.perf_counter()
+
+    def mark_first_token(self, token: typing.Optional[int] = None) -> None:
+        # first stamp wins; `token` (the sampled id) is accepted so the
+        # engine dispatcher can hand the callback straight through
+        if self.t_first_token is None:
+            self.t_first_token = time.perf_counter()
+
+    def mark_engine_done(self) -> None:
+        self.t_engine_done = time.perf_counter()
+
+    def mark_finished(self, status: int) -> None:
+        self.t_finished = time.perf_counter()
+        self.status = int(status)
+
+    # -- derived phase durations (None until both stamps exist) -------------
+    @staticmethod
+    def _dt(t0, t1) -> typing.Optional[float]:
+        return None if t0 is None or t1 is None else max(0.0, t1 - t0)
+
+    def e2e_s(self):
+        return self._dt(self.t_arrival, self.t_finished)
+
+    def parse_s(self):
+        return self._dt(self.t_arrival, self.t_parsed)
+
+    def queue_wait_s(self):
+        return self._dt(self.t_enqueued, self.t_started)
+
+    def ttft_s(self):
+        return self._dt(self.t_arrival, self.t_first_token)
+
+    def prefill_s(self):
+        return self._dt(self.t_started, self.t_first_token)
+
+    def decode_s(self):
+        return self._dt(self.t_first_token, self.t_engine_done)
+
+    def engine_s(self):
+        return self._dt(self.t_started, self.t_engine_done)
+
+    def decode_tokens_per_sec(self) -> typing.Optional[float]:
+        dt = self.decode_s()
+        if dt is None or not self.tokens_generated:
+            return None
+        # the first token belongs to prefill_s; rate covers the rest
+        n = self.tokens_generated - 1
+        return None if n <= 0 or dt <= 0 else n / dt
+
+
+# -- TTFT host dispatcher -----------------------------------------------------
+#
+# The samplers carry their request id as a TRACED int32 tag (one compilation
+# serves every request); the graph-side ``jax.debug.callback`` lands here on
+# the host, and this table resolves the tag back to the per-request sink.
+
+_TTFT_LOCK = threading.Lock()
+_TTFT_SINKS: typing.Dict[int, typing.Callable] = {}
+
+
+def register_first_token(tag: int, sink: typing.Callable) -> None:
+    """Route first-token callbacks carrying ``tag`` to ``sink(token)`` until
+    unregistered.  Tag 0 is reserved for "no request" (the samplers'
+    default) and is never dispatched."""
+    with _TTFT_LOCK:
+        _TTFT_SINKS[int(tag)] = sink
+
+
+def unregister_first_token(tag: int) -> None:
+    with _TTFT_LOCK:
+        _TTFT_SINKS.pop(int(tag), None)
+
+
+def dispatch_first_token(tag, token) -> None:
+    """Host side of the sampler's first-token callback (``infer/sampler.py::
+    _fire_first_token``): resolve the traced tag to the registered sink.  An
+    unknown tag (request already finished, or a non-serving caller) is a
+    no-op — the callback contract is best-effort by design."""
+    with _TTFT_LOCK:
+        sink = _TTFT_SINKS.get(int(tag))
+    if sink is not None:
+        sink(int(token))
+
+
+# -- ambient current record (handler thread -> endpoint -> wrapper) ----------
+
+def set_current(rec: typing.Optional[RequestRecord]
+                ) -> typing.Optional[RequestRecord]:
+    """Install the handler thread's in-flight record; returns the previous
+    one.  Endpoint methods and ``InterfaceWrapper.complete`` run on the
+    SAME thread as the handler that set it, so no signatures change for
+    the record to reach the queue."""
+    prev = getattr(_CURRENT, "record", None)
+    _CURRENT.record = rec
+    return prev
+
+
+def current() -> typing.Optional[RequestRecord]:
+    return getattr(_CURRENT, "record", None)
+
+
+class ServeSLO:
+    """Owns the serving SLO metrics on one registry and turns finished
+    :class:`RequestRecord`\\ s into histogram observations + span trails.
+    Registration is idempotent (the registry contract), so repeated
+    ``serve()`` calls in one process share the series."""
+
+    def __init__(self, registry: typing.Optional[MetricsRegistry] = None):
+        reg = registry if registry is not None else REGISTRY
+        self.registry: MetricsRegistry = reg
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self.ttft = reg.histogram(
+            "hbnlp_serve_ttft_seconds",
+            "request arrival -> first sampled token (parse + queue wait + "
+            "prefill + first decode step)", buckets=SERVE_LATENCY_BUCKETS)
+        self.queue_wait = reg.histogram(
+            "hbnlp_serve_queue_wait_seconds",
+            "enqueue -> engine worker claim (the engine-serialization cost)",
+            buckets=SERVE_LATENCY_BUCKETS)
+        self.engine = reg.histogram(
+            "hbnlp_serve_engine_seconds",
+            "engine busy time per request (prefill + decode)",
+            buckets=SERVE_LATENCY_BUCKETS)
+        self.decode_rate = reg.histogram(
+            "hbnlp_serve_decode_tokens_per_sec",
+            "per-request decode rate after the first token",
+            buckets=DECODE_RATE_BUCKETS)
+        self.e2e = reg.histogram(
+            "hbnlp_serve_request_seconds", "REST request latency",
+            labelnames=("path",), buckets=SERVE_LATENCY_BUCKETS)
+        self.requests = reg.counter(
+            "hbnlp_serve_requests_total", "REST requests served",
+            labelnames=("method", "path", "status"))
+        reg.gauge("hbnlp_serve_inflight",
+                  "requests currently being handled (accepted, not yet "
+                  "responded)", fn=self.inflight)
+        self._queue_probe: typing.Optional[typing.Callable[[], int]] = None
+        reg.gauge("hbnlp_serve_queue_depth",
+                  "completion requests waiting on the engine queue",
+                  fn=self.queue_depth)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def set_queue_probe(self, fn: typing.Callable[[], int]) -> None:
+        """Live engine-queue depth source (``InterfaceWrapper``'s queue);
+        graftload samples the resulting gauge over time for its queue-depth
+        trace."""
+        self._queue_probe = fn
+
+    def clear_queue_probe(self, fn: typing.Callable[[], int]) -> None:
+        """Detach ``fn`` if it is still the installed probe (a probe a
+        later server installed stays).  Server teardown calls this: the
+        registry's gauge callback otherwise pins probe -> wrapper ->
+        engine -> params (the full model weights) for the process
+        lifetime."""
+        if self._queue_probe is fn:
+            self._queue_probe = None
+
+    def queue_depth(self) -> int:
+        probe = self._queue_probe
+        if probe is None:
+            return 0
+        try:
+            return int(probe())
+        except Exception:  # noqa: BLE001 - a dying queue must not kill /metrics
+            return 0
+
+    def retry_after_s(self, deadline_s: float = 0.0) -> int:
+        """Whole-second Retry-After hint for a shed/timed-out request: the
+        current backlog priced at the engine's median busy time (the
+        serialized engine drains one request per engine_s), floored at 1s;
+        before any engine history exists, the deadline itself.
+
+        Backlog is the LARGER of the two views, never their sum: every
+        queued completion's handler is also counted in-flight (it blocks
+        in fetch), so adding them would double-count and tell clients to
+        back off ~2x longer than the drain actually takes.  inflight − 1
+        excludes the rejected request asking for the hint; queue depth
+        alone misses the request the engine is executing."""
+        p50 = self.engine.quantile(0.5)
+        backlog = max(self.queue_depth(), self.inflight() - 1, 1)
+        if p50 is not None and p50 > 0:
+            return max(1, int(math.ceil(p50 * backlog)))
+        return max(1, int(math.ceil(deadline_s))) if deadline_s else 1
+
+    def begin(self, path: str = "") -> RequestRecord:
+        with self._lock:
+            self._inflight += 1
+        return RequestRecord(next(_REQUEST_IDS), path)
+
+    def finish(self, rec: RequestRecord, status: int) -> RequestRecord:
+        """Close the record: decrement in-flight, observe every phase whose
+        stamps exist, and emit the span trail.  The e2e histogram +
+        request counter stay with the REST handler (they predate this
+        module and cover non-engine endpoints too)."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+        rec.mark_finished(status)
+        qw = rec.queue_wait_s()
+        if qw is None and rec.t_enqueued is not None:
+            # rejected while still QUEUED (deadline 503): its wait ended at
+            # the rejection — leaving it out would bias the queue-wait SLO
+            # low exactly under the overload it exists to expose.  (A
+            # shed-at-admission request never enqueued and records nothing.)
+            qw = max(0.0, rec.t_finished - rec.t_enqueued)
+        for hist, val in ((self.queue_wait, qw),
+                          (self.engine, rec.engine_s()),
+                          (self.ttft, rec.ttft_s()),
+                          (self.decode_rate, rec.decode_tokens_per_sec())):
+            if val is not None:
+                hist.observe(val)
+        self._emit_spans(rec)
+        return rec
+
+    def _emit_spans(self, rec: RequestRecord) -> None:
+        """The phase trail on the ambient tracer (no-op when obs is off):
+        one parent serve/request span + one child per phase that has both
+        stamps, all tagged with the request id."""
+        tag = {"id": rec.rid, "path": rec.path, "status": rec.status}
+        phases = (("serve/request", rec.t_arrival, rec.t_finished),
+                  ("serve/parse", rec.t_arrival, rec.t_parsed),
+                  ("serve/queue_wait", rec.t_enqueued, rec.t_started),
+                  ("serve/prefill", rec.t_started, rec.t_first_token),
+                  ("serve/decode", rec.t_first_token, rec.t_engine_done),
+                  ("serve/respond", rec.t_engine_done, rec.t_finished))
+        for name, t0, t1 in phases:
+            if t0 is not None and t1 is not None:
+                spans.add(name, t0, t1, **tag)
+
+    # -- /healthz summary ----------------------------------------------------
+    #: e2e percentiles in the slo block cover only these path children —
+    #: the phases (ttft/queue_wait/engine) exist only for completions, and
+    #: merging in sub-millisecond /encode//healthz-probe/404 requests would
+    #: drag e2e_s below engine_s and make e2e − engine meaningless
+    COMPLETION_PATHS = ("/token_completion", "/completion")
+
+    def _completion_e2e_pcts(self) -> typing.Optional[dict]:
+        merged: typing.Optional[list] = None
+        for path in self.COMPLETION_PATHS:
+            snap = self.e2e.snapshot(path=path)
+            if snap["count"]:
+                counts = snap["counts"]
+                merged = (counts if merged is None
+                          else [a + b for a, b in zip(merged, counts)])
+        if merged is None:
+            return None
+        out = {}
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = bucket_quantile(self.e2e.buckets, merged, q)
+            if v is None:
+                return None
+            out[key] = round(v, 6)
+        return out
+
+    def _pcts(self, hist: Histogram) -> typing.Optional[dict]:
+        if hist.count() == 0 and not hist.labelnames:
+            return None
+        out = {}
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = hist.quantile(q)
+            if v is None:
+                return None
+            out[key] = round(v, 6)
+        return out
+
+    def summary(self) -> dict:
+        """The /healthz ``slo`` block: request totals, error rate, current
+        in-flight depth, and p50/p95/p99 per phase — every percentile via
+        the ONE shared bucket-interpolated estimator."""
+        total = errors = 0.0
+        for labels, n in self.requests.items().items():
+            total += n
+            try:  # label order is (method, path, status)
+                if int(labels[2]) >= 500:
+                    errors += n
+            except (IndexError, ValueError):
+                pass
+        return {
+            "requests_total": int(total),
+            "error_rate": round(errors / total, 6) if total else None,
+            "inflight": self.inflight(),
+            "e2e_s": self._completion_e2e_pcts(),
+            "ttft_s": self._pcts(self.ttft),
+            "queue_wait_s": self._pcts(self.queue_wait),
+            "engine_s": self._pcts(self.engine),
+            "decode_tokens_per_sec": self._pcts(self.decode_rate),
+        }

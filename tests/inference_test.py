@@ -84,6 +84,32 @@ def test_completion_engine_text_roundtrip(cfg_params):
     wrapper.close()
 
 
+def test_effective_truncation_bucketing():
+    """The compile-cache bucketing contract (serve/interface.py): requested
+    top_k rounds UP to the next power of two capped at vocab, top_p snaps
+    to the 0.05 grid, and None keeps the config's exact knob un-bucketed —
+    the values completion responses echo back."""
+    from homebrewnlp_tpu.serve.interface import effective_truncation
+    cfg = _small_cfg(sampling_top_k=6, sampling_top_p=0.33)
+    # None keeps the config's EXACT values (no bucketing)
+    assert effective_truncation(cfg, None, None) == (6, 0.33)
+    # k rounds up to the next power of two; exact powers stay put
+    assert effective_truncation(cfg, 3, None)[0] == 4
+    assert effective_truncation(cfg, 4, None)[0] == 4
+    assert effective_truncation(cfg, 5, None)[0] == 8
+    assert effective_truncation(cfg, 1, None)[0] == 1
+    # capped at vocab (32), and 0 = disabled passes through
+    assert effective_truncation(cfg, 1000, None)[0] == cfg.vocab_size
+    assert effective_truncation(cfg, 0, None)[0] == 0
+    # p snaps to the 0.05 grid, floored at 0.05, >= 1 collapses to 1.0
+    assert effective_truncation(cfg, None, 0.42)[1] == pytest.approx(0.4)
+    assert effective_truncation(cfg, None, 0.43)[1] == pytest.approx(0.45)
+    assert effective_truncation(cfg, None, 0.01)[1] == pytest.approx(0.05)
+    assert effective_truncation(cfg, None, 1.7)[1] == 1.0
+    # both requested at once bucket independently
+    assert effective_truncation(cfg, 9, 0.87) == (16, pytest.approx(0.85))
+
+
 def test_rest_api_endpoints(cfg_params):
     cfg, params = cfg_params
     from homebrewnlp_tpu.serve import serve

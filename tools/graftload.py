@@ -1,0 +1,519 @@
+#!/usr/bin/env python
+"""graftload: serving load generator + client-vs-server SLO reconciliation.
+
+Drives the REST server (``serve/rest.py``) with a fixed-seed prompt corpus
+in open- or closed-loop mode, logs every request (JSONL/CSV), and computes
+a report from CLIENT-side wall-clock timestamps — e2e latency percentiles,
+goodput tok/s, error/shed rates, an in-flight-depth trace — then reconciles
+it against the SERVER's own ``/metrics`` histograms (TTFT, queue wait,
+engine busy, e2e), the same predict-vs-measure discipline graftprof applies
+to device time (docs/observability.md "Serving SLOs").
+
+Modes:
+  closed  N worker threads, each holding at most one request in flight
+          (concurrency = offered load; ``--ramp-s`` staggers worker starts
+          so the queue-depth trace shows the knee)
+  open    requests fire on a fixed schedule (``--rate`` req/s) regardless
+          of completions — the arrival process a public endpoint actually
+          sees; latency under overload grows without the closed loop's
+          self-throttling
+
+Percentiles: client-side numbers use the exact order-statistic estimator,
+server-side numbers the bucket-interpolated estimator — BOTH from
+``obs/registry.py`` (``sample_quantile`` / ``bucket_quantile``), the one
+shared percentile implementation.  Reconciliation tolerance (documented):
+
+    tol = bucket_width_at(server_p50) + max(0.05, 0.25 * server_p50)
+
+i.e. one histogram bucket (the estimator's resolution floor) plus a 25%
+margin for client-stack overhead — a disagreement inside it is not
+measurable by the histogram.
+
+Usage:
+  python tools/graftload.py --url http://127.0.0.1:8000 \
+      --metrics-url http://127.0.0.1:9090 --requests 50 --concurrency 4 \
+      --log load.jsonl --json
+  python tools/graftload.py --url ... --mode open --rate 10 --check
+
+Exit codes: 0 ok; 1 when ``--check`` and the reconciliation disagrees or
+the error rate exceeds ``--max-error-rate``; 2 usage/connection errors.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import math
+import os
+import random
+import re
+import sys
+import threading
+import time
+import typing
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from homebrewnlp_tpu.obs.registry import (bucket_quantile,  # noqa: E402
+                                          bucket_width_at, sample_quantile)
+
+#: client-side percentile keys every report section carries
+QUANTILES = ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))
+
+#: server histogram series -> report keys (serve/slo.py owns the series)
+SERVER_SERIES = (("e2e_s", "hbnlp_serve_request_seconds"),
+                 ("ttft_s", "hbnlp_serve_ttft_seconds"),
+                 ("queue_wait_s", "hbnlp_serve_queue_wait_seconds"),
+                 ("engine_s", "hbnlp_serve_engine_seconds"),
+                 ("decode_tokens_per_sec",
+                  "hbnlp_serve_decode_tokens_per_sec"))
+
+
+def make_corpus(seed: int, n: int, vocab: int = 256, min_len: int = 4,
+                max_len: int = 24) -> typing.List[typing.List[int]]:
+    """Deterministic token-id prompt corpus: same (seed, n, vocab, bounds)
+    -> byte-identical prompts on every machine, so two graftload runs (or a
+    run and the bench serving row) drive the exact same work."""
+    rng = random.Random(seed)
+    lo, hi = max(1, int(min_len)), max(1, int(max_len))
+    if hi < lo:
+        lo, hi = hi, lo
+    return [[rng.randrange(1, max(2, vocab)) for _ in range(rng.randint(lo, hi))]
+            for _ in range(max(1, n))]
+
+
+def _post(url: str, body: dict, timeout_s: float) -> typing.Tuple[int, dict]:
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        return r.status, json.loads(r.read() or b"{}")
+
+
+def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
+             n_requests: int, concurrency: int = 4, mode: str = "closed",
+             rate: typing.Optional[float] = None, ramp_s: float = 0.0,
+             response_len: int = 16, temperature: float = 1.0,
+             timeout_s: float = 300.0, trace_interval_s: float = 0.05
+             ) -> typing.Tuple[typing.List[dict], typing.List[list], float,
+                               bool]:
+    """Fire ``n_requests`` at ``url``/token_completion; returns
+    ``(records, inflight_trace, duration_s, truncated)``.  Every request
+    yields one record (id, prompt/response sizes, client timestamps,
+    status, e2e); the trace is ``[t_s, inflight]`` samples at
+    ``trace_interval_s``.  ``truncated`` is True when a worker outlived
+    the join budget (per-worker request share x ``timeout_s``) — the
+    records then cover only part of the run and must not be treated as a
+    complete measurement (drive/check/bench all refuse to)."""
+    endpoint = url.rstrip("/") + "/token_completion"
+    lock = threading.Lock()
+    records: typing.List[dict] = []
+    inflight = [0]
+    trace: typing.List[list] = []
+    done = threading.Event()
+    t_start = time.perf_counter()
+
+    def sample_trace():
+        while not done.wait(trace_interval_s):
+            with lock:
+                trace.append([round(time.perf_counter() - t_start, 4),
+                              inflight[0]])
+
+    def one(i: int) -> None:
+        prompt = list(corpus[i % len(corpus)])
+        rec = {"id": i, "prompt_len": len(prompt),
+               "t_send_s": round(time.perf_counter() - t_start, 6),
+               "status": 0, "tokens_generated": 0}
+        with lock:
+            inflight[0] += 1
+        t0 = time.perf_counter()
+        try:
+            status, out = _post(endpoint,
+                                {"prompt": prompt, "temperature": temperature,
+                                 "response_len": response_len}, timeout_s)
+            rec["status"] = status
+            comp = out.get("completion")
+            if isinstance(comp, list):
+                rec["tokens_generated"] = max(0, len(comp) - len(prompt))
+        except urllib.error.HTTPError as e:
+            rec["status"] = e.code
+            retry = e.headers.get("Retry-After")
+            if retry is not None:
+                rec["retry_after_s"] = float(retry)
+            e.read()  # drain so the connection can be reused/closed cleanly
+        except Exception as e:  # noqa: BLE001 - timeouts/conn errors -> record
+            rec["error"] = f"{type(e).__name__}: {e}"[:200]
+        finally:
+            rec["e2e_s"] = round(time.perf_counter() - t0, 6)
+            with lock:
+                inflight[0] -= 1
+                records.append(rec)
+
+    tracer = threading.Thread(target=sample_trace, daemon=True)
+    tracer.start()
+    threads: typing.List[threading.Thread] = []
+    if mode == "closed":
+        counter = [0]
+
+        def worker(k: int) -> None:
+            if ramp_s and concurrency > 1:
+                # stagger starts across the ramp so the in-flight trace
+                # records the latency knee, not just the plateau
+                time.sleep(ramp_s * k / (concurrency - 1))
+            while True:
+                with lock:
+                    i = counter[0]
+                    if i >= n_requests:
+                        return
+                    counter[0] += 1
+                one(i)
+
+        threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+                   for k in range(max(1, concurrency))]
+        for t in threads:
+            t.start()
+    elif mode == "open":
+        if not rate or rate <= 0:
+            raise ValueError("open-loop mode needs --rate > 0 (req/s)")
+        for i in range(n_requests):
+            when = t_start + i / rate
+            delay = when - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t = threading.Thread(target=one, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+    else:
+        raise ValueError(f"unknown mode {mode!r} (closed|open)")
+    # join budget scales with each worker's request share: a closed-loop
+    # worker serves ~n/concurrency requests SEQUENTIALLY, each bounded by
+    # its own HTTP timeout_s — a flat timeout would abandon slow-but-alive
+    # runs and report partial records as if they were the whole run
+    share = (-(-n_requests // max(1, concurrency)) if mode == "closed" else 1)
+    deadline = time.monotonic() + share * timeout_s + ramp_s + 60.0
+    truncated = False
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+        truncated = truncated or t.is_alive()
+    done.set()
+    tracer.join(timeout=5.0)
+    with lock:  # snapshot: a truncated run's workers may still append
+        records = list(records)
+    return records, trace, time.perf_counter() - t_start, truncated
+
+
+def _pcts(samples: typing.Sequence[float]) -> typing.Optional[dict]:
+    if not samples:
+        return None
+    out = {key: round(sample_quantile(samples, q), 6) for q, key in QUANTILES}
+    out["mean"] = round(sum(samples) / len(samples), 6)
+    out["max"] = round(max(samples), 6)
+    return out
+
+
+def client_report(records: typing.Sequence[dict],
+                  trace: typing.Sequence[list], duration_s: float,
+                  truncated: bool = False) -> dict:
+    """Client-side arm of the reconciliation: exact percentiles over this
+    process's own wall-clock measurements.  ``truncated`` (run_load gave
+    up on a live worker) marks the whole report partial."""
+    ok = [r for r in records if r.get("status") == 200]
+    tokens = sum(int(r.get("tokens_generated") or 0) for r in ok)
+    n = len(records)
+    thin = max(1, len(trace) // 200)  # bound the trace the report embeds
+    return {
+        "truncated": bool(truncated),
+        "n_requests": n,
+        "n_ok": len(ok),
+        "n_rejected": sum(1 for r in records if r.get("status") == 503),
+        "error_rate": (round(sum(1 for r in records
+                                 if r.get("status") != 200) / n, 4)
+                       if n else None),
+        "duration_s": round(duration_s, 3),
+        "requests_per_s": round(n / duration_s, 3) if duration_s > 0 else None,
+        "goodput_tok_s": (round(tokens / duration_s, 2)
+                          if duration_s > 0 else None),
+        "e2e_s": _pcts([r["e2e_s"] for r in ok]),
+        "inflight_trace": [list(p) for p in trace[::thin]],
+    }
+
+
+# -- Prometheus text parsing (the client's view of the server's histograms) --
+
+_SAMPLE_RE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)"
+                        r"(?:\{(.*)\})?\s+(\S+)\s*$")
+_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prom(text: str) -> typing.Dict[str, typing.List[tuple]]:
+    """{metric sample name: [(labels dict, float value), ...]} from
+    Prometheus text exposition (0.0.4) — just enough parser for the
+    registry's own renderer; comments and malformed lines are skipped."""
+    out: typing.Dict[str, typing.List[tuple]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels_s, value_s = m.groups()
+        try:
+            value = float(value_s)
+        except ValueError:
+            continue
+        labels = {k: v.replace(r"\"", '"').replace(r"\\", "\\")
+                  for k, v in _LABEL_RE.findall(labels_s or "")}
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def histogram_snapshot(metrics: typing.Dict[str, typing.List[tuple]],
+                       name: str,
+                       match: typing.Optional[dict] = None
+                       ) -> typing.Optional[dict]:
+    """{"buckets", "counts" (NON-cumulative, +Inf last), "sum", "count"}
+    for one histogram, summed across label children that contain ``match``;
+    None when the series is absent or empty."""
+    match = match or {}
+
+    def keep(labels: dict) -> bool:
+        return all(labels.get(k) == v for k, v in match.items())
+
+    by_le: typing.Dict[float, float] = {}
+    for labels, value in metrics.get(name + "_bucket", []):
+        if "le" not in labels or not keep(labels):
+            continue
+        le = math.inf if labels["le"] == "+Inf" else float(labels["le"])
+        by_le[le] = by_le.get(le, 0.0) + value
+    if not by_le:
+        return None
+    edges = sorted(by_le)
+    cum = [by_le[e] for e in edges]
+    counts = [cum[0]] + [cum[i] - cum[i - 1] for i in range(1, len(cum))]
+    total = sum(v for labels, v in metrics.get(name + "_count", [])
+                if keep(labels))
+    ssum = sum(v for labels, v in metrics.get(name + "_sum", [])
+               if keep(labels))
+    if total <= 0:
+        return None
+    buckets = [e for e in edges if e != math.inf]
+    if len(counts) == len(buckets):  # renderer always emits +Inf, but be safe
+        counts.append(0.0)
+    return {"buckets": buckets, "counts": counts, "sum": ssum,
+            "count": int(total)}
+
+
+def server_report(metrics_text: str) -> dict:
+    """Server-side arm: bucket-interpolated percentiles over the /metrics
+    histograms serve/slo.py records (the completion path only — the e2e
+    series is filtered to /token_completion, what graftload drives)."""
+    metrics = parse_prom(metrics_text)
+    out: dict = {}
+    for key, name in SERVER_SERIES:
+        match = ({"path": "/token_completion"}
+                 if name == "hbnlp_serve_request_seconds" else None)
+        snap = histogram_snapshot(metrics, name, match)
+        if snap is None:
+            continue
+        row = {k: round(bucket_quantile(snap["buckets"], snap["counts"], q), 6)
+               for q, k in QUANTILES}
+        row["mean"] = round(snap["sum"] / snap["count"], 6)
+        row["count"] = snap["count"]
+        out[key] = row
+    for gauge in ("hbnlp_serve_inflight", "hbnlp_serve_queue_depth"):
+        for _, value in metrics.get(gauge, []):
+            out[gauge.replace("hbnlp_serve_", "")] = value
+    return out
+
+
+def reconcile_report(client: dict, metrics_text: str) -> dict:
+    """Client p50 e2e vs the server's own e2e histogram, inside the
+    documented tolerance (module docstring), plus the serialization
+    overhead the batching PR will be judged against:
+    ``client p50 e2e − server p50 engine-busy`` = everything that is NOT
+    the model (parse + queue wait + respond + client stack).
+
+    Defined over CLEAN runs only: the server's e2e histogram has no status
+    label, so fast 503 rejections would sit in the server arm while the
+    client arm filters to 200s — under shedding the comparison would flag
+    two perfectly healthy clocks.  Any client-side error/rejection skips
+    the reconciliation with a reason instead."""
+    err = client.get("error_rate")
+    if err:
+        return {"skipped": f"client error_rate={err}: non-200 responses "
+                           "share the server histogram (no status label); "
+                           "reconciliation is defined over clean runs"}
+    metrics = parse_prom(metrics_text)
+    snap = histogram_snapshot(metrics, "hbnlp_serve_request_seconds",
+                              {"path": "/token_completion"})
+    c = (client.get("e2e_s") or {}).get("p50")
+    if snap is None or c is None:
+        return {"skipped": "client or server p50 unavailable"}
+    s = bucket_quantile(snap["buckets"], snap["counts"], 0.5)
+    width = bucket_width_at(snap["buckets"], s)
+    tol = (width if width != math.inf else 0.0) + max(0.05, 0.25 * s)
+    out = {"client_p50_e2e_s": round(c, 6),
+           "server_p50_e2e_s": round(s, 6),
+           "abs_diff_s": round(abs(c - s), 6),
+           "tolerance_s": round(tol, 6),
+           "within_tolerance": bool(abs(c - s) <= tol)}
+    eng = histogram_snapshot(metrics, "hbnlp_serve_engine_seconds")
+    if eng is not None:
+        e50 = bucket_quantile(eng["buckets"], eng["counts"], 0.5)
+        out["server_p50_engine_s"] = round(e50, 6)
+        out["serialization_overhead_s"] = round(max(0.0, c - e50), 6)
+    return out
+
+
+def check_ok(report: dict, max_error_rate: float = 0.0) -> bool:
+    """The ``--check`` verdict as a pure function: the error rate must be
+    within ``max_error_rate``, and the reconciliation must either agree
+    within tolerance or have been skipped *because of* that tolerated
+    non-zero error rate (reconcile_report is defined over clean runs only).
+    Any other skip — no metrics URL, missing p50 — still fails, as does a
+    truncated run (run_load abandoned a live worker: partial records)."""
+    rec = report.get("reconcile", {})
+    client = report.get("client") or {}
+    if client.get("truncated"):
+        return False
+    err = client.get("error_rate")
+    err_ok = err is not None and err <= max_error_rate
+    rec_ok = (rec.get("within_tolerance", False)
+              or ("skipped" in rec and bool(err)))
+    return err_ok and rec_ok
+
+
+# -- per-request log ----------------------------------------------------------
+
+LOG_FIELDS = ("id", "t_send_s", "e2e_s", "status", "prompt_len",
+              "tokens_generated", "retry_after_s", "error")
+
+
+def write_log(records: typing.Sequence[dict], path: str,
+              fmt: typing.Optional[str] = None) -> str:
+    """JSONL (default) or CSV per-request log; format inferred from the
+    extension when ``fmt`` is None."""
+    fmt = fmt or ("csv" if path.endswith(".csv") else "jsonl")
+    if fmt == "csv":
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=LOG_FIELDS, extrasaction="ignore")
+            w.writeheader()
+            for r in records:
+                w.writerow(r)
+    else:
+        with open(path, "w") as f:
+            for r in records:
+                f.write(json.dumps(r, sort_keys=True) + "\n")
+    return path
+
+
+def fetch_metrics(metrics_url: str, timeout_s: float = 10.0) -> str:
+    url = metrics_url.rstrip("/")
+    if not url.endswith("/metrics"):
+        url += "/metrics"
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return r.read().decode()
+
+
+def drive(url: str, metrics_url: typing.Optional[str] = None,
+          n_requests: int = 20, concurrency: int = 4, mode: str = "closed",
+          rate: typing.Optional[float] = None, ramp_s: float = 0.0,
+          seed: int = 0, vocab: int = 256, min_prompt: int = 4,
+          max_prompt: int = 24, response_len: int = 16,
+          temperature: float = 1.0, timeout_s: float = 300.0,
+          log_path: typing.Optional[str] = None,
+          log_format: typing.Optional[str] = None) -> dict:
+    """One full run: corpus -> load -> client report -> server scrape ->
+    reconciliation.  The importable entry bench.py and the tests share."""
+    corpus = make_corpus(seed, max(8, n_requests), vocab, min_prompt,
+                         max_prompt)
+    records, trace, duration, truncated = run_load(
+        url, corpus, n_requests, concurrency=concurrency, mode=mode,
+        rate=rate, ramp_s=ramp_s, response_len=response_len,
+        temperature=temperature, timeout_s=timeout_s)
+    report = {"url": url, "mode": mode, "concurrency": concurrency,
+              "rate": rate, "seed": seed, "response_len": response_len,
+              "client": client_report(records, trace, duration,
+                                      truncated=truncated)}
+    if log_path:
+        report["log_path"] = write_log(records, log_path, log_format)
+    if metrics_url:
+        try:
+            text = fetch_metrics(metrics_url)
+            report["server"] = server_report(text)
+            report["reconcile"] = reconcile_report(report["client"], text)
+        except Exception as e:  # noqa: BLE001 - scrape is best-effort
+            report["server"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return report
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--url", required=True, help="REST server base URL")
+    ap.add_argument("--metrics-url", default="",
+                    help="obs exporter base URL (enables the server report "
+                         "+ reconciliation)")
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--requests", type=int, default=20)
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="closed-loop worker threads")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="open-loop arrival rate (req/s)")
+    ap.add_argument("--ramp-s", type=float, default=0.0,
+                    help="closed-loop: stagger worker starts across this "
+                         "many seconds")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="prompt-corpus seed (fixed seed = fixed prompts)")
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--min-prompt", type=int, default=4)
+    ap.add_argument("--max-prompt", type=int, default=24)
+    ap.add_argument("--response-len", type=int, default=16)
+    ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--log", default="", help="per-request log (.jsonl/.csv)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as one JSON document")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless reconciliation agrees and the error "
+                         "rate is within --max-error-rate")
+    ap.add_argument("--max-error-rate", type=float, default=0.0)
+    args = ap.parse_args(argv)
+    try:
+        report = drive(args.url, metrics_url=args.metrics_url or None,
+                       n_requests=args.requests,
+                       concurrency=args.concurrency, mode=args.mode,
+                       rate=args.rate, ramp_s=args.ramp_s, seed=args.seed,
+                       vocab=args.vocab, min_prompt=args.min_prompt,
+                       max_prompt=args.max_prompt,
+                       response_len=args.response_len,
+                       temperature=args.temperature,
+                       timeout_s=args.timeout_s, log_path=args.log or None)
+    except (OSError, ValueError) as e:
+        print(f"graftload: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        c = report["client"]
+        print(f"{c['n_ok']}/{c['n_requests']} ok "
+              f"({c['n_rejected']} rejected) in {c['duration_s']}s — "
+              f"{c['goodput_tok_s']} tok/s goodput")
+        if c.get("e2e_s"):
+            print("client e2e_s: " + json.dumps(c["e2e_s"]))
+        for key in ("ttft_s", "queue_wait_s", "engine_s", "e2e_s"):
+            row = report.get("server", {}).get(key)
+            if row:
+                print(f"server {key}: " + json.dumps(row))
+        if "reconcile" in report:
+            print("reconcile: " + json.dumps(report["reconcile"]))
+    if args.check:
+        return 0 if check_ok(report, args.max_error_rate) else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
