@@ -256,6 +256,32 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # "ckpt_write:fail@2;feeder:die@step10;sigterm@step25"
     # (grammar in reliability/faults.py; HBNLP_FAULT_PLAN env var when empty)
     fault_plan="",
+    # elastic multi-host training (docs/reliability.md "Multi-host
+    # elasticity"; reliability/dist.py).  All dist_* knobs are overridden by
+    # the HBNLP_DIST_COORDINATOR / HBNLP_DIST_NUM_PROCESSES /
+    # HBNLP_DIST_PROCESS_ID env vars so one config file serves every host —
+    # the per-host supervisor injects the rank into its child's env.
+    # dist_coordinator: "host:port" of the jax.distributed coordinator
+    # (rank 0's address); "" with dist_num_processes <= 1 = single-host
+    dist_coordinator="",
+    # dist_num_processes: fleet size; <= 1 disables multi-host init entirely
+    dist_num_processes=0,
+    # dist_process_id: this host's rank in [0, dist_num_processes)
+    dist_process_id=0,
+    # dist_init_timeout_s: wall deadline across ALL initialize() retry
+    # attempts (coordinator-unreachable backoff); each attempt gets a
+    # deadline/(retries+1) slice as its jax initialization_timeout so the
+    # retry path engages even against a slow coordinator.  Default matches
+    # jax's own 300s join timeout: a fleet whose hosts boot minutes apart
+    # must not burn its supervisors' crash-loop budget waiting.
+    # 0 = attempts-only budget
+    dist_init_timeout_s=300.0,
+    # dist_init_retries: retries (exponential backoff) after the first
+    # failed jax.distributed.initialize attempt
+    dist_init_retries=3,
+    # dist_barrier_timeout_s: default bound on reliability.dist.barrier();
+    # an absent peer raises PeerLost (exit 87) instead of hanging forever
+    dist_barrier_timeout_s=60.0,
     current_step=0,
     steps_per_checkpoint=100_000,
     use_checkpointing=False,
@@ -442,6 +468,38 @@ class Config:
                              "(0 = no forced deadline on grace shutdown)")
         if self.ckpt_retries < 0:
             raise ValueError("ckpt_retries must be >= 0 (0 = single attempt)")
+        self.dist_coordinator = str(self.dist_coordinator or "")
+        self.dist_num_processes = int(self.dist_num_processes)
+        self.dist_process_id = int(self.dist_process_id)
+        if self.dist_num_processes < 0:
+            raise ValueError("dist_num_processes must be >= 0 "
+                             "(<= 1 = single-host, no distributed init)")
+        if self.dist_process_id < 0:
+            raise ValueError("dist_process_id must be >= 0")
+        if (self.dist_num_processes > 1
+                and self.dist_process_id >= self.dist_num_processes):
+            raise ValueError(
+                f"dist_process_id={self.dist_process_id} out of range for "
+                f"dist_num_processes={self.dist_num_processes}")
+        if self.dist_coordinator and self.dist_num_processes == 0:
+            # the inverse (world without coordinator) already fails in
+            # dist.settings(); a coordinator with no world would silently
+            # train N independent models over one model_path instead
+            raise ValueError(
+                f"dist_coordinator={self.dist_coordinator!r} set but "
+                "dist_num_processes is 0: set the fleet size (1 for a "
+                "single-process pod slice) or clear the coordinator")
+        if float(self.dist_init_timeout_s) < 0:
+            raise ValueError("dist_init_timeout_s must be >= 0 "
+                             "(0 = no wall deadline on distributed init)")
+        self.dist_init_timeout_s = float(self.dist_init_timeout_s)
+        if int(self.dist_init_retries) < 0:
+            raise ValueError("dist_init_retries must be >= 0 "
+                             "(0 = single initialize attempt)")
+        self.dist_init_retries = int(self.dist_init_retries)
+        if float(self.dist_barrier_timeout_s) < 0:
+            raise ValueError("dist_barrier_timeout_s must be >= 0")
+        self.dist_barrier_timeout_s = float(self.dist_barrier_timeout_s)
         if self.corrupt_record_budget < 0:
             raise ValueError("corrupt_record_budget must be >= 0 "
                              "(0 = fail fast on any unreadable record)")

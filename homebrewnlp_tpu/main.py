@@ -40,10 +40,16 @@ def parse_args(argv: typing.Optional[typing.Sequence[str]] = None):
 
 
 def _init_distributed(tpu_arg: str) -> None:
+    """Stash ``--tpu host:port,rank,size`` into the HBNLP_DIST_* env vars;
+    the actual (retried) ``jax.distributed.initialize`` happens once the
+    config is loaded, via ``reliability.dist.initialize`` — one init path
+    for the CLI flag, the config knobs, and the supervisor's env plumbing."""
     if "," in tpu_arg:
-        import jax
+        from .reliability import dist
         addr, rank, size = tpu_arg.split(",")
-        jax.distributed.initialize(addr, int(size), int(rank))
+        os.environ[dist.ENV_COORDINATOR] = addr
+        os.environ[dist.ENV_PROCESS_ID] = rank
+        os.environ[dist.ENV_NUM_PROCESSES] = size
 
 
 def _have_dataset_files(cfg) -> bool:
@@ -90,8 +96,8 @@ def train(cfg, args) -> None:
     preemption from crash."""
     from .obs import Obs
     from .obs.device_telemetry import AnomalyHalt
-    from .reliability import (EXIT_ANOMALY_HALT, EXIT_PREEMPTED,
-                              GraceController, faults)
+    from .reliability import (EXIT_ANOMALY_HALT, EXIT_PEER_LOST,
+                              EXIT_PREEMPTED, GraceController, dist, faults)
     from .train import color_print
     # installed (or cleared) EVERY run: a plan must never leak across runs
     faults.install(cfg.fault_plan or None)
@@ -103,7 +109,20 @@ def train(cfg, args) -> None:
         # would leak into every later run in this process
         obs.start()
         grace.install()
+        # join the fleet (no-op single-host) BEFORE any device use: a
+        # coordinator still coming up after a shared outage earns the
+        # retry/backoff path, not a crash (docs/reliability.md
+        # "Multi-host elasticity")
+        dist.initialize(cfg)
         _train_loop(cfg, args, obs, grace)
+    except dist.DistributedFailure as e:
+        # a peer (or the coordinator) is gone: THIS host's state is healthy
+        # and the loop already cut a checkpoint of it before re-raising —
+        # exit with the distinct code so every per-host supervisor
+        # relaunches the fleet in lockstep instead of backing off alone
+        color_print(f"DISTRIBUTED FAILURE: {e}; exiting with code "
+                    f"{EXIT_PEER_LOST} for a lockstep fleet relaunch")
+        raise SystemExit(EXIT_PEER_LOST) from e
     except AnomalyHalt as e:
         # device telemetry saw non-finite gradients under
         # anomaly_policy="halt": exit with the distinct code BEFORE any
@@ -180,7 +199,7 @@ def _train_loop(cfg, args, obs, grace) -> None:
     from .data.feed import DeviceFeeder
     from .data.synthetic import synthetic_text_batch
     from .obs import device_telemetry, spans
-    from .reliability import faults
+    from .reliability import dist, faults
     from .train import AsyncMetricWriter, MetricWriter, color_print
     from .train.metrics import config_hash
 
@@ -220,6 +239,10 @@ def _train_loop(cfg, args, obs, grace) -> None:
         # rules at or behind its starting position — a sigterm@stepN plan
         # inherited by every supervisor relaunch would livelock otherwise
         faults.disarm_until("step", step0)
+        # same for the distributed sites: a peer:die@stepN plan inherited
+        # by the relaunched fleet would re-kill every generation forever
+        faults.disarm_until("peer", step0)
+        faults.disarm_until("coordinator", step0)
     pipe = None
     if have_data:
         # the real (prefetched) pipeline, with the checkpointed cursor
@@ -319,10 +342,24 @@ def _train_loop(cfg, args, obs, grace) -> None:
                         f"trace will be captured — lower profile_start or "
                         f"raise --steps")
         tokens_per_update = cfg.train_batch_size * m * cfg.sequence_length
+        dist_failure = None
         for u in range(u0, updates_total):
             # fault-injection site "step" keys on the GLOBAL counter so
             # e.g. sigterm@step25 survives a resume; inert without a plan
             faults.hit("step", value=step0 + (u - u0) * m)
+            try:
+                # distributed sites (peer:die@stepN, coordinator:drop@stepN)
+                # poll on the same global counter; a detected failure stops
+                # BEFORE the next dispatch so the tail below checkpoints
+                # this host's healthy state, then train() exits
+                # EXIT_PEER_LOST for the lockstep fleet relaunch
+                dist.check_peers(step0 + (u - u0) * m)
+            except dist.DistributedFailure as e:
+                color_print(f"distributed failure observed at update {u} "
+                            f"(step {step0 + (u - u0) * m}): {e}; cutting a "
+                            "checkpoint before the fleet relaunch")
+                dist_failure = e
+                break
             if grace.triggered:
                 # preemption: stop BEFORE dispatching another update — the
                 # loop tail below cuts the grace checkpoint at the last
@@ -457,6 +494,10 @@ def _train_loop(cfg, args, obs, grace) -> None:
         color_print(f"trained {u_done - u0} updates; host blocked "
                     f"{writer.host_blocked_s:.2f}s in metric drains "
                     f"(window {window})")
+    if dist_failure is not None:
+        # the checkpoint above persisted this host's progress; now surface
+        # the distributed failure so train() maps it to EXIT_PEER_LOST
+        raise dist_failure
 
 
 def _params_for_serving(cfg):
@@ -686,6 +727,25 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> None:
         else:
             raw["train_batch_size"] = 1
     cfg = Config(raw)
+    # every run mode joins the fleet (no-op single-host): serving/sampling
+    # on a multi-host pod must see the global device set, exactly as the
+    # pre-elastic --tpu path did; train() re-checks (idempotent) for
+    # callers that enter it directly.  An init give-up maps to
+    # EXIT_PEER_LOST here too — after a shared outage the coordinator may
+    # simply be slow, and the supervisors must relaunch the fleet in
+    # lockstep rather than classify every host as crash-looping
+    from .reliability import EXIT_PEER_LOST, dist, faults
+    # the plan must be armed BEFORE the init or the documented
+    # dist_init:fail@N drill is silently inert on the CLI path; train()
+    # re-installs the same plan (harmless — the init below short-circuits
+    # on its second call, so a fired dist_init rule cannot refire)
+    faults.install(cfg.fault_plan or None)
+    try:
+        dist.initialize(cfg)
+    except dist.DistributedFailure as e:
+        print(f"DISTRIBUTED INIT FAILURE: {e}; exiting with code "
+              f"{EXIT_PEER_LOST} for a lockstep fleet relaunch")
+        raise SystemExit(EXIT_PEER_LOST) from e
     from .utils import enable_compilation_cache
     enable_compilation_cache(cfg.compilation_cache_dir)
     if args.debug_grad:

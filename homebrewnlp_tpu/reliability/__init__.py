@@ -28,6 +28,7 @@ import typing
 from . import faults  # noqa: F401
 from .retry import (DEFAULT_POLICY, FLUSH_POLICY, RetryPolicy,  # noqa: F401
                     retry_call, retrying)
+from . import dist  # noqa: F401  (after retry/faults: dist imports both)
 
 LOG = logging.getLogger("homebrewnlp_tpu.reliability")
 
@@ -45,6 +46,12 @@ EXIT_CRASH_LOOP = 85
 #: cutting a potentially-poisoned final checkpoint — a supervisor treats it
 #: as a crash (relaunch with backoff, resuming from the last good checkpoint)
 EXIT_ANOMALY_HALT = 86
+#: this host observed a DISTRIBUTED failure (peer death, coordinator loss,
+#: barrier timeout — reliability/dist.py), cut a checkpoint of its own
+#: healthy state, and exited: the per-host supervisors relaunch the whole
+#: fleet in lockstep (docs/reliability.md "Multi-host elasticity") instead
+#: of letting one host spin alone against a dead collective
+EXIT_PEER_LOST = 87
 
 
 class GraceController:

@@ -26,6 +26,16 @@ naturally).  Each rule fires **once**.  Sites instrumented today:
 - ``grads``       — per update, polled by the train loop via :func:`take`
                     (trigger matches the global step counter); the loop
                     implements the action itself
+- ``dist_init``   — per ``jax.distributed.initialize`` attempt
+                    (reliability/dist.py): ``dist_init:fail@1`` makes the
+                    first join attempt fail, exercising the coordinator-
+                    unreachable retry/backoff path
+- ``peer``        — per update, polled via :func:`take` against the global
+                    step (``peer:die@step10`` simulates observing a peer
+                    host's death: checkpoint + ``EXIT_PEER_LOST``)
+- ``coordinator`` — per update, polled via :func:`take` against the global
+                    step (``coordinator:drop@step5`` simulates losing the
+                    jax.distributed coordinator mid-run)
 
 Actions:
 
@@ -41,6 +51,9 @@ Actions:
                 telemetry anomaly path is drillable (``grads:nan@step3``)
                 without permanently poisoning parameters; requires
                 ``telemetry_interval > 0``
+- ``drop``    — caller-implemented (``take`` sites only): the train loop's
+                distributed poll (reliability/dist.py::check_peers) raises
+                ``CoordinatorLost`` — ``coordinator:drop@step5``
 
 Example: ``fault_plan="ckpt_write:fail@2;feeder:die@step10;sigterm@step25"``
 fails the 2nd checkpoint write once (retried), kills the feeder thread at
@@ -57,7 +70,7 @@ import typing
 
 LOG = logging.getLogger("homebrewnlp_tpu.reliability.faults")
 
-ACTIONS = ("fail", "die", "sigterm", "sigint", "corrupt", "nan")
+ACTIONS = ("fail", "die", "sigterm", "sigint", "corrupt", "nan", "drop")
 #: bare actions (no explicit site) ride the train-step site
 DEFAULT_SITE = "step"
 
@@ -211,11 +224,11 @@ class FaultPlan:
 
     def _execute(self, rule: FaultRule, path: typing.Optional[str]) -> None:
         LOG.warning("fault injection: firing %s", rule)
-        if rule.action == "nan":
-            # caller-implemented action reached through hit() instead of
+        if rule.action in ("nan", "drop"):
+            # caller-implemented actions reached through hit() instead of
             # take(): nothing to execute here
-            LOG.error("rule %s: 'nan' is caller-implemented (take()); "
-                      "ignored at a hit() site", rule)
+            LOG.error("rule %s: %r is caller-implemented (take()); "
+                      "ignored at a hit() site", rule, rule.action)
             return
         if rule.action == "fail":
             raise FaultInjectedIOError(f"injected storage failure ({rule})")

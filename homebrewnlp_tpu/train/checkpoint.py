@@ -19,6 +19,20 @@ field) and transparently falls back to the newest *verified* checkpoint when
 the latest is torn or corrupt.  All storage calls go through the retry layer
 (``cfg.ckpt_retries``) and the fault-injection sites ``ckpt_write`` /
 ``ckpt_commit``.
+
+Elastic resharding (docs/reliability.md "Multi-host elasticity"): manifests
+additionally record the **mesh shape** the checkpoint was saved under and
+each leaf's **PartitionSpec**, so restore can tell "same data, different
+placement" from corruption.  A checkpoint saved on mesh A restores onto the
+current mesh B (orbax re-shards onto the template's shardings; global leaf
+VALUES are placement-independent) — the reshard is logged loudly, counted
+on ``hbnlp_ckpt_reshard_restores_total``, re-verified against the SAME
+per-leaf crc32s after placement, and noted in
+``restore_marker.json`` so the supervisor's crash-loop probe counts a
+reshard-restore as progress.  Stale or mismatched sharding metadata
+(unknown mesh axes, specs naming axes the recorded mesh lacks, spec rank
+exceeding the leaf rank) is refused as :class:`CheckpointCorrupt`, falling
+back to the newest verified checkpoint like any other corruption.
 """
 from __future__ import annotations
 
@@ -34,14 +48,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
+from jax.sharding import NamedSharding
 
 from ..obs.registry import REGISTRY
+from ..parallel.mesh import MESH_AXES
 from ..reliability import RetryPolicy, faults, retry_call
 from .state import TrainState
 
 LOG = logging.getLogger(__name__)
 
-MANIFEST_VERSION = 1
+# version 2: manifests carry the save-time mesh shape + per-leaf
+# PartitionSpecs (elastic resharding); version-1 manifests (no "mesh" key)
+# keep restoring, just without reshard detection
+MANIFEST_VERSION = 2
 
 
 class CheckpointCorrupt(RuntimeError):
@@ -50,17 +69,49 @@ class CheckpointCorrupt(RuntimeError):
     older checkpoint'."""
 
 
+def _spec_to_json(spec) -> typing.List[typing.Any]:
+    """PartitionSpec -> JSON: each entry is None, a mesh-axis name, or a
+    list of mesh-axis names (multi-axis sharding of one dim)."""
+    out: typing.List[typing.Any] = []
+    for part in spec:
+        if part is None:
+            out.append(None)
+        elif isinstance(part, (tuple, list)):
+            out.append([str(p) for p in part])
+        else:
+            out.append(str(part))
+    return out
+
+
+def _mesh_meta(tree) -> typing.Optional[dict]:
+    """Save-time mesh metadata from the first NamedSharding-placed leaf:
+    axis-name -> size plus the device count.  None for host-only trees
+    (tests constructing states off-mesh)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return {"axes": {str(k): int(v)
+                             for k, v in sh.mesh.shape.items()},
+                    "n_devices": int(sh.mesh.devices.size)}
+    return None
+
+
 def _leaf_entries(tree, with_checksums: bool = True
                   ) -> typing.Dict[str, dict]:
     """Flatten the {params, opt_state, step} tree into ``{keypath: {shape,
-    dtype[, crc32]}}``.  Checksums hash the leaf bytes exactly as saved
-    (post ``master_dtype`` cast), so a restore can re-cast and compare."""
+    dtype[, spec][, crc32]}}``.  Checksums hash the leaf bytes exactly as
+    saved (post ``master_dtype`` cast), so a restore can re-cast and
+    compare; ``spec`` records the save-time PartitionSpec so restore can
+    tell a reshard from corruption."""
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     out: typing.Dict[str, dict] = {}
     for path, leaf in flat:
         entry: typing.Dict[str, typing.Any] = {
             "shape": list(getattr(leaf, "shape", ())),
             "dtype": str(getattr(leaf, "dtype", type(leaf).__name__))}
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            entry["spec"] = _spec_to_json(sh.spec)
         if with_checksums:
             # np.asarray is the host pull: only safe when every shard is
             # addressable from this process (the with_checksums guard)
@@ -112,6 +163,12 @@ class Checkpointer:
         self._fallbacks = REGISTRY.counter(
             "hbnlp_ckpt_fallbacks_total",
             "corrupt/torn checkpoints skipped during restore")
+        self._reshards = REGISTRY.counter(
+            "hbnlp_ckpt_reshard_restores_total",
+            "checkpoints restored onto a different mesh shape than they "
+            "were saved on (single-process restores re-verify the manifest "
+            "CRCs after placement; multi-process saves carry structure-only "
+            "manifests)")
 
     # -- save ----------------------------------------------------------------
     def save(self, state: TrainState,
@@ -143,6 +200,7 @@ class Checkpointer:
             "version": MANIFEST_VERSION, "step": step,
             "wall_time": time.time(), "config_hash": config_hash,
             "process_count": jax.process_count(),
+            "mesh": _mesh_meta(tree),
             "structure": _structure_hash(leaves), "leaves": leaves}
 
         def _commit() -> None:
@@ -410,9 +468,136 @@ class Checkpointer:
             "fresh-start over an existing checkpoint dir (max_to_keep could "
             "overwrite the evidence); repair or move it aside")
 
+    def _check_sharding_meta(self, step: int,
+                             manifest: typing.Optional[dict],
+                             template: TrainState
+                             ) -> typing.Optional[typing.Tuple[dict, dict]]:
+        """Validate the manifest's sharding metadata and detect a reshard.
+
+        Returns ``(saved_axes, current_axes)`` when the checkpoint was
+        saved under a DIFFERENT mesh shape than the template's (a reshard
+        restore), None otherwise.  Stale/mismatched metadata — unknown mesh
+        axes, a leaf spec naming an axis the recorded mesh lacks, or a spec
+        longer than its leaf's rank — raises :class:`CheckpointCorrupt`
+        (refused loudly; restore falls back to the newest verified
+        checkpoint).  Pre-elastic (version-1) manifests carry no ``mesh``
+        key and skip this check entirely."""
+        if manifest is None:
+            return None
+        mesh_meta = manifest.get("mesh")
+        if mesh_meta is None:
+            return None
+        axes = mesh_meta.get("axes") if isinstance(mesh_meta, dict) else None
+        if not isinstance(axes, dict) or not axes:
+            raise CheckpointCorrupt(
+                f"step {step} manifest mesh metadata is malformed "
+                f"({mesh_meta!r}) — refusing to trust its sharding story")
+        unknown = sorted(a for a in axes if a not in MESH_AXES)
+        if unknown:
+            raise CheckpointCorrupt(
+                f"step {step} manifest names unknown mesh axes {unknown} "
+                f"(known: {list(MESH_AXES)}) — stale or foreign sharding "
+                "metadata")
+        for key, entry in manifest.get("leaves", {}).items():
+            spec = entry.get("spec")
+            if spec is None:
+                continue
+            if (not isinstance(spec, list)
+                    or len(spec) > len(entry.get("shape", []))):
+                raise CheckpointCorrupt(
+                    f"step {step} leaf {key} sharding spec {spec!r} does "
+                    f"not fit its shape {entry.get('shape')} — mismatched "
+                    "sharding metadata")
+            for part in spec:
+                names = part if isinstance(part, list) else [part]
+                for nm in names:
+                    if nm is not None and nm not in axes:
+                        raise CheckpointCorrupt(
+                            f"step {step} leaf {key} sharding spec names "
+                            f"mesh axis {nm!r} absent from the manifest's "
+                            f"mesh {sorted(axes)} — mismatched sharding "
+                            "metadata")
+        cur_sh = getattr(template.step, "sharding", None)
+        if not isinstance(cur_sh, NamedSharding):
+            return None  # host-only template (tests): nothing to compare
+        cur_axes = {str(k): int(v) for k, v in cur_sh.mesh.shape.items()}
+        saved_axes = {str(k): int(v) for k, v in axes.items()}
+        if saved_axes == cur_axes:
+            return None
+        LOG.warning(
+            "checkpoint step %d was saved on mesh %s (%s device(s)); "
+            "restoring onto mesh %s — resharding (global values are "
+            "placement-independent; the manifest checksums re-verify them "
+            "after placement)", step, saved_axes,
+            mesh_meta.get("n_devices", "?"), cur_axes)
+        return saved_axes, cur_axes
+
+    def _note_reshard_restore(self, step: int, saved_axes: dict,
+                              cur_axes: dict, crc_verified: bool) -> None:
+        """Persist the reshard on ``restore_marker.json`` (monotonic count)
+        so the supervisor's crash-loop probe counts a successful
+        reshard-restore as progress even when the step counter is frozen
+        across the relaunch (tools/supervise.py::progress_signature).
+        ``crc_verified`` records honestly whether per-leaf checksums were
+        re-checked after placement — multi-process saves carry
+        structure-only manifests, so their reshards are placement-checked
+        but NOT byte-verified.
+
+        EVERY process writes a marker (rank 0 the plain name, ranks > 0 a
+        ``_p<r>`` suffix, mirroring the data-cursor sidecars): each host's
+        supervisor probes its own model_path, so a rank-0-only marker
+        would leave every other host's restore-heavy relaunch reading as
+        a crash loop."""
+        self._reshards.inc()
+        if not crc_verified:
+            LOG.warning(
+                "reshard restore of step %d verified structure only (no "
+                "per-leaf checksums in a multi-process manifest) — the "
+                "placed values were not byte-verified", step)
+        suffix = (f"_p{jax.process_index()}"
+                  if jax.process_index() != 0 else "")
+        path = os.path.join(self.path, f"restore_marker{suffix}.json")
+        count = 0
+        prev: dict = {}
+        try:
+            with open(path) as f:  # graftcheck: disable=bare-io
+                prev = json.load(f)
+            count = int(prev.get("count", 0))
+        except (OSError, ValueError):
+            pass  # absent or torn marker: restart the count
+        if (prev.get("step") == step and prev.get("from_mesh") == saved_axes
+                and prev.get("to_mesh") == cur_axes):
+            # the SAME reshard repeating (a child that restores then dies
+            # every generation, never saving a new checkpoint) is NOT new
+            # recovery work — bumping the count would reset the
+            # supervisor's crash-loop probe forever and the backstop
+            # (EXIT_CRASH_LOOP) could never fire
+            LOG.warning("repeat reshard restore of step %d onto the same "
+                        "mesh; not counting it as new supervisor progress",
+                        step)
+            return
+        payload = json.dumps({
+            "count": count + 1, "step": step, "from_mesh": saved_axes,
+            "to_mesh": cur_axes, "crc_verified": bool(crc_verified),
+            "wall_time": time.time()})
+        try:
+            retry_call(lambda: _write_atomic(path, payload),
+                       site="ckpt_marker", policy=self._policy)
+        except OSError as e:
+            # the marker is an ADVISORY progress hint for the supervisor's
+            # crash-loop probe: a marker-write outage must never fail the
+            # already-successful (and verified) restore it annotates
+            LOG.warning("could not persist restore marker %s (%r); the "
+                        "supervisor will not see this reshard as progress",
+                        path, e)
+
     def _restore_step(self, step: int, template: TrainState, cfg,
                       manifest: typing.Optional[dict]
                       ) -> typing.Tuple[TrainState, typing.Optional[dict]]:
+        # sharding metadata gate BEFORE the orbax read: stale/mismatched
+        # metadata must refuse loudly (fallback), a mere mesh change is a
+        # legitimate reshard the verify below re-proves bit-identical
+        reshard = self._check_sharding_meta(step, manifest, template)
         tree = {"params": template.params, "opt_state": template.opt_state,
                 "step": template.step}
         abstract = jax.tree_util.tree_map(
@@ -442,7 +627,20 @@ class Checkpointer:
                         "layout; leaf checksums not comparable — skipping "
                         "verification", step)
         crc = manifest.get("data_state_crc") if manifest else None
-        return state, self._load_data_state(step, expected_crc=crc)
+        # the sidecar can still reject this step (stale/torn cursor) —
+        # it must load BEFORE the reshard is recorded as progress
+        data_state = self._load_data_state(step, expected_crc=crc)
+        if reshard is not None and not migrated:
+            # single-process: the verify above re-proved the resharded
+            # leaves bit-identical (per-leaf crc32 on the gathered values);
+            # multi-process manifests are structure-only — recorded as such
+            crc_verified = (manifest is not None
+                            and jax.process_count() == 1
+                            and any("crc32" in e for e in
+                                    manifest.get("leaves", {}).values()))
+            self._note_reshard_restore(step, *reshard,
+                                       crc_verified=crc_verified)
+        return state, data_state
 
     def _verify(self, step: int, state: TrainState, manifest: dict) -> None:
         """Structure + per-leaf checksum verification against the manifest.

@@ -436,8 +436,8 @@ def test_supervisor_crash_backs_off_and_recovers():
     progress = iter([-1, 5, 10, 15])  # every run makes progress
     sup = supervise.Supervisor(
         lambda: next(outcomes), lambda: next(progress),
-        backoff_base_s=1.0, backoff_max_s=8.0, sleep=sleeps.append,
-        registry=MetricsRegistry())
+        backoff_base_s=1.0, backoff_max_s=8.0, backoff_jitter=0.0,
+        sleep=sleeps.append, registry=MetricsRegistry())
     assert sup.run() == 0
     # progress resets the backoff, so both crashes wait the base delay
     assert sleeps == [1.0, 1.0]
@@ -447,7 +447,7 @@ def test_supervisor_aborts_crash_loop_without_progress():
     sleeps = []
     sup = supervise.Supervisor(
         lambda: 1, lambda: 7,  # always crashes, progress frozen
-        max_failures_no_progress=3, backoff_base_s=1.0,
+        max_failures_no_progress=3, backoff_base_s=1.0, backoff_jitter=0.0,
         sleep=sleeps.append, registry=MetricsRegistry())
     assert sup.run() == EXIT_CRASH_LOOP
     assert len(sleeps) == 2  # two relaunches, third failure aborts
@@ -541,6 +541,7 @@ def test_supervisor_exit_code_contract_and_no_jax():
     assert supervise.EXIT_GRACE_TIMEOUT == rel.EXIT_GRACE_TIMEOUT
     assert supervise.EXIT_CRASH_LOOP == rel.EXIT_CRASH_LOOP
     assert supervise.EXIT_ANOMALY_HALT == rel.EXIT_ANOMALY_HALT
+    assert supervise.EXIT_PEER_LOST == rel.EXIT_PEER_LOST
     import subprocess
     out = subprocess.run(
         [sys.executable, "-c",

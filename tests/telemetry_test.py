@@ -363,7 +363,7 @@ def test_supervisor_goodput_accounting(tmp_path):
     sup = supervise.Supervisor(
         launch, lambda: progress[0], registry=supervise.MetricsRegistry(),
         metrics_path=str(prom), sleep=sleep, clock=lambda: clock[0],
-        backoff_base_s=2.0)
+        backoff_base_s=2.0, backoff_jitter=0.0)
     assert sup.run() == 0
     # wall 32s (3 launches + 2s backoff), productive 20s (launches 1 and 3)
     assert sup.goodput() == pytest.approx(20.0 / 32.0)
@@ -387,7 +387,7 @@ def test_supervisor_anomaly_halt_outcome_and_backoff(tmp_path):
     sleeps = []
     sup = supervise.Supervisor(
         launch, lambda: progress[0], registry=supervise.MetricsRegistry(),
-        sleep=sleeps.append, backoff_base_s=3.0)
+        sleep=sleeps.append, backoff_base_s=3.0, backoff_jitter=0.0)
     assert sup.run() == 0
     assert sleeps == [3.0]  # halt backs off like a crash
     assert sup._exits.value(outcome="anomaly_halt") == 1

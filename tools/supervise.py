@@ -10,11 +10,23 @@ itself safe, so this closes the loop:
   grace checkpoint was cut) -> relaunch immediately, no backoff — spot
   reclamation is not a bug;
 - **crash** (any other nonzero exit) -> relaunch with exponential backoff;
-- **crash loop** (K consecutive exits with NO step progress, measured from
-  ``metrics.jsonl`` and the verified-checkpoint manifests — never from the
-  child's own claims) -> abort with ``EXIT_CRASH_LOOP`` = 85 so the
-  orchestrator above sees a real failure instead of an infinite restart;
-- progress resets both the failure count and the backoff.
+- **crash loop** (K consecutive exits with NO progress, measured from
+  ``metrics.jsonl``, the verified-checkpoint manifests AND the
+  reshard-restore marker — never from the child's own claims) -> abort with
+  ``EXIT_CRASH_LOOP`` = 85 so the orchestrator above sees a real failure
+  instead of an infinite restart;
+- **peer lost** (exit ``EXIT_PEER_LOST`` = 87: the child observed a peer
+  host's death or lost the coordinator, cut a checkpoint of its own healthy
+  state, and exited) -> the per-host supervisors relaunch the **fleet in
+  lockstep** through a shared ``--fleet-dir``: each supervisor that sees a
+  peer's exit posted for the current generation SIGTERMs its own child
+  (grace checkpoint, exit 83), every supervisor posts its child's exit and
+  waits for the rest, then all relaunch together — no host spins alone
+  against a dead collective;
+- progress resets both the failure count and the backoff;
+- crash backoff carries **jitter** (``--backoff-jitter``) so a fleet of
+  per-host supervisors does not thundering-herd the coordinator after a
+  shared outage.
 
 Counters flow through the obs registry
 (``hbnlp_supervisor_exits_total{outcome}``) along with cross-relaunch
@@ -36,8 +48,12 @@ import importlib.util
 import json
 import logging
 import os
+import random
+import re
+import signal
 import subprocess
 import sys
+import threading
 import time
 import typing
 
@@ -72,6 +88,10 @@ EXIT_CRASH_LOOP = 85
 # docs/observability.md): crash semantics — relaunch with backoff so the
 # child resumes from its last good checkpoint, but a distinct outcome label
 EXIT_ANOMALY_HALT = 86
+# the child observed a distributed failure (peer death, coordinator loss —
+# reliability/dist.py), checkpointed its healthy state and exited: relaunch
+# the FLEET in lockstep (no backoff; the fleet barrier is the pacing)
+EXIT_PEER_LOST = 87
 
 LOG = logging.getLogger("homebrewnlp_tpu.supervise")
 
@@ -105,6 +125,333 @@ def last_step_progress(model_path: str) -> int:
     return best
 
 
+def reshard_restore_count(model_path: str) -> int:
+    """Successful reshard restores recorded by train/checkpoint.py in
+    ``ckpt/restore_marker*.json`` (monotonic count; multi-process children
+    write per-rank ``_p<r>`` markers — take the max).  0 when absent."""
+    ckpt = os.path.join(model_path, "ckpt")
+    best = 0
+    try:
+        names = os.listdir(ckpt)
+    except OSError:
+        return 0
+    for fn in names:
+        if not (fn.startswith("restore_marker") and fn.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(ckpt, fn)) as f:
+                best = max(best, int(json.load(f).get("count", 0)))
+        except (OSError, ValueError):
+            continue
+    return best
+
+
+def progress_signature(model_path: str) -> typing.Tuple[int, int]:
+    """On-disk progress as a comparable tuple: (last step, reshard-restore
+    count).  A relaunch that advanced NO steps but successfully restored a
+    checkpoint onto a new mesh shape still did real recovery work — without
+    the second component, a restore-heavy elastic relaunch (each restore
+    slower than the crash cadence) reads as 'no progress' and is
+    misclassified as a crash loop (EXIT_CRASH_LOOP)."""
+    return (last_step_progress(model_path), reshard_restore_count(model_path))
+
+
+_EXIT_FILE_RE = re.compile(r"^exit_r(\d+)_g(\d+)\.json$")
+_READY_FILE_RE = re.compile(r"^ready_r(\d+)_g(\d+)\.json$")
+
+
+class FleetCoordinator:
+    """Lockstep relaunch for N per-host supervisors over a shared directory.
+
+    The shared filesystem is the one channel that still exists when the
+    jax.distributed coordinator itself is the casualty.  Protocol, per
+    launch *generation* g:
+
+    1. while the child runs, a watcher thread polls for any PEER exit file
+       ``exit_r<rank>_g>=g.json``; seeing one means that host's child is
+       down for this generation — the watcher SIGTERMs our own child so it
+       cuts a grace checkpoint instead of hanging in a dead collective;
+    2. when our child exits, :meth:`post_exit` publishes its code;
+    3. :meth:`await_peers` blocks (bounded by ``peer_timeout_s``) until
+       every rank has posted for generation g — the relaunch barrier.  A
+       supervisor that never posts (host gone entirely) is logged and
+       skipped: the survivors relaunch DEGRADED rather than deadlock, and
+       checkpoint resharding lets the smaller fleet actually resume.
+
+    The starting generation is recovered from the HIGHEST generation any
+    rank ever posted in the directory (plus one): a restarted supervisor
+    rejoins the fleet at the right point, and a fresh run pointed at a
+    stale ``--fleet-dir`` starts PAST the leftover postings instead of
+    reading an old crash as a live peer failure and SIGTERMing its own
+    healthy child."""
+
+    def __init__(self, fleet_dir: str, rank: int, world_size: int, *,
+                 peer_timeout_s: float = 300.0, poll_s: float = 0.2):
+        self.dir = os.path.abspath(fleet_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.peer_timeout_s = float(peer_timeout_s)
+        self.poll_s = float(poll_s)
+        all_gens = [g for gens in self._scan().values() for g in gens]
+        all_gens += [g for gens in self._scan(re_=_READY_FILE_RE).values()
+                     for g in gens]
+        self.generation = (max(all_gens) + 1) if all_gens else 0
+        #: ranks that missed a barrier entirely (no posting, no tombstone —
+        #: host vanished): later barriers skip them until they post again,
+        #: so one dead machine does not tax EVERY relaunch with the full
+        #: peer timeout
+        self._absent: typing.Set[int] = set()
+        # we are alive: any tombstone bearing OUR rank is stale (a previous
+        # run, or this supervisor's earlier life) — peers must resume
+        # waiting for us at their barriers
+        try:
+            os.remove(os.path.join(self.dir, f"final_r{self.rank}.json"))
+        except OSError:
+            pass
+
+    def _scan(self, min_gen: int = 0, re_: typing.Pattern = _EXIT_FILE_RE
+              ) -> typing.Dict[int, typing.Dict[int, int]]:
+        """{rank: {generation: exit_code}} from the shared dir for one
+        posting kind (exit or ready).  Files below ``min_gen`` are filtered
+        BY FILENAME before any open — peer_down/await poll this several
+        times a second over what may be a network mount, and history can
+        never match ``g >= generation``."""
+        out: typing.Dict[int, typing.Dict[int, int]] = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for fn in names:
+            m = re_.match(fn)
+            if not m:
+                continue
+            r, g = int(m.group(1)), int(m.group(2))
+            if g < min_gen:
+                continue
+            try:
+                with open(os.path.join(self.dir, fn)) as f:
+                    rc = int(json.load(f).get("rc", -1))
+            except (OSError, ValueError):
+                continue  # torn write: the poster retries/next poll sees it
+            out.setdefault(r, {})[g] = rc
+        return out
+
+    def peer_down(self) -> typing.Optional[int]:
+        """Rank of a peer whose FAILED exit is posted for the current
+        generation (its child is down while ours still runs), else None.
+        Clean exits (rc 0) never trigger termination: a rank finishing the
+        run slightly earlier than us must not cut our final steps short."""
+        for r, gens in self._scan(self.generation).items():
+            if r == self.rank:
+                continue
+            if any(rc != 0 for rc in gens.values()):
+                return r
+        return None
+
+    def watch_peers(self, on_peer_down: typing.Callable[[int], None]
+                    ) -> "FleetWatcher":
+        return FleetWatcher(self, on_peer_down)
+
+    def _write_json(self, name: str, doc: dict) -> None:
+        """Atomic posting, best-effort with a short retry: the fleet dir
+        may be a network mount and every read path already tolerates
+        OSError — a transient write hiccup must degrade to a logged miss
+        (peers time out and skip us), never kill the supervisor, which is
+        the one component built to survive exactly this weather."""
+        path = os.path.join(self.dir, name)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        for attempt in range(3):
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(doc, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                return
+            except OSError as e:
+                if attempt == 2:
+                    LOG.error("could not post %s to the fleet dir (%r); "
+                              "peers will treat this rank as silent until "
+                              "the next posting succeeds", name, e)
+                    return
+                time.sleep(0.2 * (attempt + 1))
+
+    def post_exit(self, rc: int) -> None:
+        self._write_json(f"exit_r{self.rank}_g{self.generation}.json",
+                         {"rc": int(rc), "wall_time": time.time()})
+
+    def post_ready(self, rc: int) -> None:
+        """Posted AFTER any backoff sleep, right before the barrier wait:
+        the barrier keys on readiness-to-relaunch, not on death (exits post
+        immediately so watchers react, but a rank sleeping a long crash
+        backoff must keep holding its peers — releasing them early would
+        burn their dist-init deadlines against an absent coordinator)."""
+        self._write_json(f"ready_r{self.rank}_g{self.generation}.json",
+                         {"rc": int(rc), "wall_time": time.time()})
+
+    def post_final(self, rc: int) -> None:
+        """Tombstone: this supervisor is exiting for good (clean completion,
+        crash-loop abort, restart-budget exhaustion).  Surviving peers stop
+        holding fleet barriers for this rank — without it, every later
+        relaunch would pay the full peer timeout waiting for a rank whose
+        supervisor no longer exists."""
+        self._write_json(f"final_r{self.rank}.json",
+                         {"rc": int(rc), "generation": self.generation,
+                          "wall_time": time.time()})
+
+    def _final_ranks(self) -> typing.Dict[int, int]:
+        """{rank: final_rc} of supervisors that tombstoned themselves.
+        Honored unconditionally: a rank that comes back to life deletes its
+        own tombstone the moment its coordinator starts, so a standing one
+        means that supervisor really is gone."""
+        out: typing.Dict[int, int] = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for fn in names:
+            m = re.match(r"^final_r(\d+)\.json$", fn)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(self.dir, fn)) as f:
+                    out[int(m.group(1))] = int(json.load(f).get("rc", -1))
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def await_peers(self) -> typing.Dict[int, int]:
+        """Block until every rank posted READY for this generation (or
+        ``peer_timeout_s``); returns {rank: exit_code} for the ranks that
+        did.  THE lockstep barrier: every supervisor leaves it only when
+        the whole fleet finished its backoff sleeps, so the relaunched
+        children meet a coordinator whose peers are all coming up too.
+        Ranks that previously missed a barrier entirely (vanished host, no
+        tombstone) are skipped until they post again — one dead machine
+        must not tax every later relaunch with the full timeout."""
+        deadline = time.monotonic() + self.peer_timeout_s
+        want = set(range(self.world_size))
+        while True:
+            for r, rc in self._final_ranks().items():
+                if r in want and r != self.rank:
+                    # tombstoned: that supervisor exited for good (clean
+                    # completion / crash-loop abort / budget exhaustion)
+                    # and will never post again — do not hold the barrier
+                    LOG.info("rank %d left the fleet permanently (final "
+                             "rc %d); not holding the barrier for it", r, rc)
+                    want.discard(r)
+            seen: typing.Dict[int, int] = {}
+            for r, gens in self._scan(self.generation,
+                                      re_=_READY_FILE_RE).items():
+                seen[r] = gens[max(gens)]
+            self._absent -= set(seen)  # a vanished rank posting is back
+            if want - self._absent <= set(seen):
+                return seen
+            if time.monotonic() >= deadline:
+                missing = sorted(want - self._absent - set(seen))
+                self._absent |= set(missing)
+                LOG.error(
+                    "fleet barrier (generation %d) expired after %.0fs; "
+                    "rank(s) %s never posted readiness — relaunching "
+                    "DEGRADED without them, and skipping them at later "
+                    "barriers until they post again (supervision-only "
+                    "fleets resume via checkpoint resharding; coordinator-"
+                    "mode fleets need a restart with the new --world-size "
+                    "— docs/reliability.md)",
+                    self.generation, self.peer_timeout_s, missing)
+                return seen
+            time.sleep(self.poll_s)
+
+    def advance(self) -> None:
+        self.generation += 1
+        # prune OUR superseded postings (keep the previous generation —
+        # peers may still be reading it): bounds the directory listing the
+        # watcher polls several times a second for the run's whole lifetime
+        for g in range(max(0, self.generation - 8), self.generation - 1):
+            for fn in (f"exit_r{self.rank}_g{g}.json",
+                       f"ready_r{self.rank}_g{g}.json"):
+                try:
+                    os.remove(os.path.join(self.dir, fn))
+                except OSError:
+                    pass
+
+
+class FleetWatcher:
+    """Background poll for peer exits during one child lifetime."""
+
+    def __init__(self, fleet: FleetCoordinator,
+                 on_peer_down: typing.Callable[[int], None]):
+        self.fleet = fleet
+        self.on_peer_down = on_peer_down
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-watch")
+        self._thread.start()
+
+    def _run(self) -> None:
+        fired = False
+        while not self._stop.wait(self.fleet.poll_s):
+            r = self.fleet.peer_down()
+            if r is None:
+                continue
+            if not fired:
+                LOG.warning(
+                    "peer rank %d posted an exit for generation %d while "
+                    "our child still runs; terminating the child for the "
+                    "lockstep fleet relaunch", r, self.fleet.generation)
+                fired = True
+            # retry ONLY until one signal is delivered to a live child:
+            # the first poll can race the launcher (Popen not started yet
+            # -> nothing to signal), but repeating SIGTERM against a live
+            # child would trip its GraceController's second-signal
+            # escalation (forced exit 84, NO grace checkpoint) — exactly
+            # the data loss the lockstep protocol exists to avoid
+            try:
+                delivered = self.on_peer_down(r)
+            except Exception as e:  # pragma: no cover - defensive
+                LOG.error("peer-down callback failed: %r", e)
+                delivered = False
+            if delivered:
+                return  # the child's grace path owns the exit from here
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+class SubprocessLauncher:
+    """The production ``launch`` callable: a subprocess the fleet watcher
+    can terminate (SIGTERM -> the child's grace checkpoint -> exit 83)."""
+
+    def __init__(self, cmd: typing.Sequence[str],
+                 env: typing.Optional[dict] = None):
+        self.cmd = list(cmd)
+        self.env = env
+        self._proc: typing.Optional[subprocess.Popen] = None
+
+    def __call__(self) -> int:
+        self._proc = subprocess.Popen(self.cmd, env=self.env)
+        try:
+            return self._proc.wait()
+        finally:
+            self._proc = None
+
+    def terminate(self) -> bool:
+        """SIGTERM the child if it is running; True when the signal was
+        actually delivered (the watcher retries until then, and must stop
+        after — a second SIGTERM escalates the child's grace shutdown to
+        the forced no-checkpoint exit)."""
+        p = self._proc
+        if p is not None and p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+                return True
+            except OSError:
+                pass  # exited between poll and signal
+        return False
+
+
 class Supervisor:
     """Relaunch policy around an injectable ``launch`` callable (a
     subprocess in production, an in-process train call in tests).
@@ -113,24 +460,37 @@ class Supervisor:
     a child that crashes before flushing anything reads as 'no progress'."""
 
     def __init__(self, launch: typing.Callable[[], int],
-                 progress: typing.Callable[[], int], *,
+                 progress: typing.Callable[[], typing.Any], *,
                  max_failures_no_progress: int = 3,
                  backoff_base_s: float = 1.0, backoff_max_s: float = 60.0,
+                 backoff_jitter: float = 0.25,
                  max_restarts: int = 0,
                  sleep: typing.Callable[[float], None] = time.sleep,
                  registry: typing.Optional[MetricsRegistry] = None,
                  metrics_path: typing.Optional[str] = None,
-                 clock: typing.Callable[[], float] = time.monotonic):
+                 clock: typing.Callable[[], float] = time.monotonic,
+                 rng: typing.Callable[[], float] = random.random,
+                 fleet: typing.Optional[FleetCoordinator] = None,
+                 terminate: typing.Optional[
+                     typing.Callable[[], None]] = None):
         self.launch = launch
         self.progress = progress
         self.max_failures_no_progress = int(max_failures_no_progress)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
+        # +/- fraction applied to every crash backoff: a FLEET of per-host
+        # supervisors sleeping the identical deterministic schedule after a
+        # shared outage would reconnect to the coordinator in one synchronized
+        # wave (satellite: thundering-herd hygiene, mirroring retry.py)
+        self.backoff_jitter = float(backoff_jitter)
         self.max_restarts = int(max_restarts)  # 0 = unlimited
         self.sleep = sleep
         self.registry = registry if registry is not None else REGISTRY
         self.metrics_path = metrics_path
         self.clock = clock
+        self.rng = rng
+        self.fleet = fleet
+        self.terminate = terminate
         self._exits = self.registry.counter(
             "hbnlp_supervisor_exits_total",
             "child exits seen by the supervisor, by outcome",
@@ -174,13 +534,39 @@ class Supervisor:
         except OSError as e:
             LOG.warning("could not persist supervisor metrics: %r", e)
 
+    def _on_peer_down(self, peer_rank: int) -> bool:
+        """Returns True once a termination signal reached the live child
+        (the fleet watcher stops retrying at that point)."""
+        if self.terminate is not None:
+            return bool(self.terminate())
+        return False
+
+    def _fleet_barrier(self, rc: int) -> None:
+        """Hold at the fleet barrier until every rank posted READINESS (or
+        timed out) — the lockstep relaunch point.  The exit itself was
+        posted the moment the child died (peers' watchers key off it);
+        readiness posts here, after any backoff sleep, so the whole fleet
+        leaves the barrier together."""
+        self.fleet.post_ready(rc)
+        peers = self.fleet.await_peers()
+        others = {r: c for r, c in peers.items() if r != self.fleet.rank}
+        LOG.info("fleet generation %d complete: own exit %d, peers %s",
+                 self.fleet.generation, rc, others or "(none posted)")
+        self.fleet.advance()
+
     def run(self) -> int:
         failures_no_progress = 0
         backoff = self.backoff_base_s
         last = self.progress()
         while True:
+            watcher = (self.fleet.watch_peers(self._on_peer_down)
+                       if self.fleet is not None else None)
             t_launch = self.clock()
-            rc = self.launch()
+            try:
+                rc = self.launch()
+            finally:
+                if watcher is not None:
+                    watcher.stop()
             segment_s = self.clock() - t_launch
             now = self.progress()
             advanced = now > last
@@ -188,14 +574,26 @@ class Supervisor:
             if advanced:
                 self._productive_s += segment_s
             if rc == 0:
-                LOG.info("training completed cleanly at step %d "
+                LOG.info("training completed cleanly at %s "
                          "(%d restart(s), goodput %.3f)", last,
                          self.restarts, self.goodput())
                 self._exits.labels(outcome="clean").inc()
                 self.write_metrics()
+                if self.fleet is not None:
+                    # post so peers never block on us, but do NOT hold the
+                    # barrier ourselves — there is nothing left to relaunch
+                    self.fleet.post_exit(rc)
+                    self.fleet.post_final(rc)
                 return 0
+            if self.fleet is not None:
+                # publish the death IMMEDIATELY: peers' watchers key off it
+                # to stop their own children instead of hanging in a dead
+                # collective (the barrier wait comes later, after backoff)
+                self.fleet.post_exit(rc)
             preempted = rc == EXIT_PREEMPTED
+            peer_lost = rc == EXIT_PEER_LOST
             outcome = ("preemption" if preempted else
+                       "peer_lost" if peer_lost else
                        "anomaly_halt" if rc == EXIT_ANOMALY_HALT else
                        "crash")
             self._exits.labels(outcome=outcome).inc()
@@ -209,30 +607,48 @@ class Supervisor:
                 failures_no_progress += 1
                 if failures_no_progress >= self.max_failures_no_progress:
                     LOG.error(
-                        "crash loop: %d consecutive exits with no step "
-                        "progress (stuck at step %d, last exit code %d); "
+                        "crash loop: %d consecutive exits with no "
+                        "progress (stuck at %s, last exit code %d); "
                         "aborting with %d", failures_no_progress, last, rc,
                         EXIT_CRASH_LOOP)
                     self._exits.labels(outcome="crash_loop_abort").inc()
                     self.write_metrics()
+                    if self.fleet is not None:
+                        # exit already posted above; the tombstone tells
+                        # peers we are gone for good
+                        self.fleet.post_final(EXIT_CRASH_LOOP)
                     return EXIT_CRASH_LOOP
             self.restarts += 1
             if self.max_restarts and self.restarts > self.max_restarts:
                 LOG.error("restart budget (%d) exhausted; passing through "
                           "exit code %d", self.max_restarts, rc)
+                if self.fleet is not None:
+                    self.fleet.post_final(rc)  # exit already posted above
                 return rc
-            if preempted:
-                LOG.warning("preemption exit (%d): grace checkpoint cut at "
-                            "step %d; relaunching (restart %d)", rc, last,
+            if preempted or peer_lost:
+                LOG.warning("%s exit (%d): checkpoint cut at %s; "
+                            "relaunching%s (restart %d)",
+                            "preemption" if preempted else "peer-lost", rc,
+                            last,
+                            " the fleet in lockstep" if peer_lost else "",
                             self.restarts)
             else:
-                LOG.warning("crash exit %d at step %d; relaunching in %.1fs "
+                d = backoff
+                if self.backoff_jitter:
+                    d *= 1.0 + self.backoff_jitter * (2.0 * self.rng() - 1.0)
+                LOG.warning("crash exit %d at %s; relaunching in %.1fs "
                             "(restart %d, %d/%d failures without progress)",
-                            rc, last, backoff, self.restarts,
+                            rc, last, d, self.restarts,
                             failures_no_progress,
                             self.max_failures_no_progress)
-                self.sleep(backoff)
+                self.sleep(max(0.0, d))
                 backoff = min(backoff * 2.0, self.backoff_max_s)
+            if self.fleet is not None:
+                # the barrier is the LAST thing before relaunch — backoff
+                # sleeps happen before it, so one host's long crash backoff
+                # cannot make peers leave early and burn their dist-init
+                # deadline against a coordinator that is still asleep
+                self._fleet_barrier(rc)
 
 
 def parse_args(argv=None):
@@ -249,14 +665,44 @@ def parse_args(argv=None):
                    help="seconds before the first crash relaunch (doubles "
                         "up to --backoff-max; preemptions skip backoff)")
     p.add_argument("--backoff-max", type=float, default=60.0)
+    p.add_argument("--backoff-jitter", type=float, default=0.25,
+                   help="+/- fraction of jitter on every crash backoff so "
+                        "a fleet of supervisors does not thundering-herd "
+                        "the coordinator after a shared outage (0 = exact "
+                        "exponential)")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="total relaunch budget (0 = unlimited)")
     p.add_argument("--obs-port", type=int, default=0,
                    help=">0: serve the supervisor's /metrics on "
                         "127.0.0.1:<port>")
+    p.add_argument("--rank", type=int, default=0,
+                   help="this host's rank; exported to the child as "
+                        "HBNLP_DIST_PROCESS_ID (reliability/dist.py)")
+    p.add_argument("--world-size", type=int, default=1,
+                   help="fleet size; >1 enables lockstep fleet relaunch "
+                        "(requires --fleet-dir) and exports "
+                        "HBNLP_DIST_NUM_PROCESSES to the child")
+    p.add_argument("--coordinator", type=str, default="",
+                   help="host:port of the jax.distributed coordinator "
+                        "(rank 0's address); exported to the child as "
+                        "HBNLP_DIST_COORDINATOR")
+    p.add_argument("--fleet-dir", type=str, default="",
+                   help="SHARED directory the per-host supervisors "
+                        "coordinate lockstep relaunches through "
+                        "(exit-code postings + relaunch barrier)")
+    p.add_argument("--peer-timeout", type=float, default=300.0,
+                   help="seconds to hold the fleet relaunch barrier for a "
+                        "peer supervisor's exit posting before relaunching "
+                        "degraded without it")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command after '--'")
     args = p.parse_args(argv)
+    if args.world_size > 1 and not args.fleet_dir:
+        p.error("--world-size > 1 requires --fleet-dir (a directory shared "
+                "by every host's supervisor)")
+    if not 0 <= args.rank < max(1, args.world_size):
+        p.error(f"--rank {args.rank} out of range for --world-size "
+                f"{args.world_size}")
     cmd = list(args.command)
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
@@ -271,14 +717,31 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s supervise %(levelname)s %(message)s")
     args = parse_args(argv)
+    env = dict(os.environ)
+    fleet = None
+    if args.world_size > 1:
+        if args.coordinator:
+            # per-host rank/coordinator plumbing: the child's
+            # reliability.dist reads these env vars, so ONE config file
+            # serves every host.  Without --coordinator the fleet is
+            # supervision-only (lockstep relaunch, no jax.distributed) —
+            # the chaos-multihost drill mode.
+            env["HBNLP_DIST_PROCESS_ID"] = str(args.rank)
+            env["HBNLP_DIST_NUM_PROCESSES"] = str(args.world_size)
+            env["HBNLP_DIST_COORDINATOR"] = args.coordinator
+        fleet = FleetCoordinator(args.fleet_dir, args.rank, args.world_size,
+                                 peer_timeout_s=args.peer_timeout)
+    launcher = SubprocessLauncher(args.command, env=env)
     sup = Supervisor(
-        lambda: subprocess.call(args.command),
-        lambda: last_step_progress(args.model_path),
+        launcher,
+        lambda: progress_signature(args.model_path),
         max_failures_no_progress=args.max_failures_no_progress,
         backoff_base_s=args.backoff_base, backoff_max_s=args.backoff_max,
+        backoff_jitter=args.backoff_jitter,
         max_restarts=args.max_restarts,
         metrics_path=os.path.join(args.model_path,
-                                  "supervisor_metrics.prom"))
+                                  "supervisor_metrics.prom"),
+        fleet=fleet, terminate=launcher.terminate)
     server = None
     if args.obs_port:
         # the exporter import pulls the full package (and jax); degrade to
