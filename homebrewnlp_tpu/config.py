@@ -282,6 +282,13 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # dist_barrier_timeout_s: default bound on reliability.dist.barrier();
     # an absent peer raises PeerLost (exit 87) instead of hanging forever
     dist_barrier_timeout_s=60.0,
+    # fleet_dir: SHARED directory for cross-rank fleet observability
+    # (docs/observability.md "Fleet observability"): each rank posts
+    # per-step dispatch timestamps, /metrics snapshots, and its span trace
+    # under <fleet_dir>/obs/ for federation + straggler attribution.
+    # Overridden by HBNLP_FLEET_DIR (the supervisor injects its
+    # --fleet-dir).  "" = off: single-process runs stay byte-identical.
+    fleet_dir="",
     current_step=0,
     steps_per_checkpoint=100_000,
     use_checkpointing=False,
@@ -500,6 +507,7 @@ class Config:
         if float(self.dist_barrier_timeout_s) < 0:
             raise ValueError("dist_barrier_timeout_s must be >= 0")
         self.dist_barrier_timeout_s = float(self.dist_barrier_timeout_s)
+        self.fleet_dir = str(self.fleet_dir or "")
         if self.corrupt_record_budget < 0:
             raise ValueError("corrupt_record_budget must be >= 0 "
                              "(0 = fail fast on any unreadable record)")

@@ -292,7 +292,8 @@ def _train_loop(cfg, args, obs, grace) -> None:
     writer = AsyncMetricWriter(MetricWriter(cfg.model_path), window=window,
                                health=obs.health if obs.enabled else None,
                                registry=obs.registry if obs.enabled else None,
-                               anomaly=anomaly)
+                               anomaly=anomaly,
+                               reporter=obs.fleet_reporter)
     if util is not None:
         writer.set_utilization(util, run_start=run_t0)
         if obs.enabled:
@@ -300,7 +301,9 @@ def _train_loop(cfg, args, obs, grace) -> None:
     # run boundary marker: restarts append to metrics.jsonl, so bench /
     # post-mortem tooling splits runs on these records
     cfg_hash = config_hash(cfg)
-    writer.write_run_start(step0, cfg_hash)
+    # Obs.identity is cfg-resolved (env overrides the dist_* knobs): the
+    # marker must agree with the /healthz identity block
+    writer.write_run_start(step0, cfg_hash, identity=obs.identity)
     run_log = RunLog(cfg.model_path)
     # train_steps (and the step counter) count macro slices, reference
     # run.py:155,249: one optimizer update advances the counter by

@@ -28,6 +28,7 @@ from .exporter import (Health, Watchdog, device_memory_stats,  # noqa: F401
                        dump_diagnostics, start_server, stop_server)
 from .spans import (NULL_SPAN, SpanTracer, get_tracer,  # noqa: F401
                     set_tracer, span, traced)
+from . import fleet  # noqa: F401  (stdlib-only; docs/observability.md)
 
 
 class _HealthPause:
@@ -58,14 +59,21 @@ class Obs:
     def __init__(self, model_path: str, port: int = 0, spans: bool = False,
                  watchdog_factor: float = 0.0,
                  startup_stall_s: float = 600.0,
-                 registry: typing.Optional[MetricsRegistry] = None):
+                 registry: typing.Optional[MetricsRegistry] = None,
+                 fleet_dir: str = "",
+                 identity: typing.Optional[dict] = None):
         self.model_path = model_path
         self.port = int(port)
         self.spans_enabled = bool(spans)
         self.watchdog_factor = float(watchdog_factor)
+        self.fleet_dir = str(fleet_dir or "")
+        self.identity = identity if identity is not None else fleet.identity()
         self.enabled = bool(self.port or self.spans_enabled
-                            or self.watchdog_factor)
+                            or self.watchdog_factor or self.fleet_dir)
         self.registry = registry if registry is not None else REGISTRY
+        #: cross-rank posting half (docs/observability.md "Fleet
+        #: observability"); None outside a fleet — every consumer guards
+        self.fleet_reporter: typing.Optional[fleet.FleetReporter] = None
         self.health = Health(stall_factor=self.watchdog_factor or 10.0,
                              startup_stall_s=startup_stall_s) \
             if self.enabled else None
@@ -88,7 +96,9 @@ class Obs:
                    spans=getattr(cfg, "obs_spans", False),
                    watchdog_factor=getattr(cfg, "watchdog_factor", 0.0),
                    startup_stall_s=getattr(cfg, "watchdog_startup_s",
-                                           600.0))
+                                           600.0),
+                   fleet_dir=fleet.fleet_dir_from(cfg),
+                   identity=fleet.identity(cfg))
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Obs":
@@ -98,6 +108,11 @@ class Obs:
         if self.spans_enabled:
             self.tracer = SpanTracer()
             self._prev_tracer = set_tracer(self.tracer)
+        if self.fleet_dir:
+            self.fleet_reporter = fleet.FleetReporter(
+                self.fleet_dir, self.identity.get("rank", 0),
+                self.identity.get("world_size", 1),
+                registry=self.registry)
         self._steps = self.registry.counter(
             "hbnlp_train_steps_total", "optimizer updates dispatched")
         self._tokens = self.registry.counter(
@@ -113,11 +128,15 @@ class Obs:
             fn=lambda: h.ema_step_seconds() or 0.0)
         if self.port:
             self.server = start_server(self.port, registry=self.registry,
-                                       health=self.health)
+                                       health=self.health,
+                                       identity=self.identity)
         if self.watchdog_factor:
+            r = self.fleet_reporter
             self.watchdog = Watchdog(self.health, self.model_path,
                                      factor=self.watchdog_factor,
-                                     registry=self.registry)
+                                     registry=self.registry,
+                                     extra_fn=(r.skew_summary
+                                               if r is not None else None))
             self.watchdog.start()
         return self
 
@@ -153,7 +172,16 @@ class Obs:
                     os.path.join(self.model_path, "trace.json"))
             except Exception as e:
                 log.warning("trace.json export failed: %s", e)
+            if self.fleet_reporter is not None:
+                # the per-rank lane of the merged fleet trace
+                self.fleet_reporter.export_trace(self.tracer)
             self.tracer = None
+        if self.fleet_reporter is not None:
+            try:
+                self.fleet_reporter.close()  # final prom snapshot rides this
+            except Exception as e:
+                log.warning("fleet reporter close failed: %s", e)
+            self.fleet_reporter = None
         self._freeze_gauges()
 
     def _freeze_gauges(self) -> None:

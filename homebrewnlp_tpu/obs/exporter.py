@@ -256,6 +256,10 @@ class _ObsServer(ThreadingHTTPServer):
     #: optional serving-SLO summary callable (serve/slo.py::ServeSLO.summary)
     #: merged into /healthz as the ``slo`` block
     slo_probe: typing.Optional[typing.Callable[[], dict]] = None
+    #: fleet identity (obs/fleet.py::identity — rank, world_size,
+    #: coordinator, generation) merged into /healthz so ANY scraped
+    #: endpoint is self-describing in a multi-host fleet
+    identity: typing.Optional[dict] = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -278,6 +282,9 @@ class _Handler(BaseHTTPRequestHandler):
             # "the engine is alive"
             snap = health.snapshot() if health is not None else \
                 {"status": "metrics-only", "last_completed_step": None}
+            ident = getattr(self.server, "identity", None)
+            if ident:
+                snap["identity"] = ident
             probe = getattr(self.server, "slo_probe", None)
             if probe is not None:
                 # serving SLO summary (p50/p95/p99 per phase + error rate)
@@ -298,16 +305,18 @@ class _Handler(BaseHTTPRequestHandler):
 def start_server(port: int, registry: typing.Optional[MetricsRegistry] = None,
                  health: typing.Optional[Health] = None,
                  host: str = "127.0.0.1",
-                 slo_probe: typing.Optional[typing.Callable[[], dict]] = None
-                 ) -> _ObsServer:
+                 slo_probe: typing.Optional[typing.Callable[[], dict]] = None,
+                 identity: typing.Optional[dict] = None) -> _ObsServer:
     """Start the exporter on a daemon thread; ``port=0`` binds an ephemeral
     port (read it back from ``server.server_address[1]``).  ``slo_probe``
     (the REST layer's ``ServeSLO.summary``) adds a ``slo`` block to
-    /healthz."""
+    /healthz; ``identity`` (obs/fleet.py) adds the self-describing
+    ``identity`` block every fleet-scraped endpoint must carry."""
     server = _ObsServer((host, port), _Handler)
     server.registry = registry if registry is not None else REGISTRY
     server.health = health
     server.slo_probe = slo_probe
+    server.identity = identity
     thread = threading.Thread(target=server.serve_forever,
                               name="obs-exporter", daemon=True)
     server._thread = thread
@@ -328,9 +337,13 @@ _DUMP_LOCK = threading.Lock()
 
 
 def dump_diagnostics(model_path: str, health: typing.Optional[Health] = None,
-                     reason: str = "manual") -> str:
+                     reason: str = "manual",
+                     extra: typing.Optional[dict] = None) -> str:
     """Write thread stacks + device memory stats + health snapshot to
-    ``<model_path>/diagnostics/hang_<ts>_<n>.txt``; returns the path."""
+    ``<model_path>/diagnostics/hang_<ts>_<n>.txt``; returns the path.
+    ``extra`` ({section name: json-able}) appends caller context — the
+    watchdog passes the fleet straggler report so a stall dump says
+    whether this rank was the fleet's straggler before it wedged."""
     outdir = os.path.join(model_path, "diagnostics")
     os.makedirs(outdir, exist_ok=True)
     with _DUMP_LOCK:
@@ -346,6 +359,11 @@ def dump_diagnostics(model_path: str, health: typing.Optional[Health] = None,
     mem = device_memory_stats()
     lines.append("device_memory_stats: "
                  + (json.dumps(mem, indent=1) if mem else "(unavailable)"))
+    for section, doc in (extra or {}).items():
+        try:
+            lines.append(f"{section}: " + json.dumps(doc, sort_keys=True))
+        except (TypeError, ValueError):
+            lines.append(f"{section}: {doc!r}")
     # latest graftprof window (main.py writes it at profiler stop): where
     # device time was going BEFORE the stall is exactly the third artifact
     # a hang post-mortem wants next to thread stacks and memory
@@ -385,10 +403,15 @@ class Watchdog(threading.Thread):
                  factor: typing.Optional[float] = None, poll_s: float = 1.0,
                  min_stall_s: typing.Optional[float] = None,
                  max_pause_s: typing.Optional[float] = None,
-                 registry: typing.Optional[MetricsRegistry] = None):
+                 registry: typing.Optional[MetricsRegistry] = None,
+                 extra_fn: typing.Optional[
+                     typing.Callable[[], dict]] = None):
         super().__init__(name="obs-watchdog", daemon=True)
         self.health = health
         self.model_path = model_path
+        #: optional {section: doc} provider inlined into each stall dump
+        #: (Obs wires the fleet straggler summary here)
+        self.extra_fn = extra_fn
         # stall visibility beyond the diagnostics dir: the supervisor and
         # alerting watch this counter on /metrics instead of scraping files
         reg = registry if registry is not None else REGISTRY
@@ -437,9 +460,15 @@ class Watchdog(threading.Thread):
                    f"{h.seconds_since_last_step():.2f}s (threshold "
                    f"{threshold:.2f}s = max({h.stall_factor} x "
                    f"EMA {h.ema_step_seconds():.4f}s, {h.min_stall_s}s))")
+        extra = None
+        if self.extra_fn is not None:
+            try:
+                extra = {"fleet": self.extra_fn()}
+            except Exception as e:  # noqa: BLE001 - the dump must land
+                extra = {"fleet": {"error": repr(e)}}
         self.dumps.append(dump_diagnostics(
             self.model_path, h,
-            reason=f"watchdog: {why}, last step {step}"))
+            reason=f"watchdog: {why}, last step {step}", extra=extra))
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop_evt.set()

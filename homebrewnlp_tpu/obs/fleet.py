@@ -1,0 +1,990 @@
+"""Fleet observability: cross-rank metrics federation, merged traces, and
+straggler attribution (docs/observability.md "Fleet observability").
+
+Every obs surface built so far — the registry, the span tracer, the
+exporter, graftprof — sees exactly ONE rank.  The reference delegated all
+multi-host visibility to the TF1 TPU runtime's opaque session; this module
+is the native replacement, built on the same shared-filesystem channel the
+supervisor fleet protocol already trusts (tools/supervise.py
+``--fleet-dir`` — the one channel that survives the coordinator being the
+casualty):
+
+- **posting** (:class:`FleetReporter`, the child side): each rank appends
+  per-step dispatch timestamps to ``<fleet_dir>/obs/steps_r<rank>.jsonl``,
+  re-renders its registry to ``metrics_r<rank>.prom`` (throttled), and
+  exports its span trace to ``trace_r<rank>.json`` on close;
+- **federation** (:func:`federate` / :class:`FleetFederation`): per-rank
+  Prometheus snapshots merge into one exposition — every sample gains a
+  ``rank`` label, counters sum into ``rank="fleet"`` aggregates, gauges
+  aggregate min/mean/max, histograms merge EXACTLY (the shared bucket-edge
+  constants — ``SERVE_LATENCY_BUCKETS``, ``DEFAULT_BUCKETS`` — make the
+  element-wise count sum lossless; mismatched edges are rejected loudly);
+- **trace merge** (:func:`estimate_offsets` / :func:`merge_traces`):
+  per-rank clock offsets are estimated from matching ``dist/barrier`` span
+  END times (every rank leaves a barrier at nearly the same true instant),
+  and the per-rank Chrome traces merge into one file with a lane (pid) per
+  rank on a common timebase;
+- **attribution** (:func:`straggler_report`): per-step dispatch skew, an
+  EMA straggler score per rank, and the barrier-wait decomposition —
+  seconds the fast ranks would spend idle waiting for the slowest — the
+  fleet-level twin of graftprof's per-device ``comm + idle`` bucket.
+
+This module is STDLIB-ONLY (no jax, no numpy): tools/supervise.py loads it
+file-path style (``_load_light``) so a broken accelerator install cannot
+take fleet visibility down with the child.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import re
+import threading
+import time
+import typing
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+try:
+    from .registry import (bucket_quantile, merge_histogram_counts,
+                           sample_quantile)
+except ImportError:  # loaded by file path (tools/supervise.py _load_light)
+    import importlib.util as _ilu
+    _spec = _ilu.spec_from_file_location(
+        "hbnlp_obs_registry_for_fleet",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "registry.py"))
+    _reg = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_reg)
+    bucket_quantile = _reg.bucket_quantile
+    merge_histogram_counts = _reg.merge_histogram_counts
+    sample_quantile = _reg.sample_quantile
+
+LOG = logging.getLogger("homebrewnlp_tpu.obs.fleet")
+
+#: env vars the supervisor injects so the child (and its run-start markers,
+#: /healthz identity block, and fleet postings) know who they are even in
+#: supervision-only fleets where the HBNLP_DIST_* vars stay unset
+ENV_FLEET_DIR = "HBNLP_FLEET_DIR"
+ENV_FLEET_RANK = "HBNLP_FLEET_RANK"
+ENV_FLEET_WORLD = "HBNLP_FLEET_WORLD"
+ENV_FLEET_GENERATION = "HBNLP_FLEET_GENERATION"
+
+OBS_SUBDIR = "obs"
+EMA_ALPHA = 0.2  # straggler-score EMA weight (matches Health.ema_alpha)
+
+
+def identity(cfg=None) -> dict:
+    """Who this process is inside the fleet — the identity block /healthz
+    and the metrics.jsonl run-start markers carry so ANY scraped endpoint
+    or log file is self-describing.  Resolution is env-first (the
+    supervisor injects per-host values so one config serves every host),
+    falling back to the dist_* config knobs, then single-host defaults."""
+    def _pick(env_names, cfg_attr, default):
+        for n in env_names:
+            v = os.environ.get(n)
+            if v not in (None, ""):
+                return v
+        return getattr(cfg, cfg_attr, default) or default
+    rank = int(_pick((ENV_FLEET_RANK, "HBNLP_DIST_PROCESS_ID"),
+                     "dist_process_id", 0))
+    world = int(_pick((ENV_FLEET_WORLD, "HBNLP_DIST_NUM_PROCESSES"),
+                      "dist_num_processes", 1))
+    coord = str(_pick(("HBNLP_DIST_COORDINATOR",), "dist_coordinator", ""))
+    gen = os.environ.get(ENV_FLEET_GENERATION)
+    out = {"rank": rank, "world_size": max(1, world), "coordinator": coord}
+    if gen not in (None, ""):
+        out["generation"] = int(gen)
+    return out
+
+
+def fleet_dir_from(cfg=None) -> str:
+    """The shared fleet directory, env-first (``HBNLP_FLEET_DIR`` — the
+    supervisor's injection — overrides ``cfg.fleet_dir``)."""
+    return os.environ.get(ENV_FLEET_DIR) or getattr(cfg, "fleet_dir", "") \
+        or ""
+
+
+# -- Prometheus text parsing --------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)\s*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+_UNESCAPE_RE = re.compile(r"\\(.)")
+
+
+def _unescape(v: str) -> str:
+    # single-pass left-to-right, like Prometheus itself: sequential
+    # .replace calls would let one pass consume the backslash of the next
+    # escape pair (r"a\nb" escaped is r"a\\nb", which must NOT round-trip
+    # to 'a\<newline>b')
+    return _UNESCAPE_RE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), v)
+
+
+def _escape(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"  # a rank's failing callback gauge renders NaN — one
+        # bad sample must not take the whole federation down
+    if f == math.inf:
+        return "+Inf"
+    if f == -math.inf:
+        return "-Inf"
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _label_str(labels: typing.Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"'
+                          for k, v in sorted(labels.items())) + "}"
+
+
+class Family:
+    """One metric family parsed from Prometheus text: flat samples for
+    counters/gauges/untyped, reconstructed per-labelset histograms for
+    histograms."""
+
+    def __init__(self, name: str, kind: str = "untyped", help_text: str = ""):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        #: [(labels dict, value)] for counter/gauge/untyped
+        self.samples: typing.List[typing.Tuple[dict, float]] = []
+        #: histogram parts: {labelset key: {"labels", "le": {edge: cum},
+        #:                                  "sum", "count"}}
+        self.hist: typing.Dict[tuple, dict] = {}
+
+    def _hist_slot(self, labels: dict) -> dict:
+        key = tuple(sorted(labels.items()))
+        slot = self.hist.get(key)
+        if slot is None:
+            slot = {"labels": dict(labels), "le": {}, "sum": 0.0,
+                    "count": 0.0}
+            self.hist[key] = slot
+        return slot
+
+    def snapshots(self) -> typing.List[typing.Tuple[dict, tuple, list,
+                                                    float, float]]:
+        """Per-labelset histogram snapshots as
+        ``(labels, edges, non_cumulative_counts, sum, count)`` — the
+        ``registry.Histogram.snapshot`` shape ``merge_histogram_counts``
+        and ``bucket_quantile`` consume."""
+        out = []
+        for slot in self.hist.values():
+            finite = sorted(e for e in slot["le"] if e != math.inf)
+            cum_prev = 0.0
+            counts = []
+            for e in finite:
+                c = slot["le"][e]
+                counts.append(c - cum_prev)
+                cum_prev = c
+            inf_cum = slot["le"].get(math.inf, cum_prev)
+            counts.append(inf_cum - cum_prev)
+            out.append((slot["labels"], tuple(finite), counts,
+                        slot["sum"], slot["count"]))
+        return out
+
+
+def parse_prom_text(text: str) -> typing.Dict[str, Family]:
+    """Parse a Prometheus 0.0.4 text exposition into families.  Built for
+    OUR renderer's output (registry.render / this module's federate), but
+    tolerant: unknown lines are skipped, untyped samples become untyped
+    families."""
+    families: typing.Dict[str, Family] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                fam = families.setdefault(parts[2], Family(parts[2]))
+                if parts[1] == "TYPE":
+                    fam.kind = parts[3] if len(parts) > 3 else "untyped"
+                elif len(parts) > 3:
+                    fam.help = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labelstr, valstr = m.group(1), m.group(2), m.group(3)
+        try:
+            value = float(valstr)
+        except ValueError:
+            continue
+        labels = {k: _unescape(v)
+                  for k, v in _LABEL_RE.findall(labelstr or "")}
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            cand = name[:-len(suffix)] if name.endswith(suffix) else None
+            if cand and cand in families and families[cand].kind \
+                    == "histogram":
+                base = (cand, suffix)
+                break
+        if base is not None:
+            fam = families[base[0]]
+            if base[1] == "_bucket":
+                le = labels.pop("le", None)
+                if le is None:
+                    continue
+                edge = math.inf if le == "+Inf" else float(le)
+                fam._hist_slot(labels)["le"][edge] = value
+            elif base[1] == "_sum":
+                fam._hist_slot(labels)["sum"] = value
+            else:
+                fam._hist_slot(labels)["count"] = value
+            continue
+        fam = families.setdefault(name, Family(name))
+        fam.samples.append((labels, value))
+    return {n: f for n, f in families.items() if f.samples or f.hist}
+
+
+# -- federation ---------------------------------------------------------------
+
+FLEET_RANK_LABEL = "rank"
+FLEET_AGG_VALUE = "fleet"  # the rank label value aggregate series carry
+
+
+def _group_key(labels: dict) -> tuple:
+    return tuple(sorted((k, v) for k, v in labels.items()
+                        if k != FLEET_RANK_LABEL))
+
+
+def federate(rank_texts: typing.Dict[int, str],
+             errors: typing.Optional[list] = None) -> str:
+    """Merge per-rank Prometheus expositions into one federated text:
+
+    - every per-rank sample keeps its series name and gains
+      ``rank="<r>"`` (samples already carrying a rank label — the
+      supervisor's own, satellite-fixed series — are passed through);
+    - fleet aggregates ride the same family under ``rank="fleet"``:
+      counters sum; gauges get ``agg="min"|"mean"|"max"``; histograms
+      merge exactly via :func:`registry.merge_histogram_counts` (same
+      edges summed element-wise — lossless), and mismatched edges are
+      rejected LOUDLY: no aggregate, an ``hbnlp_fleet_merge_errors``
+      sample, and an entry in ``errors``.
+
+    Kind conflicts between ranks (same family name, different TYPE) are
+    treated the same way — per-rank samples still render, the aggregate is
+    refused."""
+    if errors is None:
+        errors = []
+    parsed = {r: parse_prom_text(t) for r, t in sorted(rank_texts.items())}
+    names = sorted({n for fams in parsed.values() for n in fams})
+    lines: typing.List[str] = []
+    for name in names:
+        per_rank = [(r, fams[name]) for r, fams in parsed.items()
+                    if name in fams]
+        kinds = {f.kind for _, f in per_rank}
+        fam0 = per_rank[0][1]
+        kind = fam0.kind if len(kinds) == 1 else "untyped"
+        if len(kinds) != 1:
+            errors.append(f"{name}: TYPE differs across ranks "
+                          f"({sorted(kinds)}); no aggregate emitted")
+        lines.append(f"# HELP {name} {fam0.help}" if fam0.help
+                     else f"# HELP {name} (federated)")
+        lines.append(f"# TYPE {name} {kind}")
+        # counters / gauges / untyped ----------------------------------------
+        # dedup by the FINAL label set (a series already carrying a rank
+        # label — e.g. the supervisor's own — may appear in several
+        # snapshots; last posting wins, and aggregates see it once)
+        flat: typing.Dict[str, typing.Tuple[dict, float]] = {}
+        for r, fam in per_rank:
+            for labels, value in fam.samples:
+                out = dict(labels)
+                out.setdefault(FLEET_RANK_LABEL, str(r))
+                flat[_label_str(out)] = (out, value)
+        groups: typing.Dict[tuple, typing.List[float]] = {}
+        for ls, (out, value) in flat.items():
+            lines.append(f"{name}{ls} {_fmt(value)}")
+            if value == value:  # a NaN sample (failed callback gauge)
+                # renders per-rank but must not poison the aggregates
+                groups.setdefault(_group_key(out), []).append(value)
+        if len(kinds) == 1 and kind in ("counter", "gauge"):
+            for key, values in sorted(groups.items()):
+                base = dict(key)
+                base[FLEET_RANK_LABEL] = FLEET_AGG_VALUE
+                if kind == "counter":
+                    lines.append(f"{name}{_label_str(base)} "
+                                 f"{_fmt(sum(values))}")
+                else:
+                    for agg, v in (("min", min(values)),
+                                   ("mean", sum(values) / len(values)),
+                                   ("max", max(values))):
+                        lines.append(
+                            f"{name}{_label_str(dict(base, agg=agg))} "
+                            f"{_fmt(v)}")
+        # histograms ---------------------------------------------------------
+        hflat: typing.Dict[str, tuple] = {}
+        for r, fam in per_rank:
+            for labels, edges, counts, hsum, hcount in fam.snapshots():
+                out = dict(labels)
+                out.setdefault(FLEET_RANK_LABEL, str(r))
+                hflat[_label_str(out)] = (out, edges, counts, hsum, hcount)
+        hist_groups: typing.Dict[tuple, list] = {}
+        for out, edges, counts, hsum, hcount in hflat.values():
+            lines.extend(_render_hist(name, out, edges, counts,
+                                      hsum, hcount))
+            hist_groups.setdefault(_group_key(out), []).append(
+                (edges, counts, hsum, hcount))
+        if len(kinds) == 1 and kind == "histogram":
+            for key, parts in sorted(hist_groups.items()):
+                base = dict(key)
+                base[FLEET_RANK_LABEL] = FLEET_AGG_VALUE
+                try:
+                    edges, merged = merge_histogram_counts(
+                        [(e, c) for e, c, _, _ in parts])
+                except ValueError as e:
+                    errors.append(f"{name}{_label_str(dict(key))}: {e}")
+                    continue
+                lines.extend(_render_hist(
+                    name, base, edges, merged,
+                    sum(p[2] for p in parts), sum(p[3] for p in parts)))
+    # a GAUGE, always emitted (including 0): the value is recomputed per
+    # render, so counter semantics would read every clean scrape after a
+    # bad one as a counter reset, and absent-when-zero would keep
+    # increase()-style alerts from ever arming off a clean baseline
+    lines.append("# HELP hbnlp_fleet_merge_errors federation aggregates "
+                 "refused this render (bucket-edge or TYPE mismatch "
+                 "across ranks)")
+    lines.append("# TYPE hbnlp_fleet_merge_errors gauge")
+    lines.append(f"hbnlp_fleet_merge_errors {len(errors)}")
+    for msg in errors:
+        LOG.warning("fleet federation: %s", msg)
+    return "\n".join(lines) + "\n"
+
+
+def _render_hist(name: str, labels: dict, edges: typing.Sequence[float],
+                 counts: typing.Sequence[float], hsum: float,
+                 hcount: float) -> typing.List[str]:
+    lines = []
+    cum = 0.0
+    for e, c in zip(edges, counts):
+        cum += c
+        lines.append(f"{name}_bucket{_label_str(dict(labels, le=_fmt(e)))} "
+                     f"{_fmt(cum)}")
+    cum += counts[-1]
+    lines.append(f"{name}_bucket{_label_str(dict(labels, le='+Inf'))} "
+                 f"{_fmt(cum)}")
+    lines.append(f"{name}_sum{_label_str(labels)} {_fmt(hsum)}")
+    lines.append(f"{name}_count{_label_str(labels)} {_fmt(hcount)}")
+    return lines
+
+
+# -- step posts + straggler attribution ---------------------------------------
+
+_STEPS_RE = re.compile(r"^steps_r(\d+)\.jsonl$")
+_PROM_RE = re.compile(r"^(?:metrics|supervisor)_r(\d+)\.prom$")
+_TRACE_RE = re.compile(r"^trace_r(\d+)\.json$")
+
+
+def obs_dir(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, OBS_SUBDIR)
+
+
+#: how much of each rank's step-post file a read considers (the newest
+#: tail): the files are append-only for the run's whole lifetime, and the
+#: federated /metrics endpoint re-reads them on EVERY scrape — an
+#: unbounded read would grow a week-long run's scrape cost linearly (tens
+#: of MB per rank over what may be a network mount).  2 MiB is ~40k posts
+#: per rank, far more than skew/EMA attribution needs.
+STEP_POSTS_TAIL_BYTES = 2 * 1024 * 1024
+
+
+def read_step_posts(fleet_dir: str,
+                    tail_bytes: int = STEP_POSTS_TAIL_BYTES
+                    ) -> typing.Dict[int, typing.Dict[int, dict]]:
+    """{rank: {step: {"wall": dispatch wall, "gen": fleet generation or
+    None}}} from the newest ``tail_bytes`` of each per-rank step posting
+    file (0 = unbounded).  Appends across relaunches; a re-run step's
+    NEWEST post wins (the resumed generation re-dispatches steps behind
+    its restore point), and the generation tag lets skew attribution
+    refuse to compare one rank's pre-crash walls against another's
+    post-relaunch walls."""
+    out: typing.Dict[int, typing.Dict[int, dict]] = {}
+    d = obs_dir(fleet_dir)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for fn in names:
+        m = _STEPS_RE.match(fn)
+        if not m:
+            continue
+        rank = int(m.group(1))
+        steps: typing.Dict[int, dict] = {}
+        try:
+            with open(os.path.join(d, fn), "rb") as f:
+                if tail_bytes > 0:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    if size > tail_bytes:
+                        f.seek(size - tail_bytes)
+                        f.readline()  # discard the partial first line
+                    else:
+                        f.seek(0)
+                for raw in f:
+                    try:
+                        row = json.loads(raw)
+                        gen = row.get("gen")
+                        steps[int(row["step"])] = {
+                            "wall": float(row["wall"]),
+                            "gen": None if gen is None else int(gen)}
+                    except (ValueError, KeyError, TypeError):
+                        continue  # torn tail line of a live writer
+        except OSError:
+            continue
+        if steps:
+            out[rank] = steps
+    return out
+
+
+def straggler_report(posts: typing.Dict[int, typing.Dict[int, dict]],
+                     ema_alpha: float = EMA_ALPHA) -> dict:
+    """Per-step skew + per-rank straggler attribution over the steps EVERY
+    posting rank dispatched in the SAME fleet generation
+    (docs/observability.md "Fleet observability"):
+
+    - ``skew_ms`` — max-minus-min dispatch wall across ranks per step
+      (last / mean / max / p95): how far apart the fleet runs;
+    - ``straggler_score_ms`` per rank — EMA of that rank's lag behind the
+      fastest rank each step; ``straggler_rank`` = argmax (None when no
+      rank is measurably behind);
+    - ``barrier_wait_s`` per rank — seconds this rank would idle at a
+      per-step barrier waiting for the slowest rank (fast ranks accumulate
+      the most); the total is the fleet-level twin of graftprof's
+      ``comm + idle`` bucket: compute the whole fleet paid to its skew.
+
+    The generation match matters after an elastic relaunch: ranks restore
+    from different steps, so one rank RE-dispatches a step the other only
+    ran before the crash — comparing those walls would report the whole
+    outage as per-step skew.  Steps whose newest posts disagree on
+    generation are excluded (counted in ``n_generation_skipped``)."""
+    ranks = sorted(posts)
+    report: dict = {"ranks": {}, "n_common_steps": 0,
+                    "n_generation_skipped": 0, "skew_ms": None,
+                    "straggler_rank": None, "barrier_wait_total_s": 0.0}
+    for r in ranks:
+        walls = [posts[r][s]["wall"] for s in sorted(posts[r])]
+        deltas = [b - a for a, b in zip(walls, walls[1:]) if b > a]
+        report["ranks"][str(r)] = {
+            "steps": len(walls),
+            "last_step": max(posts[r]),
+            "mean_step_s": (sum(deltas) / len(deltas)) if deltas else None,
+            "straggler_score_ms": 0.0,
+            "barrier_wait_s": 0.0,
+        }
+    if len(ranks) < 2:
+        return report
+    candidates = sorted(set.intersection(*(set(posts[r]) for r in ranks)))
+    common = [s for s in candidates
+              if len({posts[r][s]["gen"] for r in ranks}) == 1]
+    report["n_common_steps"] = len(common)
+    report["n_generation_skipped"] = len(candidates) - len(common)
+    if not common:
+        return report
+    skews = []
+    scores = {r: 0.0 for r in ranks}
+    waits = {r: 0.0 for r in ranks}
+    for s in common:
+        walls = {r: posts[r][s]["wall"] for r in ranks}
+        lo, hi = min(walls.values()), max(walls.values())
+        skews.append((hi - lo) * 1e3)
+        for r in ranks:
+            lag_ms = (walls[r] - lo) * 1e3
+            scores[r] = ema_alpha * lag_ms + (1 - ema_alpha) * scores[r]
+            waits[r] += hi - walls[r]
+    for r in ranks:
+        report["ranks"][str(r)]["straggler_score_ms"] = round(scores[r], 3)
+        report["ranks"][str(r)]["barrier_wait_s"] = round(waits[r], 6)
+    report["skew_ms"] = {
+        "last": round(skews[-1], 3),
+        "mean": round(sum(skews) / len(skews), 3),
+        "max": round(max(skews), 3),
+        "p95": round(sample_quantile(skews, 0.95), 3),
+    }
+    report["barrier_wait_total_s"] = round(sum(waits.values()), 6)
+    worst = max(ranks, key=lambda r: scores[r])
+    if scores[worst] > 0:
+        report["straggler_rank"] = worst
+    return report
+
+
+# -- trace merge --------------------------------------------------------------
+
+BARRIER_SPAN = "dist/barrier"
+
+
+def _barrier_ends(trace: dict) -> typing.Dict[tuple, float]:
+    """{(barrier name, occurrence index): wall end time} of every
+    ``dist/barrier`` span in one rank's trace — ranks leave a given
+    barrier at (nearly) the same true instant, so matching END times
+    across ranks carry the inter-rank clock offset."""
+    epoch = float(trace.get("otherData", {}).get("wall_epoch", 0.0))
+    seen: typing.Dict[str, int] = {}
+    out: typing.Dict[tuple, float] = {}
+    events = sorted((e for e in trace.get("traceEvents", [])
+                     if e.get("ph") == "X" and e.get("name") == BARRIER_SPAN),
+                    key=lambda e: e.get("ts", 0.0))
+    for e in events:
+        name = str(e.get("args", {}).get("barrier", ""))
+        k = seen.get(name, 0)
+        seen[name] = k + 1
+        out[(name, k)] = epoch + (e.get("ts", 0.0)
+                                  + e.get("dur", 0.0)) / 1e6
+    return out
+
+
+def estimate_offsets(traces: typing.Dict[int, dict]) -> dict:
+    """Per-rank clock offsets from matched barrier-exit pairs.
+
+    ``offset[r]`` is the seconds to ADD to rank r's wall clock to land on
+    the base rank's timebase — the lowest rank WITH barrier spans, so a
+    base candidate whose trace lost its spans cannot silently zero every
+    pairing — estimated as the mean of ``end_base(b) - end_r(b)`` over
+    every barrier pair both ranks recorded.  ``bound_s`` is the error
+    bound the docs commit to: the maximum residual of any single pair
+    around that mean (barrier release skew + wall-clock sampling noise).
+    A rank with NO matched pairs falls back to offset 0 (raw
+    ``wall_epoch`` alignment) and is listed in ``ranks_without_pairs``;
+    when that happens (or no rank has pairs) ``bound_s`` is None — the
+    merge still renders, but it must not advertise an alignment one lane
+    does not have."""
+    ranks = sorted(traces)
+    out = {"base_rank": ranks[0] if ranks else None,
+           "offsets_s": {str(r): 0.0 for r in ranks},
+           "bound_s": None, "n_pairs": 0, "ranks_without_pairs": [],
+           "ranks_with_spans": []}
+    if len(ranks) < 2:
+        return out
+    ends_by_rank = {r: _barrier_ends(traces[r]) for r in ranks}
+    # which lanes recorded ANY barrier span: the --check gate needs to
+    # tell 'no rank barriers' (supervision-only fleets: legitimate raw
+    # wall-clock merge) from 'SOME lanes have barrier evidence and others
+    # lost theirs' (a mixed merge that must not gate green) — with two
+    # ranks, pair counts alone cannot distinguish the cases
+    out["ranks_with_spans"] = [r for r in ranks if ends_by_rank[r]]
+    base_rank = next((r for r in ranks if ends_by_rank[r]), ranks[0])
+    out["base_rank"] = base_rank
+    base = ends_by_rank[base_rank]
+    residual_max = 0.0
+    n_pairs = 0
+    for r in ranks:
+        if r == base_rank:
+            continue
+        ends = ends_by_rank[r]
+        deltas = [base[k] - ends[k] for k in sorted(set(base) & set(ends))]
+        if not deltas:
+            out["ranks_without_pairs"].append(r)
+            continue
+        off = sum(deltas) / len(deltas)
+        out["offsets_s"][str(r)] = round(off, 9)
+        residual_max = max(residual_max,
+                           max(abs(d - off) for d in deltas))
+        n_pairs += len(deltas)
+    out["n_pairs"] = n_pairs
+    if n_pairs and not out["ranks_without_pairs"]:
+        out["bound_s"] = round(residual_max, 9)
+    return out
+
+
+def merge_traces(traces: typing.Dict[int, dict],
+                 offsets: typing.Optional[dict] = None) -> dict:
+    """One Chrome trace with a lane (pid) per rank on a common timebase.
+
+    Each rank's events shift onto the base rank's wall clock
+    (``wall_epoch + ts + offset``); the merged origin is the earliest
+    shifted event, so Perfetto renders small relative times.  Thread-name
+    metadata survives per rank; each rank's process lane is named
+    ``rank <r>``."""
+    if offsets is None:
+        offsets = estimate_offsets(traces)
+    shifted: typing.List[dict] = []
+    origin = None
+    per_rank: typing.List[typing.Tuple[int, float, dict]] = []
+    for r, trace in sorted(traces.items()):
+        epoch = float(trace.get("otherData", {}).get("wall_epoch", 0.0))
+        off = float(offsets["offsets_s"].get(str(r), 0.0))
+        per_rank.append((r, epoch + off, trace))
+        for e in trace.get("traceEvents", []):
+            if e.get("ph") == "X":
+                t = epoch + off + e.get("ts", 0.0) / 1e6
+                origin = t if origin is None else min(origin, t)
+    origin = origin or 0.0
+    for r, base_wall, trace in per_rank:
+        shifted.append({"ph": "M", "name": "process_name", "pid": r,
+                        "tid": 0, "args": {"name": f"rank {r}"}})
+        for e in trace.get("traceEvents", []):
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                shifted.append(dict(e, pid=r))
+            elif e.get("ph") == "X":
+                ts = (base_wall + e.get("ts", 0.0) / 1e6 - origin) * 1e6
+                shifted.append(dict(e, pid=r, ts=round(ts, 3)))
+    return {"traceEvents": shifted, "displayTimeUnit": "ms",
+            "otherData": {"wall_origin": origin,
+                          "clock_offsets": offsets,
+                          "ranks": sorted(traces)}}
+
+
+def read_traces(fleet_dir: str) -> typing.Dict[int, dict]:
+    out: typing.Dict[int, dict] = {}
+    d = obs_dir(fleet_dir)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return out
+    for fn in names:
+        m = _TRACE_RE.match(fn)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(d, fn)) as f:
+                out[int(m.group(1))] = json.load(f)
+        except (OSError, ValueError) as e:
+            LOG.warning("fleet trace %s unreadable: %r", fn, e)
+    return out
+
+
+# -- child side: FleetReporter ------------------------------------------------
+
+class FleetReporter:
+    """The child-side posting half, wired by ``Obs`` and fed from the
+    metric drain (``AsyncMetricWriter``): NEVER from the dispatch hot path
+    — the ``host-sync`` ratchet guards the loop, and this class only runs
+    where file I/O already happens.
+
+    Every write is best-effort: the fleet dir may be a network mount, and
+    a posting hiccup must degrade to a logged miss (the federation shows a
+    stale rank), never kill training — the same weather contract as the
+    supervisor's fleet protocol."""
+
+    def __init__(self, fleet_dir: str, rank: int, world_size: int,
+                 registry=None, min_render_s: float = 2.0,
+                 clock: typing.Callable[[], float] = time.time):
+        self.dir = obs_dir(fleet_dir)
+        self.fleet_dir = fleet_dir
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.registry = registry
+        self.min_render_s = float(min_render_s)
+        self.clock = clock
+        #: fleet generation of THIS launch (supervisor-injected env,
+        #: constant for the process lifetime): stamped on every step post
+        #: so skew attribution never compares walls across relaunches
+        self.generation = identity().get("generation")
+        self._lock = threading.Lock()
+        self._last_render = 0.0
+        self._steps_f = None
+        self._warned = False
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            self._steps_f = open(  # graftcheck: disable=bare-io
+                os.path.join(self.dir, f"steps_r{self.rank}.jsonl"), "a")
+        except OSError as e:
+            self._warn(f"cannot open step-post file: {e!r}")
+
+    def _warn(self, msg: str) -> None:
+        if not self._warned:
+            LOG.warning("fleet posting degraded (rank %d): %s",
+                        self.rank, msg)
+            self._warned = True
+
+    def step_completed(self, step: int, dispatch_wall: float) -> None:
+        """Post one step's DISPATCH wall time (drain-side call — the drain
+        already holds the dispatch timestamp, and dispatch spacing is the
+        cadence skew attribution needs, not drain spacing)."""
+        row = {"step": int(step), "wall": float(dispatch_wall)}
+        if self.generation is not None:
+            row["gen"] = self.generation
+        with self._lock:
+            if self._steps_f is not None:
+                try:
+                    self._steps_f.write(json.dumps(row) + "\n")
+                    self._steps_f.flush()
+                except OSError as e:
+                    self._warn(f"step post failed: {e!r}")
+            now = self.clock()
+            if (self.registry is not None
+                    and now - self._last_render >= self.min_render_s):
+                self._last_render = now
+                self._render_prom_locked()
+
+    def render_prom(self) -> None:
+        with self._lock:
+            self._render_prom_locked()
+
+    def _render_prom_locked(self) -> None:
+        if self.registry is None:
+            return
+        path = os.path.join(self.dir, f"metrics_r{self.rank}.prom")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:  # graftcheck: disable=bare-io
+                f.write(self.registry.render())
+            os.replace(tmp, path)
+        except OSError as e:
+            self._warn(f"prom snapshot failed: {e!r}")
+
+    def export_trace(self, tracer) -> None:
+        """Copy this rank's span trace into the fleet dir (Obs.close)."""
+        if tracer is None:
+            return
+        try:
+            tracer.export(os.path.join(self.dir,
+                                       f"trace_r{self.rank}.json"))
+        except Exception as e:  # noqa: BLE001 - never fail the run's exit
+            self._warn(f"trace export failed: {e!r}")
+
+    def skew_summary(self) -> dict:
+        """The straggler report over the CURRENT fleet-dir postings — the
+        watchdog inlines it into stall diagnostics, so a hang dump says
+        whether THIS rank was the fleet's straggler before it wedged."""
+        try:
+            report = straggler_report(read_step_posts(self.fleet_dir))
+        except Exception as e:  # noqa: BLE001 - diagnostics must not throw
+            return {"error": repr(e)}
+        report["own_rank"] = self.rank
+        return report
+
+    def close(self) -> None:
+        with self._lock:
+            self._render_prom_locked()
+            if self._steps_f is not None:
+                try:
+                    self._steps_f.close()
+                except OSError:
+                    pass
+                self._steps_f = None
+
+
+# -- read side: FleetFederation + federation server ---------------------------
+
+class FleetFederation:
+    """The supervisor/CLI-side read half: renders the federated exposition
+    and the fleet /healthz snapshot from the fleet dir's per-rank
+    postings.  ``own_registry``/``own_rank`` splice a LIVE local registry
+    (the serving supervisor's own counters) in place of that rank's
+    on-disk snapshot."""
+
+    def __init__(self, fleet_dir: str, own_registry=None,
+                 own_rank: typing.Optional[int] = None,
+                 world_size: typing.Optional[int] = None,
+                 identity_doc: typing.Optional[dict] = None,
+                 generation: typing.Optional[
+                     typing.Callable[[], int]] = None,
+                 stale_after_s: float = 600.0):
+        self.fleet_dir = fleet_dir
+        self.own_registry = own_registry
+        self.own_rank = own_rank
+        self.world_size = world_size
+        self.identity_doc = identity_doc or {}
+        self.generation = generation
+        self.stale_after_s = float(stale_after_s)
+
+    def rank_texts(self) -> typing.Dict[int, str]:
+        """{rank: concatenated prom text} from the per-rank child and
+        supervisor snapshots (distinct family names, so concatenation is a
+        valid exposition), with the own-rank supervisor snapshot replaced
+        by the live registry."""
+        texts: typing.Dict[int, typing.List[str]] = {}
+        d = obs_dir(self.fleet_dir)
+        try:
+            names = os.listdir(d)
+        except OSError:
+            names = []
+        for fn in sorted(names):
+            m = _PROM_RE.match(fn)
+            if not m:
+                continue
+            rank = int(m.group(1))
+            if (self.own_registry is not None and rank == self.own_rank
+                    and fn.startswith("supervisor_")):
+                continue  # served live below
+            try:
+                with open(os.path.join(d, fn)) as f:
+                    texts.setdefault(rank, []).append(f.read())
+            except OSError as e:
+                LOG.warning("fleet snapshot %s unreadable: %r", fn, e)
+        if self.own_registry is not None and self.own_rank is not None:
+            texts.setdefault(self.own_rank, []).append(
+                self.own_registry.render())
+        return {r: "\n".join(parts) for r, parts in texts.items()}
+
+    def fleet_series(self, report: dict,
+                     n_reporting: typing.Optional[int] = None) -> str:
+        """The fleet-level attribution gauges, rendered straight to text
+        (they exist only at federation scope — no single rank can compute
+        them).  ``n_reporting``: ranks with a metrics snapshot OR step
+        postings (the /healthz definition — a rank that posted metrics but
+        no step yet must not read as a dark fleet); defaults to the
+        step-posting count when the caller has nothing better."""
+        if n_reporting is None:
+            n_reporting = len(report["ranks"])
+        lines = [
+            "# HELP hbnlp_fleet_ranks_reporting ranks with a metrics "
+            "snapshot or step postings in the fleet dir",
+            "# TYPE hbnlp_fleet_ranks_reporting gauge",
+            f"hbnlp_fleet_ranks_reporting {n_reporting}",
+        ]
+        skew = report.get("skew_ms")
+        if skew:
+            lines += ["# HELP hbnlp_fleet_step_skew_ms max-minus-min "
+                      "step-dispatch wall across ranks",
+                      "# TYPE hbnlp_fleet_step_skew_ms gauge"]
+            for stat, v in sorted(skew.items()):
+                lines.append(
+                    f'hbnlp_fleet_step_skew_ms{{stat="{stat}"}} {_fmt(v)}')
+        worst = report.get("straggler_rank")
+        lines += ["# HELP hbnlp_fleet_straggler_rank rank with the highest "
+                  "EMA lag behind the fastest rank (-1: none measurable)",
+                  "# TYPE hbnlp_fleet_straggler_rank gauge",
+                  f"hbnlp_fleet_straggler_rank "
+                  f"{-1 if worst is None else worst}"]
+        if report["ranks"]:
+            lines += ["# HELP hbnlp_fleet_straggler_score_ms EMA of each "
+                      "rank's per-step lag behind the fastest rank",
+                      "# TYPE hbnlp_fleet_straggler_score_ms gauge"]
+            for r, row in sorted(report["ranks"].items(), key=lambda kv:
+                                 int(kv[0])):
+                lines.append(f'hbnlp_fleet_straggler_score_ms{{rank="{r}"}} '
+                             f'{_fmt(row["straggler_score_ms"])}')
+            lines += ["# HELP hbnlp_fleet_barrier_wait_seconds seconds each "
+                      "rank would idle at a per-step barrier waiting for "
+                      "the slowest rank (the fleet twin of graftprof's "
+                      "comm+idle bucket)",
+                      "# TYPE hbnlp_fleet_barrier_wait_seconds gauge"]
+            for r, row in sorted(report["ranks"].items(), key=lambda kv:
+                                 int(kv[0])):
+                lines.append(f'hbnlp_fleet_barrier_wait_seconds'
+                             f'{{rank="{r}"}} {_fmt(row["barrier_wait_s"])}')
+            lines += ["# HELP hbnlp_fleet_last_step newest step each rank "
+                      "posted a dispatch timestamp for",
+                      "# TYPE hbnlp_fleet_last_step gauge"]
+            for r, row in sorted(report["ranks"].items(), key=lambda kv:
+                                 int(kv[0])):
+                lines.append(f'hbnlp_fleet_last_step{{rank="{r}"}} '
+                             f'{row["last_step"]}')
+        return "\n".join(lines) + "\n"
+
+    def render(self) -> str:
+        """The federated /metrics body: per-rank + aggregate series, then
+        the fleet attribution gauges."""
+        errors: typing.List[str] = []
+        texts = self.rank_texts()
+        body = federate(texts, errors=errors)
+        posts = read_step_posts(self.fleet_dir)
+        report = straggler_report(posts)
+        return body + self.fleet_series(
+            report, n_reporting=len(set(texts) | set(posts)))
+
+    def snapshot(self) -> dict:
+        """The fleet /healthz payload: identity, generation, which ranks
+        are reporting (and how stale), and the straggler summary.
+
+        A rank whose newest step post is older than ``stale_after_s`` is
+        flagged ``stale`` and degrades the fleet status: a host that died
+        without any exit posting (machine gone, not process crash) leaves
+        its files behind, and file EXISTENCE alone would read as healthy
+        forever.  Metrics-only ranks (posted a snapshot, no step yet) have
+        no post to age and are not flagged — fleet children always post
+        steps, so that state is transient startup."""
+        posts = read_step_posts(self.fleet_dir)
+        report = straggler_report(posts)
+        texts = self.rank_texts()
+        now = time.time()
+        ranks = {}
+        any_stale = False
+        for r in sorted(set(texts) | set(posts)):
+            newest = max((row["wall"] for row in posts.get(r, {}).values()),
+                         default=None)
+            age = None if newest is None else round(now - newest, 3)
+            stale = age is not None and age > self.stale_after_s
+            any_stale = any_stale or stale
+            ranks[str(r)] = {
+                "metrics_snapshot": r in texts,
+                "last_step": (max(posts[r]) if posts.get(r) else None),
+                "seconds_since_last_post": age,
+                "stale": stale,
+            }
+        reporting = len(ranks)
+        expect = self.world_size or reporting
+        status = ("empty" if reporting == 0 else
+                  "degraded" if reporting < expect or any_stale else "ok")
+        out = {"status": status,
+               "identity": dict(self.identity_doc),
+               "world_size": self.world_size,
+               "ranks": ranks,
+               "straggler": report}
+        if self.generation is not None:
+            try:
+                out["generation"] = int(self.generation())
+            except Exception:
+                out["generation"] = None
+        return out
+
+
+class _FederationServer(ThreadingHTTPServer):
+    daemon_threads = True
+    federation: FleetFederation
+
+
+class _FederationHandler(BaseHTTPRequestHandler):
+    def _send(self, status: int, body: bytes, ctype: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        fed = self.server.federation
+        if path == "/metrics":
+            try:
+                body = fed.render().encode()
+            except Exception as e:  # noqa: BLE001 - scrape must not 500 raw
+                body = f"# federation render failed: {e!r}\n".encode()
+            self._send(200, body,
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/healthz":
+            snap = fed.snapshot()
+            # 503 only when the fleet is DARK (no rank ever posted): a
+            # degraded fleet still serves what it knows
+            status = 503 if snap["status"] == "empty" else 200
+            self._send(status, json.dumps(snap).encode(),
+                       "application/json")
+        else:
+            self.send_error(404)
+
+    def log_message(self, fmt, *args):
+        LOG.debug("fleet %s %s", self.address_string(), fmt % args)
+
+
+def serve_federation(port: int, federation: FleetFederation,
+                     host: str = "127.0.0.1") -> _FederationServer:
+    """Serve the federated /metrics + fleet /healthz on a daemon thread —
+    stdlib-only on purpose: the supervisor must keep federating through
+    exactly the toolchain failures that kill the child (the obs exporter
+    import would drag jax in).  ``port=0`` binds ephemeral."""
+    server = _FederationServer((host, port), _FederationHandler)
+    server.federation = federation
+    thread = threading.Thread(target=server.serve_forever,
+                              name="fleet-federation", daemon=True)
+    server._thread = thread
+    thread.start()
+    return server
+
+
+def stop_federation(server: _FederationServer) -> None:
+    server.shutdown()
+    server.server_close()
+    server._thread.join(timeout=5.0)

@@ -83,6 +83,44 @@ def sample_quantile(samples: typing.Sequence[float], q: float
     return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
 
 
+def merge_histogram_counts(
+        parts: typing.Sequence[typing.Tuple[typing.Sequence[float],
+                                            typing.Sequence[float]]]
+) -> typing.Tuple[typing.Tuple[float, ...], typing.List[float]]:
+    """Exact merge of Prometheus-style histogram snapshots from several
+    sources (ranks): same finite bucket edges -> element-wise count sum,
+    which is LOSSLESS — the merged histogram is exactly what one histogram
+    observing every rank's samples would hold, so ``bucket_quantile`` over
+    the merge has the same resolution as over any single rank.
+
+    ``parts`` is a sequence of ``(edges, counts)`` pairs with
+    NON-cumulative counts and one trailing +Inf entry
+    (``len(counts) == len(edges) + 1`` — the :meth:`Histogram.snapshot`
+    shape).  Mismatched edges are REJECTED loudly (ValueError): summing
+    counts across different bucketings would silently reassign
+    observations to wrong value ranges, which is exactly the corruption a
+    fleet merge must never hide.  Returns ``(edges, merged_counts)``;
+    raises on an empty ``parts``."""
+    if not parts:
+        raise ValueError("merge_histogram_counts: nothing to merge")
+    edges0 = tuple(float(b) for b in parts[0][0])
+    merged = [0.0] * (len(edges0) + 1)
+    for i, (edges, counts) in enumerate(parts):
+        edges = tuple(float(b) for b in edges)
+        if edges != edges0:
+            raise ValueError(
+                f"histogram bucket edges differ between sources (part 0: "
+                f"{list(edges0)}, part {i}: {list(edges)}); an exact merge "
+                f"is only defined over identical edges")
+        if len(counts) != len(edges0) + 1:
+            raise ValueError(
+                f"part {i}: expected {len(edges0) + 1} counts "
+                f"(finite buckets + Inf), got {len(counts)}")
+        for j, c in enumerate(counts):
+            merged[j] += float(c)
+    return edges0, merged
+
+
 def bucket_width_at(buckets: typing.Sequence[float], value: float) -> float:
     """Width of the histogram bucket a value falls into — the resolution
     floor of any bucket-interpolated quantile at that point, used as the
@@ -178,6 +216,12 @@ class _Bound:
     def set(self, v: float) -> None:
         self._metric._set(self._child, v)
 
+    def set_function(self, fn: typing.Callable[[], float]) -> None:
+        """Render-time callback for THIS label combination (gauges only) —
+        a fleet of per-rank series can each expose a live value without a
+        poller running between scrapes."""
+        self._metric._set_child_fn(self._child, fn)
+
     def observe(self, v: float) -> None:
         self._metric._observe(self._child, v)
 
@@ -224,14 +268,15 @@ class Gauge(_Metric):
         self._fn = fn
 
     def set_function(self, fn: typing.Callable[[], float]) -> None:
-        """Render-time callback (only valid unlabelled)."""
+        """Render-time callback (only valid unlabelled; labelled gauges take
+        per-child callbacks via ``labels(...).set_function``)."""
         if self.labelnames:
-            raise ValueError(f"{self.name}: callback gauges cannot be "
-                             "labelled")
+            raise ValueError(f"{self.name}: metric-level callbacks cannot "
+                             "be labelled — use labels(...).set_function")
         self._fn = fn
 
     def _make_child(self):
-        return [0.0]
+        return [0.0, None]  # [value, render-time fn]
 
     def set(self, v: float) -> None:
         self._set(self._default_child(), v)
@@ -239,6 +284,21 @@ class Gauge(_Metric):
     def _set(self, child, v: float) -> None:
         with self._registry._lock:
             child[0] = float(v)
+            child[1] = None  # an explicit set supersedes the callback
+
+    def _set_child_fn(self, child, fn: typing.Callable[[], float]) -> None:
+        with self._registry._lock:
+            child[1] = fn
+
+    @staticmethod
+    def _child_value(child) -> float:
+        fn = child[1]
+        if fn is not None:
+            try:
+                return float(fn())
+            except Exception:
+                return math.nan
+        return child[0]
 
     def value(self, **labels) -> float:
         if self._fn is not None:
@@ -246,7 +306,7 @@ class Gauge(_Metric):
         key = tuple(str(labels[n]) for n in self.labelnames) if labels else ()
         with self._registry._lock:
             child = self._children.get(key)
-            return child[0] if child else 0.0
+        return self._child_value(child) if child else 0.0
 
     def render(self) -> typing.List[str]:
         if self._fn is not None:
@@ -260,8 +320,9 @@ class Gauge(_Metric):
         return super().render()
 
     def _render_child(self, values, child):
+        v = self._child_value(child)
         return [f"{self.name}{_label_str(self.labelnames, values)} "
-                f"{_fmt(child[0])}"]
+                f"{_fmt(v) if v == v else 'NaN'}"]
 
 
 class Histogram(_Metric):

@@ -40,6 +40,7 @@ import os
 import time
 import typing
 
+from ..obs import spans
 from ..obs.registry import REGISTRY, MetricsRegistry
 from . import faults
 from .retry import RetryPolicy, retry_call
@@ -257,7 +258,13 @@ def barrier(name: str, timeout_s: typing.Optional[float] = None) -> None:
         client = None
     if client is not None and hasattr(client, "wait_at_barrier"):
         try:
-            client.wait_at_barrier(name, int(timeout_s * 1000))
+            # the span pair is the fleet trace merge's clock reference:
+            # every rank LEAVES a barrier at nearly the same true instant,
+            # so matching span END times across ranks carry the inter-rank
+            # clock offset (obs/fleet.py::estimate_offsets).  Ambient no-op
+            # when spans are off — the single-host path pays nothing.
+            with spans.span("dist/barrier", barrier=name):
+                client.wait_at_barrier(name, int(timeout_s * 1000))
             return
         except Exception as e:
             raise BarrierTimeout(
@@ -268,7 +275,8 @@ def barrier(name: str, timeout_s: typing.Optional[float] = None) -> None:
     LOG.warning("distributed runtime exposes no wait_at_barrier; barrier "
                 "%r falls back to sync_global_devices (no timeout)", name)
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    with spans.span("dist/barrier", barrier=name):
+        multihost_utils.sync_global_devices(name)
 
 
 def check_peers(step: int) -> None:

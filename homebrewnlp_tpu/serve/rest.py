@@ -234,9 +234,11 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                else (getattr(cfg, "obs_port", 0) if cfg is not None else 0))
     if obs_port is not None or eff_obs:
         try:
+            from ..obs import fleet
             server._obs_server = obs_exporter.start_server(
                 eff_obs, registry=registry if registry is not None
-                else REGISTRY, slo_probe=serve_slo.summary)
+                else REGISTRY, slo_probe=serve_slo.summary,
+                identity=fleet.identity(cfg))
         except OSError:
             server.server_close()  # don't leak the bound REST socket
             raise

@@ -20,7 +20,7 @@ import typing
 
 import numpy as np
 
-from ..obs import spans
+from ..obs import fleet, spans
 from ..reliability import FLUSH_POLICY, retry_call
 
 # log2-|grad| histogram bucket edges shared between the train step (which
@@ -104,15 +104,29 @@ class MetricWriter:
         wall = time.time() - self._t0
         return self._productive_s / wall if wall > 0 else 0.0
 
-    def write_run_start(self, resume_step: int, cfg_hash: str) -> None:
+    def write_run_start(self, resume_step: int, cfg_hash: str,
+                        identity: typing.Optional[dict] = None) -> None:
         """Run boundary marker: ``metrics.jsonl`` appends across restarts, so
         every run begins with ``{"run_start": true, resume_step,
-        config_hash, wall_time}`` — consumers that read metric rows must
-        skip records without a ``"loss"``/``"step"`` key (bench.py's guard
-        and the test helpers do)."""
-        self._f.write(json.dumps({
-            "run_start": True, "resume_step": int(resume_step),
-            "config_hash": cfg_hash, "wall_time": time.time()}) + "\n")
+        config_hash, wall_time}`` plus the fleet identity (rank /
+        world_size / coordinator / generation — obs/fleet.py) so the file
+        itself says which host of which fleet generation wrote it.
+        ``identity``: the caller's cfg-resolved identity (main.py passes
+        ``Obs.identity``) so config-driven multi-host runs — env vars
+        unset, dist_* knobs set — record the same rank /healthz reports;
+        the env-only fallback covers direct writer users.  Consumers that
+        read metric rows must skip records without a ``"loss"``/``"step"``
+        key (bench.py's guard and the test helpers do)."""
+        doc = {"run_start": True, "resume_step": int(resume_step),
+               "config_hash": cfg_hash, "wall_time": time.time()}
+        ident = identity if identity is not None else fleet.identity()
+        doc["rank"] = ident["rank"]
+        doc["world_size"] = ident["world_size"]
+        if ident["coordinator"]:
+            doc["coordinator"] = ident["coordinator"]
+        if "generation" in ident:
+            doc["generation"] = ident["generation"]
+        self._f.write(json.dumps(doc) + "\n")
         self._rows_in_run = 0
         self.flush()
 
@@ -207,7 +221,7 @@ class AsyncMetricWriter:
     """
 
     def __init__(self, writer: MetricWriter, window: int = 2,
-                 health=None, registry=None, anomaly=None):
+                 health=None, registry=None, anomaly=None, reporter=None):
         """``health``/``registry`` (optional, docs/observability.md): each
         drained step reports to ``Health.step_completed`` (the /healthz +
         watchdog notion of progress — a step counts once its metrics
@@ -216,10 +230,14 @@ class AsyncMetricWriter:
         step's telemetry sentinels — counting skip_step skips, raising
         ``AnomalyHalt`` under the halt policy — AFTER the row is written,
         so the anomalous step itself is always in metrics.jsonl for the
-        post-mortem."""
+        post-mortem.  ``reporter`` (an ``obs.fleet.FleetReporter``) posts
+        each drained step's DISPATCH timestamp to the shared fleet dir for
+        cross-rank skew attribution — drain-side like everything else
+        here, so the dispatch hot path stays sync-free."""
         self.writer = writer
         self.window = max(0, int(window))
         self._anomaly = anomaly
+        self._reporter = reporter
         self._pending: typing.Deque[typing.Tuple[int, float, dict]] = \
             collections.deque()
         self.last_loss: typing.Optional[float] = None
@@ -229,8 +247,10 @@ class AsyncMetricWriter:
             "hbnlp_metric_drain_seconds",
             "wall seconds blocked in the device->host metric pull per step")
 
-    def write_run_start(self, resume_step: int, cfg_hash: str) -> None:
-        self.writer.write_run_start(resume_step, cfg_hash)
+    def write_run_start(self, resume_step: int, cfg_hash: str,
+                        identity: typing.Optional[dict] = None) -> None:
+        self.writer.write_run_start(resume_step, cfg_hash,
+                                    identity=identity)
 
     def set_utilization(self, util,
                         run_start: typing.Optional[float] = None) -> None:
@@ -266,6 +286,9 @@ class AsyncMetricWriter:
             # dispatch wall, not drain wall: a flush() draining the whole
             # window back-to-back must not collapse the health EMA
             self._health.step_completed(step, dispatch_wall=wall)
+        if self._reporter is not None:
+            # same dispatch wall: fleet skew measures training cadence
+            self._reporter.step_completed(step, dispatch_wall=wall)
         loss = host.get("loss")
         if loss is not None and getattr(loss, "size", 0) == 1:
             self.last_loss = float(loss)

@@ -28,6 +28,10 @@ def main() -> None:
     p.add_argument("--model-path", required=True)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--fault-plan", default="")
+    p.add_argument("--obs-spans", action="store_true",
+                   help="record host spans (the fleet-obs drill merges the "
+                        "per-rank traces; fleet postings themselves key off "
+                        "the supervisor-injected HBNLP_FLEET_DIR)")
     args = p.parse_args()
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -39,7 +43,8 @@ def main() -> None:
     # the fleet protocol, not the XLA cache
     cfg = tiny_config(model_path=args.model_path, use_checkpointing=True,
                       steps_per_checkpoint=2, fault_plan=args.fault_plan,
-                      grace_deadline_s=60.0, compilation_cache_dir="")
+                      grace_deadline_s=60.0, compilation_cache_dir="",
+                      obs_spans=args.obs_spans)
     cli.train(cfg, argparse.Namespace(steps=args.steps, profile="",
                                       workers=None))
 

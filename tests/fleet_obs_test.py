@@ -1,0 +1,887 @@
+"""Fleet observability suite (ISSUE 11): exact cross-rank histogram
+merging, Prometheus text federation with rank labels + fleet aggregates,
+clock-offset estimation from dist/barrier span pairs + the merged
+multi-lane trace, step-dispatch posting and straggler/barrier-wait
+attribution, the self-describing /healthz identity block and run-start
+markers, rank-labeled supervisor series, the graftfleet CLI, and THE
+two-supervisor composed drill — the CI ``fleet-obs`` job runs this file
+on CPU."""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from homebrewnlp_tpu import main as cli
+from homebrewnlp_tpu.obs import Obs, SpanTracer, fleet, start_server, \
+    stop_server
+from homebrewnlp_tpu.obs.registry import (MetricsRegistry, bucket_quantile,
+                                          merge_histogram_counts)
+
+from .backend import tiny_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import graftfleet  # noqa: E402  (tools/graftfleet.py)
+import supervise  # noqa: E402  (tools/supervise.py)
+
+
+def _args(steps):
+    return argparse.Namespace(steps=steps, profile="", workers=None)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+# -- exact histogram merging (satellite) --------------------------------------
+
+BUCKETS = (0.1, 0.5, 1.0, 5.0)
+
+
+def _observed(values):
+    reg = MetricsRegistry()
+    h = reg.histogram("h", "t", buckets=BUCKETS)
+    for v in values:
+        h.observe(v)
+    return h.snapshot()
+
+
+def test_histogram_merge_same_edges_is_lossless():
+    """The federation contract: merging per-rank snapshots with the SHARED
+    bucket edges equals one histogram that observed every rank's samples —
+    counts, sum, count, and therefore any bucket_quantile, exactly."""
+    a_vals, b_vals = [0.05, 0.3, 0.7, 2.0], [0.2, 0.2, 4.0, 9.0]
+    a, b = _observed(a_vals), _observed(b_vals)
+    edges, merged = merge_histogram_counts(
+        [(BUCKETS, a["counts"]), (BUCKETS, b["counts"])])
+    want = _observed(a_vals + b_vals)
+    assert edges == BUCKETS
+    assert merged == [float(c) for c in want["counts"]]
+    for q in (0.1, 0.5, 0.9, 0.99):
+        assert bucket_quantile(edges, merged, q) == \
+            bucket_quantile(BUCKETS, want["counts"], q)
+
+
+def test_histogram_merge_rejects_mismatched_edges_loudly():
+    a = _observed([0.3])
+    with pytest.raises(ValueError, match="edges differ"):
+        merge_histogram_counts(
+            [(BUCKETS, a["counts"]), ((0.1, 0.5, 2.0, 5.0), a["counts"])])
+    with pytest.raises(ValueError, match="nothing to merge"):
+        merge_histogram_counts([])
+    with pytest.raises(ValueError, match="counts"):
+        merge_histogram_counts([(BUCKETS, [1, 2])])
+
+
+def test_bucket_quantile_over_merged_snapshots():
+    """The fleet p95 story end to end: two ranks' latency histograms merge
+    exactly, and the quantile of the merge sits where the combined
+    distribution puts it (inside the bucket holding the target rank)."""
+    a = _observed([0.05] * 90)   # fast rank
+    b = _observed([3.0] * 10)    # slow rank
+    edges, merged = merge_histogram_counts(
+        [(BUCKETS, a["counts"]), (BUCKETS, b["counts"])])
+    p50 = bucket_quantile(edges, merged, 0.5)
+    p95 = bucket_quantile(edges, merged, 0.95)
+    assert p50 <= 0.1           # median in the fast bucket
+    assert 1.0 < p95 <= 5.0     # p95 lands in the slow rank's bucket
+
+
+# -- prometheus text parse + federate -----------------------------------------
+
+def _rank_registry(steps, latency):
+    reg = MetricsRegistry()
+    reg.counter("hbnlp_train_steps_total", "steps").inc(steps)
+    reg.gauge("hbnlp_mfu", "mfu").set(steps / 100.0)
+    h = reg.histogram("hbnlp_metric_drain_seconds", "drain",
+                      buckets=BUCKETS)
+    h.observe(latency)
+    return reg
+
+
+def test_parse_prom_text_roundtrip_with_labels_and_escapes():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "help text", labelnames=("path",))
+    c.labels(path='we"ird\npath\\x').inc(3)
+    fams = fleet.parse_prom_text(reg.render())
+    (labels, value), = fams["c_total"].samples
+    assert labels == {"path": 'we"ird\npath\\x'} and value == 3.0
+    assert fams["c_total"].kind == "counter"
+    assert fams["c_total"].help == "help text"
+
+
+def test_parse_prom_text_unescape_is_single_pass():
+    """Code-review regression: a literal backslash followed by 'n' (e.g. a
+    Windows-ish path label) must round-trip — sequential .replace-based
+    unescaping would turn the escaped pair into a real newline."""
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "h", labelnames=("p",))
+    c.labels(p="a\\nb").inc(1)  # backslash + 'n', NOT a newline
+    (labels, _), = fleet.parse_prom_text(reg.render())["c_total"].samples
+    assert labels == {"p": "a\\nb"}
+
+
+def test_federate_tolerates_nan_samples():
+    """Code-review regression: a rank whose callback gauge failed renders
+    'NaN' — one bad sample must not crash the whole federation render."""
+    errors = []
+    out = fleet.federate(
+        {0: "# TYPE g gauge\ng 5\n", 1: "# TYPE g gauge\ng NaN\n"},
+        errors=errors)
+    assert not errors
+    assert 'g{rank="0"} 5' in out and 'g{rank="1"} NaN' in out
+    # the NaN renders per-rank but is excluded from the aggregates
+    assert 'g{agg="max",rank="fleet"} 5' in out
+    assert 'g{agg="mean",rank="fleet"} 5' in out
+
+
+def test_parse_prom_text_reconstructs_histograms():
+    reg = _rank_registry(5, 0.3)
+    fams = fleet.parse_prom_text(reg.render())
+    (labels, edges, counts, hsum, hcount), = \
+        fams["hbnlp_metric_drain_seconds"].snapshots()
+    assert labels == {} and edges == BUCKETS
+    assert counts == [0.0, 1.0, 0.0, 0.0, 0.0]  # 0.3 in the (0.1, 0.5] bin
+    assert hsum == pytest.approx(0.3) and hcount == 1
+
+
+def test_federate_rank_labels_and_aggregates():
+    texts = {0: _rank_registry(10, 0.05).render(),
+             1: _rank_registry(30, 3.0).render()}
+    errors = []
+    out = fleet.federate(texts, errors=errors)
+    assert not errors
+    # per-rank series, rank-labeled
+    assert 'hbnlp_train_steps_total{rank="0"} 10' in out
+    assert 'hbnlp_train_steps_total{rank="1"} 30' in out
+    # counters sum into the fleet aggregate
+    assert 'hbnlp_train_steps_total{rank="fleet"} 40' in out
+    # gauges aggregate min/mean/max
+    assert 'hbnlp_mfu{agg="min",rank="fleet"} 0.1' in out
+    assert 'hbnlp_mfu{agg="mean",rank="fleet"} 0.2' in out
+    assert 'hbnlp_mfu{agg="max",rank="fleet"} 0.3' in out
+    # histograms merge exactly: fleet count = 2, both observations binned
+    assert ('hbnlp_metric_drain_seconds_count{rank="fleet"} 2' in out)
+    fams = fleet.parse_prom_text(out)
+    snaps = {tuple(sorted(lab.items())): counts for lab, _, counts, _, _
+             in fams["hbnlp_metric_drain_seconds"].snapshots()}
+    assert snaps[(("rank", "fleet"),)] == [1.0, 0.0, 0.0, 1.0, 0.0]
+
+
+def test_federate_rejects_mismatched_bucket_edges_loudly():
+    reg_a = _rank_registry(1, 0.2)
+    reg_b = MetricsRegistry()
+    reg_b.histogram("hbnlp_metric_drain_seconds", "drain",
+                    buckets=(1.0, 2.0)).observe(0.5)
+    errors = []
+    out = fleet.federate({0: reg_a.render(), 1: reg_b.render()},
+                         errors=errors)
+    assert errors and "edges differ" in errors[0]
+    # per-rank series survive; the aggregate is refused and counted
+    assert 'hbnlp_metric_drain_seconds_count{rank="0"} 1' in out
+    assert 'hbnlp_metric_drain_seconds_count{rank="1"} 1' in out
+    assert 'rank="fleet"' not in \
+        [l for l in out.splitlines()
+         if l.startswith("hbnlp_metric_drain_seconds")][-1]
+    assert "hbnlp_fleet_merge_errors 1" in out
+
+
+def test_federate_merge_errors_gauge_always_present():
+    """Code-review regression: the merge-error figure is recomputed per
+    render, so it must be a gauge and present even at 0 — a vanishing
+    'counter' would read as a counter reset and an absent-when-clean
+    series can never arm an alert from baseline."""
+    out = fleet.federate({0: _rank_registry(1, 0.2).render()})
+    assert "# TYPE hbnlp_fleet_merge_errors gauge" in out
+    assert "hbnlp_fleet_merge_errors 0" in out
+
+
+def test_federate_kind_conflict_refuses_aggregate():
+    reg_a = MetricsRegistry()
+    reg_a.counter("x_total", "a").inc(2)
+    reg_b = MetricsRegistry()
+    reg_b.gauge("x_total", "b").set(5)
+    errors = []
+    out = fleet.federate({0: reg_a.render(), 1: reg_b.render()},
+                         errors=errors)
+    assert errors and "TYPE differs" in errors[0]
+    assert 'x_total{rank="0"} 2' in out and 'x_total{rank="1"} 5' in out
+    assert 'rank="fleet"' not in out.split("hbnlp_fleet", 1)[0]
+
+
+def test_federate_passes_through_pre_rank_labeled_series():
+    """The supervisor's own series already carry rank labels (satellite
+    fix): federation must not double-label or duplicate them, and the
+    aggregate sees each rank once."""
+    reg0 = MetricsRegistry()
+    reg0.counter("s_total", "s", labelnames=("rank",)).labels(rank=0).inc(1)
+    reg1 = MetricsRegistry()
+    reg1.counter("s_total", "s", labelnames=("rank",)).labels(rank=1).inc(2)
+    out = fleet.federate({0: reg0.render(), 1: reg1.render()})
+    lines = [l for l in out.splitlines() if l.startswith("s_total{")]
+    assert lines == ['s_total{rank="0"} 1', 's_total{rank="1"} 2',
+                     's_total{rank="fleet"} 3']
+
+
+# -- step posts + straggler attribution ---------------------------------------
+
+def _post(fleet_dir, rank, rows, gen=None):
+    d = fleet.obs_dir(fleet_dir)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"steps_r{rank}.jsonl"), "a") as f:
+        for step, wall in rows:
+            doc = {"step": step, "wall": wall}
+            if gen is not None:
+                doc["gen"] = gen
+            f.write(json.dumps(doc) + "\n")
+
+
+def test_read_step_posts_dedups_and_tolerates_torn_lines(tmp_path):
+    _post(str(tmp_path), 0, [(0, 10.0), (1, 11.0)])
+    # relaunch re-dispatches step 1 (restore point): newest post wins
+    _post(str(tmp_path), 0, [(1, 99.0)], gen=1)
+    with open(os.path.join(fleet.obs_dir(str(tmp_path)),
+                           "steps_r0.jsonl"), "a") as f:
+        f.write('{"step": 2, "wa')  # torn tail of a live writer
+    posts = fleet.read_step_posts(str(tmp_path))
+    assert posts == {0: {0: {"wall": 10.0, "gen": None},
+                         1: {"wall": 99.0, "gen": 1}}}
+
+
+def test_straggler_report_attribution(tmp_path):
+    """Rank 1 dispatches 100ms late every step: skew ~100ms, rank 1 is the
+    straggler, and rank 0 carries the barrier-wait (the seconds it would
+    idle at a per-step barrier waiting for rank 1)."""
+    base = 1000.0
+    _post(str(tmp_path), 0, [(s, base + s) for s in range(5)])
+    _post(str(tmp_path), 1, [(s, base + s + 0.1) for s in range(5)])
+    rep = fleet.straggler_report(fleet.read_step_posts(str(tmp_path)))
+    assert rep["n_common_steps"] == 5
+    assert rep["straggler_rank"] == 1
+    assert rep["skew_ms"]["mean"] == pytest.approx(100.0, abs=1e-6)
+    assert rep["skew_ms"]["max"] == pytest.approx(100.0, abs=1e-6)
+    r0, r1 = rep["ranks"]["0"], rep["ranks"]["1"]
+    assert r0["barrier_wait_s"] == pytest.approx(0.5, abs=1e-6)
+    assert r1["barrier_wait_s"] == 0.0
+    assert r1["straggler_score_ms"] > r0["straggler_score_ms"]
+    assert r0["mean_step_s"] == pytest.approx(1.0)
+    # the EMA converges toward the true 100ms lag
+    assert 60.0 < r1["straggler_score_ms"] <= 100.0
+
+
+def test_straggler_report_refuses_cross_generation_walls(tmp_path):
+    """Code-review regression: after an elastic relaunch, rank 0
+    re-dispatches steps 2-3 (post-outage walls, generation 1) that rank 1
+    only ran before the crash (generation 0).  Comparing those walls would
+    report the whole outage as skew — they must be excluded, while the
+    generation-matched steps still attribute."""
+    base = 1000.0
+    outage = 300.0  # seconds between crash and relaunch
+    _post(str(tmp_path), 1, [(s, base + s) for s in range(4)], gen=0)
+    _post(str(tmp_path), 0, [(s, base + s) for s in range(2)], gen=0)
+    # rank 0 restored to step 2 and re-posts 2..3 after the outage
+    _post(str(tmp_path), 0, [(s, base + outage + s) for s in (2, 3)],
+          gen=1)
+    rep = fleet.straggler_report(fleet.read_step_posts(str(tmp_path)))
+    assert rep["n_common_steps"] == 2      # steps 0-1 (both gen 0)
+    assert rep["n_generation_skipped"] == 2  # steps 2-3 (gen 1 vs gen 0)
+    # the outage never shows up as skew
+    assert rep["skew_ms"]["max"] < 1.0, rep["skew_ms"]
+
+
+def test_straggler_report_single_rank_and_disjoint_steps(tmp_path):
+    _post(str(tmp_path), 0, [(0, 1.0)])
+    rep = fleet.straggler_report(fleet.read_step_posts(str(tmp_path)))
+    assert rep["skew_ms"] is None and rep["straggler_rank"] is None
+    _post(str(tmp_path), 1, [(7, 2.0)])  # no step in common
+    rep = fleet.straggler_report(fleet.read_step_posts(str(tmp_path)))
+    assert rep["n_common_steps"] == 0 and rep["skew_ms"] is None
+
+
+# -- clock offsets + merged trace ---------------------------------------------
+
+def _trace_with_barriers(wall_epoch, barrier_ends, extra_span=None):
+    """A minimal Chrome trace: dist/barrier spans ending (relative to
+    wall_epoch) at the given seconds, each 10ms long."""
+    events = []
+    for i, end in enumerate(barrier_ends):
+        events.append({"ph": "X", "name": fleet.BARRIER_SPAN,
+                       "cat": "host", "ts": (end - 0.010) * 1e6,
+                       "dur": 0.010 * 1e6, "pid": 1, "tid": 1,
+                       "args": {"barrier": f"b{i}"}})
+    if extra_span:
+        events.append(extra_span)
+    return {"traceEvents": events,
+            "otherData": {"wall_epoch": wall_epoch}}
+
+
+def test_estimate_offsets_recovers_known_clock_shift():
+    """Rank 1's wall clock runs 2.5s AHEAD: at the same true barrier-exit
+    instant its wall reads 2.5s more, so the estimated offset (seconds to
+    ADD to rank 1 to land on rank 0's timebase) must recover -2.5s within
+    the documented residual bound."""
+    true_ends = [1.0, 2.0, 3.0]
+    shift = 2.5
+    jitter = [0.0, 0.0004, -0.0004]  # barrier release skew
+    t0 = _trace_with_barriers(100.0, true_ends)
+    # same relative ends, epoch shifted: every wall timestamp reads +2.5s
+    t1 = _trace_with_barriers(
+        100.0 + shift, [e + j for e, j in zip(true_ends, jitter)])
+    off = fleet.estimate_offsets({0: t0, 1: t1})
+    assert off["base_rank"] == 0 and off["n_pairs"] == 3
+    assert off["offsets_s"]["1"] == pytest.approx(-shift, abs=1e-3)
+    assert off["bound_s"] <= 0.001  # residual = the injected jitter
+    # merged: barrier ends align across lanes within the bound
+    merged = fleet.merge_traces({0: t0, 1: t1}, off)
+    ends = {}
+    for e in merged["traceEvents"]:
+        if e.get("ph") == "X" and e["name"] == fleet.BARRIER_SPAN:
+            ends.setdefault(e["args"]["barrier"], {})[e["pid"]] = \
+                (e["ts"] + e["dur"]) / 1e6
+    for b, per_rank in ends.items():
+        assert abs(per_rank[0] - per_rank[1]) <= off["bound_s"] + 1e-6, \
+            (b, per_rank)
+
+
+def test_estimate_offsets_nulls_bound_when_a_lane_has_no_pairs():
+    """Code-review regression: rank 2's trace lost its barrier spans —
+    its lane aligns on raw wall clock, so the merge must NOT advertise
+    the other ranks' tight residual as the whole-trace bound."""
+    t0 = _trace_with_barriers(100.0, [1.0, 2.0])
+    t1 = _trace_with_barriers(100.2, [1.0, 2.0])
+    t2 = _trace_with_barriers(107.0, [])  # no barrier spans survived
+    off = fleet.estimate_offsets({0: t0, 1: t1, 2: t2})
+    assert off["n_pairs"] == 2 and off["ranks_without_pairs"] == [2]
+    assert off["bound_s"] is None  # no alignment promise for lane 2
+    assert off["offsets_s"]["1"] == pytest.approx(-0.2, abs=1e-6)
+
+
+def test_estimate_offsets_skips_base_candidate_without_spans():
+    """Code-review regression: rank 0's trace lost its barrier spans while
+    ranks 1 and 2 both have them — the base must move to rank 1 (so the
+    1<->2 pairing still happens and the mixed fleet is visible), not
+    silently zero every pairing against a span-less rank 0."""
+    t0 = _trace_with_barriers(100.0, [])
+    t1 = _trace_with_barriers(200.0, [1.0, 2.0])
+    t2 = _trace_with_barriers(200.3, [1.0, 2.0])
+    off = fleet.estimate_offsets({0: t0, 1: t1, 2: t2})
+    assert off["base_rank"] == 1
+    assert off["n_pairs"] == 2 and off["ranks_without_pairs"] == [0]
+    assert off["offsets_s"]["2"] == pytest.approx(-0.3, abs=1e-6)
+    assert off["bound_s"] is None  # lane 0 is unaligned: no promise
+    # ...and graftfleet --check treats it as a mixed fleet
+    s = {"metrics_ranks": [0, 1, 2], "merge_errors": [],
+         "straggler": {"n_common_steps": 3}, "trace_ranks": [0, 1, 2],
+         "clock_offsets": off}
+    failed = graftfleet.run_check(s)
+    assert failed and "NOT aligned" in failed[0]
+
+
+def test_merge_traces_without_barriers_falls_back_to_wall_clock():
+    t0 = _trace_with_barriers(50.0, [], extra_span={
+        "ph": "X", "name": "step", "ts": 0.0, "dur": 1e4, "pid": 9,
+        "tid": 1})
+    t1 = _trace_with_barriers(51.0, [], extra_span={
+        "ph": "X", "name": "step", "ts": 0.0, "dur": 1e4, "pid": 9,
+        "tid": 1})
+    off = fleet.estimate_offsets({0: t0, 1: t1})
+    assert off["n_pairs"] == 0 and off["bound_s"] is None
+    merged = fleet.merge_traces({0: t0, 1: t1}, off)
+    lanes = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+    assert lanes == {0, 1}  # one lane per rank, pids rewritten
+    # rank 1's identical relative span sits 1s later on the merged axis
+    spans = {e["pid"]: e["ts"] for e in merged["traceEvents"]
+             if e.get("ph") == "X" and e["name"] == "step"}
+    assert spans[1] - spans[0] == pytest.approx(1e6, rel=1e-6)
+
+
+def test_span_tracer_traces_merge_with_real_exports(tmp_path):
+    """End to end over REAL SpanTracer exports: two tracers with barrier
+    spans recorded at matching true instants merge into two aligned
+    lanes."""
+    tracers = {}
+    for r in (0, 1):
+        t = SpanTracer(mirror_jax=False)
+        with t.span(fleet.BARRIER_SPAN, barrier="sync0"):
+            time.sleep(0.002)
+        with t.span("step", update=0):
+            time.sleep(0.001)
+        t.export(str(tmp_path / f"trace_r{r}.json"))
+        tracers[r] = t
+    d = fleet.obs_dir(str(tmp_path / "fleet"))
+    os.makedirs(d)
+    for r in (0, 1):
+        os.replace(str(tmp_path / f"trace_r{r}.json"),
+                   os.path.join(d, f"trace_r{r}.json"))
+    traces = fleet.read_traces(str(tmp_path / "fleet"))
+    assert sorted(traces) == [0, 1]
+    merged = fleet.merge_traces(traces)
+    names = {e["name"] for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    assert {fleet.BARRIER_SPAN, "step"} <= names
+
+
+# -- FleetReporter ------------------------------------------------------------
+
+def test_fleet_reporter_posts_steps_and_throttles_prom(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("c_total", "c").inc(1)
+    clock = [100.0]
+    rep = fleet.FleetReporter(str(tmp_path), rank=3, world_size=4,
+                              registry=reg, min_render_s=2.0,
+                              clock=lambda: clock[0])
+    prom = os.path.join(fleet.obs_dir(str(tmp_path)), "metrics_r3.prom")
+    rep.step_completed(0, 100.0)
+    assert os.path.exists(prom)  # first render
+    first_mtime = os.path.getmtime(prom)
+    reg.counter("c_total", "c").inc(1)
+    clock[0] += 0.5
+    rep.step_completed(1, 100.5)  # inside the throttle window: no render
+    assert "c_total 1" in open(prom).read()
+    clock[0] += 2.0
+    rep.step_completed(2, 102.5)  # past the window: re-rendered
+    assert "c_total 2" in open(prom).read()
+    rep.close()
+    posts = fleet.read_step_posts(str(tmp_path))
+    assert {s: row["wall"] for s, row in posts[3].items()} == \
+        {0: 100.0, 1: 100.5, 2: 102.5}
+    assert first_mtime <= os.path.getmtime(prom)
+
+
+def test_fleet_reporter_survives_unwritable_dir(tmp_path, caplog):
+    """Posting is weather, not structure: a reporter pointed at an
+    unwritable fleet dir degrades to a logged miss, never an exception."""
+    bad = tmp_path / "nodir"
+    bad.write_text("a file where a directory should be")
+    rep = fleet.FleetReporter(str(bad), rank=0, world_size=2,
+                              registry=MetricsRegistry())
+    rep.step_completed(0, 1.0)  # must not raise
+    rep.render_prom()
+    rep.close()
+    assert rep.skew_summary()["ranks"] == {}
+
+
+# -- identity: /healthz block + run-start markers -----------------------------
+
+def test_identity_resolution_env_first(monkeypatch):
+    assert fleet.identity() == {"rank": 0, "world_size": 1,
+                                "coordinator": ""}
+    monkeypatch.setenv(fleet.ENV_FLEET_RANK, "2")
+    monkeypatch.setenv(fleet.ENV_FLEET_WORLD, "4")
+    monkeypatch.setenv(fleet.ENV_FLEET_GENERATION, "7")
+    ident = fleet.identity()
+    assert ident["rank"] == 2 and ident["world_size"] == 4
+    assert ident["generation"] == 7
+
+
+def test_healthz_carries_identity_block():
+    reg = MetricsRegistry()
+    server = start_server(0, registry=reg,
+                          identity={"rank": 1, "world_size": 2,
+                                    "coordinator": "h:1", "generation": 3})
+    try:
+        port = server.server_address[1]
+        _, body = _get(f"http://127.0.0.1:{port}/healthz")
+        snap = json.loads(body)
+        assert snap["identity"] == {"rank": 1, "world_size": 2,
+                                    "coordinator": "h:1", "generation": 3}
+    finally:
+        stop_server(server)
+
+
+def test_run_start_marker_carries_identity(tmp_path, monkeypatch,
+                                           eight_devices):
+    monkeypatch.setenv(fleet.ENV_FLEET_RANK, "1")
+    monkeypatch.setenv(fleet.ENV_FLEET_WORLD, "2")
+    monkeypatch.setenv(fleet.ENV_FLEET_GENERATION, "4")
+    cli.train(tiny_config(model_path=str(tmp_path)), _args(2))
+    rows = [json.loads(l)
+            for l in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    marker = rows[0]
+    assert marker["run_start"] is True
+    assert marker["rank"] == 1 and marker["world_size"] == 2
+    assert marker["generation"] == 4
+    # metric-row readers still skip the marker
+    from homebrewnlp_tpu.train.metrics import read_metric_rows
+    assert [r["step"] for r in read_metric_rows(str(tmp_path))] == [0, 1]
+
+
+# -- Obs wiring: the production posting path ----------------------------------
+
+def test_train_posts_fleet_obs_and_stays_parity(tmp_path, monkeypatch,
+                                                eight_devices):
+    """A single-rank training run with cfg.fleet_dir set posts steps, a
+    /metrics snapshot, and its span trace under <fleet_dir>/obs — through
+    the production Obs + AsyncMetricWriter wiring — while the loss
+    sequence stays bit-identical to fleet obs off."""
+    ref = tiny_config(model_path=str(tmp_path / "ref"))
+    cli.train(ref, _args(4))
+    fleet_dir = str(tmp_path / "fleet")
+    cfg = tiny_config(model_path=str(tmp_path / "run"), obs_spans=True,
+                      fleet_dir=fleet_dir)
+    cli.train(cfg, _args(4))
+    d = fleet.obs_dir(fleet_dir)
+    assert sorted(os.listdir(d)) == ["metrics_r0.prom", "steps_r0.jsonl",
+                                     "trace_r0.json"]
+    posts = fleet.read_step_posts(fleet_dir)
+    assert sorted(posts[0]) == [0, 1, 2, 3]
+    assert "hbnlp_train_steps_total" in \
+        open(os.path.join(d, "metrics_r0.prom")).read()
+    trace = json.load(open(os.path.join(d, "trace_r0.json")))
+    assert {e["name"] for e in trace["traceEvents"]
+            if e.get("ph") == "X"} >= {"step", "feed"}
+    from homebrewnlp_tpu.train.metrics import read_metric_rows
+    ref_losses = [r["loss"] for r in read_metric_rows(str(tmp_path / "ref"))]
+    got_losses = [r["loss"] for r in read_metric_rows(str(tmp_path / "run"))]
+    assert ref_losses == got_losses
+
+
+# -- supervisor: rank labels + fleet posting + federation serving -------------
+
+def test_supervisor_series_carry_rank_label(tmp_path):
+    prom = tmp_path / "sup.prom"
+    sup = supervise.Supervisor(
+        lambda: 0, lambda: 1, registry=supervise.MetricsRegistry(),
+        metrics_path=str(prom), rank=2)
+    assert sup.run() == 0
+    text = prom.read_text()
+    assert 'hbnlp_supervisor_exits_total{outcome="clean",rank="2"} 1' in text
+    assert 'hbnlp_supervisor_goodput{rank="2"}' in text
+    assert 'hbnlp_supervisor_wall_seconds{rank="2"}' in text
+
+
+def test_supervisor_posts_rank_prom_to_fleet_dir(tmp_path):
+    """Satellite: supervisors sharing a fleet dir render per-rank files
+    whose series are rank-labeled — no more collisions."""
+    fdir = str(tmp_path / "fleet")
+    outcomes = {0: iter([supervise.EXIT_PEER_LOST, 0]), 1: iter([0])}
+    sups = {}
+    for r in (0, 1):
+        f = supervise.FleetCoordinator(fdir, r, 2, peer_timeout_s=5,
+                                       poll_s=0.02)
+        sups[r] = supervise.Supervisor(
+            lambda r=r: next(outcomes[r]), lambda: 1,
+            registry=supervise.MetricsRegistry(),
+            metrics_path=str(tmp_path / f"host{r}" / "sup.prom"),
+            fleet=f, rank=r, backoff_jitter=0.0, sleep=lambda s: None)
+    import threading
+    ts = [threading.Thread(target=sups[r].run) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    d = fleet.obs_dir(fdir)
+    assert {"supervisor_r0.prom", "supervisor_r1.prom"} <= \
+        set(os.listdir(d))
+    t0 = open(os.path.join(d, "supervisor_r0.prom")).read()
+    t1 = open(os.path.join(d, "supervisor_r1.prom")).read()
+    assert ('hbnlp_supervisor_exits_total{outcome="peer_lost",rank="0"} 1'
+            in t0)
+    assert 'rank="1"' in t1 and 'rank="0"' not in t1
+    # and the two files federate into distinct + aggregate series
+    out = fleet.federate({0: t0, 1: t1})
+    assert 'hbnlp_supervisor_exits_total{outcome="clean",rank="fleet"} 2' \
+        in out
+
+
+def test_federation_server_endpoints(tmp_path):
+    fdir = str(tmp_path)
+    t0 = time.time()  # fresh walls: ancient posts now read as stale
+    _post(fdir, 0, [(0, t0), (1, t0 + 1.0)])
+    _post(fdir, 1, [(0, t0 + 0.05), (1, t0 + 1.08)])
+    d = fleet.obs_dir(fdir)
+    for r in (0, 1):
+        with open(os.path.join(d, f"metrics_r{r}.prom"), "w") as f:
+            f.write(_rank_registry(5 * (r + 1), 0.2).render())
+    fed = fleet.FleetFederation(fdir, world_size=2,
+                                identity_doc={"rank": 0, "world_size": 2})
+    server = fleet.serve_federation(0, fed)
+    try:
+        port = server.server_address[1]
+        _, body = _get(f"http://127.0.0.1:{port}/metrics")
+        text = body.decode()
+        assert 'hbnlp_train_steps_total{rank="fleet"} 15' in text
+        assert "hbnlp_fleet_step_skew_ms" in text
+        assert 'hbnlp_fleet_barrier_wait_seconds{rank="0"}' in text
+        assert "hbnlp_fleet_straggler_rank 1" in text
+        status, body = _get(f"http://127.0.0.1:{port}/healthz")
+        snap = json.loads(body)
+        assert status == 200 and snap["status"] == "ok"
+        assert snap["identity"]["world_size"] == 2
+        assert snap["straggler"]["n_common_steps"] == 2
+        assert snap["ranks"]["1"]["last_step"] == 1
+    finally:
+        fleet.stop_federation(server)
+
+
+def test_federation_healthz_flags_silently_dead_rank_stale(tmp_path):
+    """Code-review regression: a host that died WITHOUT any exit posting
+    leaves its files behind — file existence alone must not read as a
+    healthy fleet forever.  A rank whose newest step post exceeds
+    stale_after_s flags stale and degrades the status."""
+    now = time.time()
+    _post(str(tmp_path), 0, [(0, now - 1.0)])          # fresh
+    _post(str(tmp_path), 1, [(0, now - 3600.0)])       # died an hour ago
+    fed = fleet.FleetFederation(str(tmp_path), world_size=2,
+                                stale_after_s=600.0)
+    snap = fed.snapshot()
+    assert snap["status"] == "degraded"
+    assert snap["ranks"]["1"]["stale"] is True
+    assert snap["ranks"]["0"]["stale"] is False
+    # both fresh: ok again
+    _post(str(tmp_path), 1, [(1, now)])
+    assert fed.snapshot()["status"] == "ok"
+
+
+def test_two_rank_mixed_barrier_spans_fails_check():
+    """Code-review regression: with exactly two ranks, one lane carrying
+    barrier spans and the other having lost them yields zero PAIRS — pair
+    counts alone cannot distinguish this mixed merge from the legitimate
+    no-barriers supervision-only fleet, so the span census must."""
+    t0 = _trace_with_barriers(100.0, [1.0, 2.0])
+    t1 = _trace_with_barriers(100.5, [])
+    off = fleet.estimate_offsets({0: t0, 1: t1})
+    assert off["ranks_with_spans"] == [0] and off["n_pairs"] == 0
+    s = {"metrics_ranks": [0, 1], "merge_errors": [],
+         "straggler": {"n_common_steps": 2}, "trace_ranks": [0, 1],
+         "clock_offsets": off}
+    failed = graftfleet.run_check(s)
+    assert failed and "NOT aligned" in failed[0]
+    # both span-less (supervision-only drill): legitimately green
+    off2 = fleet.estimate_offsets({0: _trace_with_barriers(1.0, []),
+                                   1: _trace_with_barriers(2.0, [])})
+    assert off2["ranks_with_spans"] == []
+    s["clock_offsets"] = off2
+    assert graftfleet.run_check(s) == []
+
+
+def test_launcher_extra_env_is_per_launch(tmp_path):
+    """Code-review regression: the fleet generation reaches the child via
+    an explicit per-launch parameter, not by mutating the dict instance
+    the launcher captured at construction."""
+    marker = tmp_path / "gen.txt"
+    launcher = supervise.SubprocessLauncher(
+        [sys.executable, "-c",
+         "import os;open(r'%s','a').write("
+         "os.environ.get('HBNLP_FLEET_GENERATION','unset')+'\\n')"
+         % marker],
+        env=dict(os.environ))
+    assert launcher(extra_env={"HBNLP_FLEET_GENERATION": "5"}) == 0
+    assert launcher() == 0  # no extra env: the base env is untouched
+    assert marker.read_text().splitlines() == ["5", "unset"]
+
+
+def test_federation_healthz_dark_fleet_is_503(tmp_path):
+    fed = fleet.FleetFederation(str(tmp_path), world_size=2)
+    server = fleet.serve_federation(0, fed)
+    try:
+        port = server.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{port}/healthz")
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "empty"
+    finally:
+        fleet.stop_federation(server)
+
+
+# -- graftfleet CLI -----------------------------------------------------------
+
+def _fake_fleet_dir(tmp_path):
+    fdir = str(tmp_path / "fleet")
+    _post(fdir, 0, [(s, 100.0 + s) for s in range(4)])
+    _post(fdir, 1, [(s, 100.02 + s) for s in range(4)])
+    d = fleet.obs_dir(fdir)
+    for r in (0, 1):
+        with open(os.path.join(d, f"metrics_r{r}.prom"), "w") as f:
+            f.write(_rank_registry(4, 0.1).render())
+        # rank 1's wall clock runs 0.5s ahead: same true barrier exits,
+        # epoch shifted
+        t = _trace_with_barriers(100.0 + r * 0.5, [1.0, 2.0])
+        with open(os.path.join(d, f"trace_r{r}.json"), "w") as f:
+            json.dump(t, f)
+    return fdir
+
+
+def test_graftfleet_report_check_and_merged_trace(tmp_path, capsys):
+    fdir = _fake_fleet_dir(tmp_path)
+    merged_path = str(tmp_path / "merged.json")
+    rc = graftfleet.main([fdir, "--check", "--merged-trace", merged_path])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "straggler rank: 1" in out
+    assert "clock offsets vs rank 0" in out
+    merged = json.load(open(merged_path))
+    assert {e["pid"] for e in merged["traceEvents"]
+            if e.get("ph") == "X"} == {0, 1}
+    # rank 1's clock runs 0.5s ahead: the offset recovers -0.5s exactly
+    off = merged["otherData"]["clock_offsets"]
+    assert off["offsets_s"]["1"] == pytest.approx(-0.5, abs=1e-6)
+
+
+def test_graftfleet_check_fails_on_empty_or_single_rank(tmp_path, capsys):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert graftfleet.main([str(empty), "--check"]) == 1
+    err = capsys.readouterr().err
+    assert "CHECK FAILED" in err and "need >= 2" in err
+    assert graftfleet.main([str(tmp_path / "missing")]) == 2
+
+
+def test_graftfleet_json_output(tmp_path, capsys):
+    fdir = _fake_fleet_dir(tmp_path)
+    assert graftfleet.main([fdir, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["metrics_ranks"] == [0, 1]
+    assert doc["straggler"]["skew_ms"]["mean"] == pytest.approx(20.0,
+                                                               abs=0.5)
+
+
+def _multichip_round(tmp_path, name, row):
+    doc = {"n_devices": 8, "rc": 0, "ok": True,
+           "tail": "dryrun_multichip(8): mesh=... loss=5.5\n"
+                   f"dryrun_multichip(8) fleet_obs: {json.dumps(row)}\n"}
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_graftfleet_compare_multichip_fleet_rows(tmp_path, capsys):
+    """Satellite: two MULTICHIP rounds' fleet rows diff in the same shape
+    as graftprof --compare (a -> b with deltas)."""
+    row_a = {"skew_ms": {"mean": 50.0, "p95": 51.0, "max": 52.0},
+             "barrier_wait_total_s": 0.30, "straggler_rank": 1,
+             "ranks": {"0": {"mean_step_s": 0.119, "barrier_wait_s": 0.30},
+                       "1": {"mean_step_s": 0.119, "barrier_wait_s": 0.0}}}
+    row_b = {"skew_ms": {"mean": 20.0, "p95": 21.0, "max": 22.0},
+             "barrier_wait_total_s": 0.12, "straggler_rank": 0,
+             "ranks": {"0": {"mean_step_s": 0.100, "barrier_wait_s": 0.0},
+                       "1": {"mean_step_s": 0.095, "barrier_wait_s": 0.12}}}
+    a = _multichip_round(tmp_path, "MULTICHIP_rA.json", row_a)
+    b = _multichip_round(tmp_path, "MULTICHIP_rB.json", row_b)
+    assert graftfleet.main(["--compare", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "skew mean ms: 50.000 -> 20.000 (-30.000)" in out
+    assert "barrier-wait total s: 0.300 -> 0.120 (-0.180)" in out
+    assert "straggler rank: 1 -> 0" in out
+    assert "-19.000" in out  # per-rank step-time delta (0.119 -> 0.100)
+    # a round without the row is a usage error, not a crash
+    legacy = tmp_path / "MULTICHIP_r00.json"
+    legacy.write_text(json.dumps({"n_devices": 8, "tail": "no row"}))
+    assert graftfleet.main(["--compare", a, str(legacy)]) == 2
+
+
+# -- watchdog diagnostics carry the fleet report ------------------------------
+
+def test_watchdog_dump_includes_fleet_straggler_report(tmp_path):
+    from homebrewnlp_tpu.obs import Health, Watchdog
+    fdir = str(tmp_path / "fleet")
+    _post(fdir, 0, [(0, 1.0), (1, 2.0)])
+    _post(fdir, 1, [(0, 1.3), (1, 2.3)])
+    rep = fleet.FleetReporter(fdir, rank=0, world_size=2)
+    health = Health(stall_factor=2.0, min_stall_s=0.05)
+    health.step_completed(0)
+    health.step_completed(1)
+    wd = Watchdog(health, str(tmp_path / "run"), factor=2.0, poll_s=0.05,
+                  min_stall_s=0.05, registry=MetricsRegistry(),
+                  extra_fn=rep.skew_summary)
+    wd.start()
+    try:
+        deadline = time.time() + 10
+        while not wd.dumps and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+        rep.close()
+    assert wd.dumps, "watchdog never fired"
+    text = open(wd.dumps[0]).read()
+    assert '"straggler_rank": 1' in text.replace("'", '"') or \
+        '"straggler_rank": 1' in text
+    assert "fleet:" in text
+
+
+# -- THE composed drill: two supervised processes with fleet obs --------------
+
+@pytest.mark.slow  # ~90s: two supervisors x two generations of children;
+# the CI fleet-obs job runs it explicitly
+def test_fleet_obs_two_supervised_processes(tmp_path, eight_devices):
+    """Acceptance drill (CI ``fleet-obs``): the PR-10 lockstep drill
+    (peer:die@step4 under two real per-host supervisors) now produces the
+    full fleet-observability surface — a federated /metrics with both
+    ranks labeled plus fleet aggregates, per-rank supervisor proms, a
+    populated skew report over the common steps, a two-lane merged trace,
+    and a green ``graftfleet --check``."""
+    steps = 10
+    fleet_dir = str(tmp_path / "fleet")
+    child = os.path.join(REPO, "tests", "elastic_child.py")
+    sup_py = os.path.join(REPO, "tools", "supervise.py")
+    procs = []
+    for r in range(2):
+        model = str(tmp_path / f"host{r}")
+        cmd = [sys.executable, sup_py, "--model-path", model,
+               "--rank", str(r), "--world-size", "2",
+               "--fleet-dir", fleet_dir, "--peer-timeout", "120",
+               "--backoff-jitter", "0", "--backoff-base", "0.1", "--",
+               sys.executable, child, "--model-path", model,
+               "--steps", str(steps), "--fault-plan", "peer:die@step4",
+               "--obs-spans"]
+        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, f"rank{r} supervisor rc={p.returncode}:\n" \
+                                  f"{outs[r][-3000:]}"
+    d = fleet.obs_dir(fleet_dir)
+    files = set(os.listdir(d))
+    assert {"steps_r0.jsonl", "steps_r1.jsonl", "metrics_r0.prom",
+            "metrics_r1.prom", "trace_r0.json", "trace_r1.json",
+            "supervisor_r0.prom", "supervisor_r1.prom"} <= files, files
+    # federated /metrics: both ranks labeled + fleet aggregates
+    fed = fleet.FleetFederation(fleet_dir, world_size=2)
+    errors = []
+    text = fleet.federate(fed.rank_texts(), errors=errors)
+    assert not errors, errors
+    for series in ('hbnlp_train_steps_total{rank="0"}',
+                   'hbnlp_train_steps_total{rank="1"}',
+                   'hbnlp_train_steps_total{rank="fleet"}'):
+        assert series in text, series
+    # run-start markers carry per-rank identity + the relaunch generation
+    for r in range(2):
+        markers = [json.loads(l) for l in
+                   (tmp_path / f"host{r}" / "metrics.jsonl")
+                   .read_text().splitlines() if '"run_start"' in l]
+        assert markers and all(m["rank"] == r and m["world_size"] == 2
+                               for m in markers), markers
+        assert markers[-1]["generation"] >= 1  # the lockstep relaunch
+    # skew report populated over the generation-matched steps (a rank
+    # SIGTERMed a step later than its peer re-posts one step fewer in
+    # generation 1, so a small generation-skipped tail is legitimate)
+    report = fleet.straggler_report(fleet.read_step_posts(fleet_dir))
+    assert (report["n_common_steps"]
+            + report["n_generation_skipped"]) == steps, report
+    assert report["n_common_steps"] >= steps - 4, report
+    assert report["skew_ms"] is not None
+    # merged trace: two lanes (no cross-rank barriers in this drill — the
+    # offset bound comes from the fleet_obs dryrun, which has them)
+    merged = fleet.merge_traces(fleet.read_traces(fleet_dir))
+    lanes = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+    assert lanes == {0, 1}, lanes
+    # graftfleet --check gates green on this dir
+    assert graftfleet.main([fleet_dir, "--check"]) == 0
+    # fleet healthz sees both ranks
+    snap = fleet.FleetFederation(fleet_dir, world_size=2).snapshot()
+    assert snap["status"] == "ok" and set(snap["ranks"]) == {"0", "1"}

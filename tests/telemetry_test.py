@@ -368,11 +368,14 @@ def test_supervisor_goodput_accounting(tmp_path):
     # wall 32s (3 launches + 2s backoff), productive 20s (launches 1 and 3)
     assert sup.goodput() == pytest.approx(20.0 / 32.0)
     text = prom.read_text()
+    # every supervisor series carries the host's rank label (fleet-obs
+    # satellite: N supervisors sharing a fleet dir must not collide)
     assert "hbnlp_supervisor_goodput" in text
-    assert "hbnlp_supervisor_productive_seconds 20" in text
-    assert 'hbnlp_supervisor_exits_total{outcome="preemption"} 1' in text
-    assert 'hbnlp_supervisor_exits_total{outcome="crash"} 1' in text
-    assert 'hbnlp_supervisor_exits_total{outcome="clean"} 1' in text
+    assert 'hbnlp_supervisor_productive_seconds{rank="0"} 20' in text
+    assert ('hbnlp_supervisor_exits_total{outcome="preemption",rank="0"} 1'
+            in text)
+    assert 'hbnlp_supervisor_exits_total{outcome="crash",rank="0"} 1' in text
+    assert 'hbnlp_supervisor_exits_total{outcome="clean",rank="0"} 1' in text
 
 
 def test_supervisor_anomaly_halt_outcome_and_backoff(tmp_path):
@@ -390,7 +393,7 @@ def test_supervisor_anomaly_halt_outcome_and_backoff(tmp_path):
         sleep=sleeps.append, backoff_base_s=3.0, backoff_jitter=0.0)
     assert sup.run() == 0
     assert sleeps == [3.0]  # halt backs off like a crash
-    assert sup._exits.value(outcome="anomaly_halt") == 1
+    assert sup._exits.value(outcome="anomaly_halt", rank="0") == 1
 
 
 def test_exit_code_contract_includes_anomaly_halt():

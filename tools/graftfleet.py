@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""graftfleet CLI: fleet-level observability over a shared fleet dir.
+
+Renders the cross-rank view of a multi-host run from the ``<fleet_dir>/obs``
+postings (docs/observability.md "Fleet observability"): which ranks are
+reporting, the federated metrics summary, per-step dispatch skew, the EMA
+straggler score per rank, and the barrier-wait decomposition — plus the
+merged multi-lane Chrome trace with clock offsets estimated from
+``dist/barrier`` span pairs.
+
+Sources:
+
+- a fleet directory (the ``--fleet-dir`` the per-host supervisors share);
+- two committed ``MULTICHIP_r*.json`` rounds via ``--compare`` — the
+  ``fleet_obs`` row (emitted by ``__graft_entry__.py``'s two-process drill)
+  diffs round over round the same way ``graftprof --compare`` diffs
+  profile captures.
+
+Examples::
+
+    python tools/graftfleet.py /shared/fleet
+    python tools/graftfleet.py /shared/fleet --check
+    python tools/graftfleet.py /shared/fleet --merged-trace merged.json
+    python tools/graftfleet.py --compare MULTICHIP_r05.json MULTICHIP_r06.json
+
+Exit codes: 0 ok; 1 a ``--check`` gate failed; 2 usage / unreadable source.
+
+Like tools/supervise.py, this never imports the ``homebrewnlp_tpu`` package
+(which pulls jax): fleet visibility must work on a host whose accelerator
+toolchain is exactly what broke.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import typing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_light(name: str, relpath: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+fleet = _load_light("hbnlp_obs_fleet_cli", "homebrewnlp_tpu/obs/fleet.py")
+
+FLEET_OBS_MARKER = "fleet_obs: "
+
+
+def load_fleet_obs_row(path: str) -> dict:
+    """The ``fleet_obs`` row of a committed MULTICHIP round: the JSON
+    payload after the ``fleet_obs: `` marker in the round's ``tail``."""
+    with open(path) as f:
+        doc = json.load(f)
+    tail = doc.get("tail", "") if isinstance(doc, dict) else ""
+    for line in tail.splitlines():
+        if FLEET_OBS_MARKER in line:
+            return json.loads(line.split(FLEET_OBS_MARKER, 1)[1])
+    raise ValueError(f"{path}: no '{FLEET_OBS_MARKER}' row in its tail — "
+                     "this round predates the fleet_obs drill")
+
+
+def fleet_summary(fleet_dir: str) -> typing.Tuple[dict, str, dict]:
+    """One read of the fleet dir for every output path: the summary doc,
+    the federated text, and the raw traces (report / --check /
+    --merged-trace / --federated all reuse them — a network-mounted fleet
+    dir must not be re-parsed per flag, nor may two reads disagree)."""
+    posts = fleet.read_step_posts(fleet_dir)
+    report = fleet.straggler_report(posts)
+    errors: typing.List[str] = []
+    federation = fleet.FleetFederation(fleet_dir)
+    texts = federation.rank_texts()
+    # same composition as FleetFederation.render(): the --federated dump
+    # must carry the hbnlp_fleet_* attribution gauges the live supervisor
+    # endpoint serves, not a stripped-down exposition
+    federated = fleet.federate(texts, errors=errors) \
+        + federation.fleet_series(
+            report, n_reporting=len(set(texts) | set(posts)))
+    traces = fleet.read_traces(fleet_dir)
+    offsets = fleet.estimate_offsets(traces)
+    summary = {"fleet_dir": os.path.abspath(fleet_dir),
+               "metrics_ranks": sorted(texts),
+               "federated_series": sum(
+                   1 for line in federated.splitlines()
+                   if line and not line.startswith("#")),
+               "merge_errors": errors,
+               "trace_ranks": sorted(traces),
+               "clock_offsets": offsets,
+               "straggler": report}
+    return summary, federated, traces
+
+
+def render_report(s: dict) -> str:
+    rep = s["straggler"]
+    lines = [f"fleet dir: {s['fleet_dir']}",
+             f"metrics snapshots: ranks {s['metrics_ranks']} "
+             f"({s['federated_series']} federated series"
+             + (f", {len(s['merge_errors'])} merge error(s)"
+                if s["merge_errors"] else "") + ")",
+             f"traces: ranks {s['trace_ranks']}"]
+    off = s["clock_offsets"]
+    if off["n_pairs"] and off["bound_s"] is not None:
+        pretty = {r: f"{v * 1e3:+.3f}ms"
+                  for r, v in off["offsets_s"].items()}
+        lines.append(f"clock offsets vs rank {off['base_rank']}: {pretty} "
+                     f"(bound {off['bound_s'] * 1e3:.3f}ms over "
+                     f"{off['n_pairs']} barrier pair(s))")
+    elif off["n_pairs"]:
+        lines.append(f"clock offsets: rank(s) "
+                     f"{off['ranks_without_pairs']} recorded no matched "
+                     "dist/barrier spans — their lanes align on raw wall "
+                     "clocks, so NO alignment bound holds")
+    elif s["trace_ranks"]:
+        lines.append("clock offsets: no matched dist/barrier span pairs — "
+                     "lanes align on raw wall clocks (no bound)")
+    if rep["ranks"]:
+        lines.append("")
+        lines.append(f"{'rank':>4} {'steps':>6} {'last':>6} "
+                     f"{'step ms':>9} {'straggle ms':>12} "
+                     f"{'barrier-wait s':>15}")
+        for r, row in sorted(rep["ranks"].items(), key=lambda kv:
+                             int(kv[0])):
+            mean_ms = ("-" if row["mean_step_s"] is None
+                       else f"{row['mean_step_s'] * 1e3:.3f}")
+            lines.append(f"{r:>4} {row['steps']:>6} {row['last_step']:>6} "
+                         f"{mean_ms:>9} {row['straggler_score_ms']:>12.3f} "
+                         f"{row['barrier_wait_s']:>15.6f}")
+    skew = rep.get("skew_ms")
+    if skew:
+        lines.append("")
+        lines.append(
+            f"step skew ms over {rep['n_common_steps']} common step(s): "
+            f"mean {skew['mean']:.3f}  p95 {skew['p95']:.3f}  "
+            f"max {skew['max']:.3f}  last {skew['last']:.3f}")
+        lines.append(
+            f"straggler rank: {rep['straggler_rank']}  "
+            f"fleet barrier-wait total: {rep['barrier_wait_total_s']:.6f}s")
+    for e in s["merge_errors"]:
+        lines.append(f"MERGE ERROR: {e}")
+    return "\n".join(lines)
+
+
+def run_check(s: dict) -> typing.List[str]:
+    """The CI gate: a fleet dir that claims to host a fleet must actually
+    show one — >= 2 ranks' metrics, a populated skew report, traces that
+    merge, and no federation merge errors.
+
+    Alignment is gated only where it is CLAIMED: a fleet with no
+    ``dist/barrier`` spans at all (supervision-only drills never barrier)
+    merges on raw wall clocks, says so in the report, and passes — but a
+    MIXED fleet (some lanes with pairs, some without) fails, because the
+    merged file would silently carry one unaligned lane next to aligned
+    ones."""
+    failed = []
+    if len(s["metrics_ranks"]) < 2:
+        failed.append(f"only {len(s['metrics_ranks'])} rank(s) posted a "
+                      "metrics snapshot (need >= 2)")
+    if s["merge_errors"]:
+        failed.append(f"{len(s['merge_errors'])} federation merge error(s)")
+    if s["straggler"]["n_common_steps"] < 1:
+        failed.append("skew report empty: no step dispatched by every "
+                      "posting rank")
+    if len(s["trace_ranks"]) >= 2:
+        off = s["clock_offsets"]
+        spanless = sorted(set(s["trace_ranks"])
+                          - set(off.get("ranks_with_spans", [])))
+        if off.get("ranks_with_spans") and spanless:
+            failed.append(
+                f"rank(s) {spanless} recorded no dist/barrier spans while "
+                f"rank(s) {off['ranks_with_spans']} did — their merged "
+                "lanes are NOT aligned")
+        elif off["n_pairs"] and off["ranks_without_pairs"]:
+            failed.append(
+                f"rank(s) {off['ranks_without_pairs']} recorded no "
+                "matched dist/barrier spans while others did — their "
+                "merged lanes are NOT aligned")
+        elif off["n_pairs"] and off["bound_s"] > 1.0:
+            failed.append(f"clock-offset residual {off['bound_s']:.3f}s "
+                          "exceeds 1s — barrier ends disagree; traces "
+                          "cannot be trusted as aligned")
+    return failed
+
+
+def render_compare(a: dict, b: dict) -> str:
+    """Round-over-round fleet drift, graftprof --compare shape: a -> b
+    (+delta) for skew, barrier-wait, and per-rank step time."""
+    lines = []
+
+    def _num(doc, *path):
+        cur: typing.Any = doc
+        for k in path:
+            if not isinstance(cur, dict) or k not in cur:
+                return None
+            cur = cur[k]
+        return float(cur) if isinstance(cur, (int, float)) else None
+
+    for label, path in (("skew mean ms", ("skew_ms", "mean")),
+                        ("skew p95 ms", ("skew_ms", "p95")),
+                        ("skew max ms", ("skew_ms", "max")),
+                        ("barrier-wait total s",
+                         ("barrier_wait_total_s",))):
+        va, vb = _num(a, *path), _num(b, *path)
+        if va is None or vb is None:
+            lines.append(f"{label}: (absent in one round)")
+        else:
+            lines.append(f"{label}: {va:.3f} -> {vb:.3f} ({vb - va:+.3f})")
+    sa, sb = _num(a, "straggler_rank"), _num(b, "straggler_rank")
+    lines.append(f"straggler rank: {None if sa is None else int(sa)} -> "
+                 f"{None if sb is None else int(sb)}")
+    ranks = sorted(set(a.get("ranks", {})) | set(b.get("ranks", {})),
+                   key=int)
+    if ranks:
+        lines.append("")
+        lines.append(f"{'rank':>4} {'a step ms':>10} {'b step ms':>10} "
+                     f"{'delta':>9} {'a wait s':>9} {'b wait s':>9}")
+        for r in ranks:
+            ra = a.get("ranks", {}).get(r, {})
+            rb = b.get("ranks", {}).get(r, {})
+            ma = ra.get("mean_step_s")
+            mb = rb.get("mean_step_s")
+            d = ("-" if ma is None or mb is None
+                 else f"{(mb - ma) * 1e3:+.3f}")
+            lines.append(
+                f"{r:>4} "
+                f"{'-' if ma is None else format(ma * 1e3, '.3f'):>10} "
+                f"{'-' if mb is None else format(mb * 1e3, '.3f'):>10} "
+                f"{d:>9} "
+                f"{ra.get('barrier_wait_s', 0.0):>9.4f} "
+                f"{rb.get('barrier_wait_s', 0.0):>9.4f}")
+    return "\n".join(lines)
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="fleet observability over a shared fleet dir")
+    p.add_argument("fleet_dir", nargs="?", default="",
+                   help="the --fleet-dir the per-host supervisors share")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 unless the dir shows a healthy fleet "
+                        "(>= 2 ranks, populated skew report, merged "
+                        "traces, no federation errors)")
+    p.add_argument("--merged-trace", default="",
+                   help="write the merged multi-lane Chrome trace here")
+    p.add_argument("--federated", default="",
+                   help="write the federated Prometheus text here")
+    p.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                   help="two MULTICHIP_r*.json rounds: print the "
+                        "fleet_obs row drift (b - a)")
+    args = p.parse_args(argv)
+
+    if args.compare:
+        try:
+            a, b = (load_fleet_obs_row(x) for x in args.compare)
+        except (OSError, ValueError) as e:
+            print(f"graftfleet: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps({"a": a, "b": b}, indent=1, sort_keys=True)
+              if args.as_json else render_compare(a, b))
+        return 0
+
+    if not args.fleet_dir:
+        p.error("fleet_dir required (or use --compare A B)")
+    if not os.path.isdir(args.fleet_dir):
+        print(f"graftfleet: {args.fleet_dir} is not a directory",
+              file=sys.stderr)
+        return 2
+    s, federated, traces = fleet_summary(args.fleet_dir)
+    if args.merged_trace:
+        merged = fleet.merge_traces(traces, s["clock_offsets"])
+        with open(args.merged_trace, "w") as f:
+            json.dump(merged, f)
+        print(f"merged trace ({len(traces)} lane(s)) -> "
+              f"{args.merged_trace}", file=sys.stderr)
+    if args.federated:
+        with open(args.federated, "w") as f:
+            f.write(federated)
+        print(f"federated metrics -> {args.federated}", file=sys.stderr)
+    print(json.dumps(s, indent=1, sort_keys=True) if args.as_json
+          else render_report(s))
+    if args.check:
+        failed = run_check(s)
+        for msg in failed:
+            print(f"graftfleet: CHECK FAILED: {msg}", file=sys.stderr)
+        return 1 if failed else 0
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

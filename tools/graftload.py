@@ -46,7 +46,6 @@ import json
 import math
 import os
 import random
-import re
 import sys
 import threading
 import time
@@ -243,31 +242,31 @@ def client_report(records: typing.Sequence[dict],
 
 # -- Prometheus text parsing (the client's view of the server's histograms) --
 
-_SAMPLE_RE = re.compile(r"^([A-Za-z_:][A-Za-z0-9_:]*)"
-                        r"(?:\{(.*)\})?\s+(\S+)\s*$")
-_LABEL_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
-
 
 def parse_prom(text: str) -> typing.Dict[str, typing.List[tuple]]:
     """{metric sample name: [(labels dict, float value), ...]} from
-    Prometheus text exposition (0.0.4) — just enough parser for the
-    registry's own renderer; comments and malformed lines are skipped."""
+    Prometheus text exposition (0.0.4).
+
+    Thin raw-sample view over the ONE prom-text parser the repo maintains
+    (``obs.fleet.parse_prom_text`` — the fleet federation's): histogram
+    families flatten back to their ``_bucket``/``_sum``/``_count`` raw
+    names with cumulative bucket values, which is the shape
+    ``histogram_snapshot`` below has always consumed."""
+    from homebrewnlp_tpu.obs import fleet as fleet_obs
     out: typing.Dict[str, typing.List[tuple]] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        m = _SAMPLE_RE.match(line)
-        if not m:
-            continue
-        name, labels_s, value_s = m.groups()
-        try:
-            value = float(value_s)
-        except ValueError:
-            continue
-        labels = {k: v.replace(r"\"", '"').replace(r"\\", "\\")
-                  for k, v in _LABEL_RE.findall(labels_s or "")}
-        out.setdefault(name, []).append((labels, value))
+    for name, fam in fleet_obs.parse_prom_text(text).items():
+        if fam.samples:
+            out.setdefault(name, []).extend(fam.samples)
+        for slot in fam.hist.values():
+            labels = slot["labels"]
+            for le, cum in sorted(slot["le"].items()):
+                le_s = "+Inf" if le == math.inf else fleet_obs._fmt(le)
+                out.setdefault(name + "_bucket", []).append(
+                    (dict(labels, le=le_s), cum))
+            out.setdefault(name + "_sum", []).append(
+                (dict(labels), slot["sum"]))
+            out.setdefault(name + "_count", []).append(
+                (dict(labels), slot["count"]))
     return out
 
 

@@ -29,12 +29,17 @@ itself safe, so this closes the loop:
   shared outage.
 
 Counters flow through the obs registry
-(``hbnlp_supervisor_exits_total{outcome}``) along with cross-relaunch
-goodput (``hbnlp_supervisor_goodput`` = productive seconds / wall seconds,
-where only launch segments that advanced on-disk progress count as
-productive), rendered to ``<model_path>/supervisor_metrics.prom`` after
-every child exit and served live on ``--obs-port`` if given — so restarts
-land in the same dashboard as the child's MFU.  Exit-code contract + drill
+(``hbnlp_supervisor_exits_total{outcome,rank}`` — every supervisor series
+carries this host's rank, so fleets federate without collisions) along
+with cross-relaunch goodput (``hbnlp_supervisor_goodput`` = productive
+seconds / wall seconds, where only launch segments that advanced on-disk
+progress count as productive), rendered to
+``<model_path>/supervisor_metrics.prom`` (and, in a fleet,
+``<fleet_dir>/obs/supervisor_r<rank>.prom``) after every child exit and
+served live on ``--obs-port`` if given — in fleet mode that port serves
+the FEDERATED ``/metrics`` + fleet ``/healthz`` built from every rank's
+postings (docs/observability.md "Fleet observability") — so restarts land
+in the same dashboard as the child's MFU.  Exit-code contract + drill
 walkthrough: docs/reliability.md.
 
 Usage:
@@ -77,6 +82,11 @@ _registry = _load_light("hbnlp_obs_registry",
                         "homebrewnlp_tpu/obs/registry.py")
 MetricsRegistry = _registry.MetricsRegistry
 REGISTRY = _registry.REGISTRY
+# fleet observability (stdlib-only by contract, docs/observability.md
+# "Fleet observability"): federated /metrics + fleet /healthz over the
+# shared fleet dir, served by the SUPERVISOR so fleet visibility survives
+# exactly the child failures being supervised
+fleet_obs = _load_light("hbnlp_obs_fleet", "homebrewnlp_tpu/obs/fleet.py")
 
 # the exit-code contract with homebrewnlp_tpu.reliability (which cannot be
 # imported here without dragging in jax); pinned by a reliability_test
@@ -430,8 +440,14 @@ class SubprocessLauncher:
         self.env = env
         self._proc: typing.Optional[subprocess.Popen] = None
 
-    def __call__(self) -> int:
-        self._proc = subprocess.Popen(self.cmd, env=self.env)
+    def __call__(self, extra_env: typing.Optional[dict] = None) -> int:
+        """``extra_env``: per-launch additions (the fleet generation) —
+        an explicit parameter, so the caller never depends on mutating
+        the exact dict instance the constructor captured."""
+        env = self.env
+        if extra_env:
+            env = dict(env if env is not None else os.environ, **extra_env)
+        self._proc = subprocess.Popen(self.cmd, env=env)
         try:
             return self._proc.wait()
         finally:
@@ -472,9 +488,14 @@ class Supervisor:
                  rng: typing.Callable[[], float] = random.random,
                  fleet: typing.Optional[FleetCoordinator] = None,
                  terminate: typing.Optional[
-                     typing.Callable[[], None]] = None):
+                     typing.Callable[[], None]] = None,
+                 rank: int = 0):
         self.launch = launch
         self.progress = progress
+        # every supervisor series carries this host's rank: N supervisors
+        # sharing one fleet (or registry, or scrape target) must render N
+        # distinguishable series, not N colliding unlabeled ones
+        self.rank = int(rank)
         self.max_failures_no_progress = int(max_failures_no_progress)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
@@ -494,7 +515,7 @@ class Supervisor:
         self._exits = self.registry.counter(
             "hbnlp_supervisor_exits_total",
             "child exits seen by the supervisor, by outcome",
-            labelnames=("outcome",))
+            labelnames=("outcome", "rank"))
         # goodput across relaunches (the in-run figure lives on the child's
         # own /metrics): wall covers backoff sleeps and dead children;
         # productive covers only launch segments that ADVANCED on-disk
@@ -505,15 +526,18 @@ class Supervisor:
         self.registry.gauge(
             "hbnlp_supervisor_wall_seconds",
             "wall seconds since the supervisor started",
-            fn=lambda: self.clock() - self._t0)
+            labelnames=("rank",)).labels(rank=self.rank).set_function(
+            lambda: self.clock() - self._t0)
         self.registry.gauge(
             "hbnlp_supervisor_productive_seconds",
             "wall seconds inside launch segments that advanced on-disk "
-            "progress", fn=lambda: self._productive_s)
+            "progress", labelnames=("rank",)).labels(
+            rank=self.rank).set_function(lambda: self._productive_s)
         self.registry.gauge(
             "hbnlp_supervisor_goodput",
             "productive seconds / wall seconds across all relaunches",
-            fn=self.goodput)
+            labelnames=("rank",)).labels(rank=self.rank).set_function(
+            self.goodput)
         self.restarts = 0
 
     def goodput(self) -> float:
@@ -523,16 +547,35 @@ class Supervisor:
     def write_metrics(self) -> None:
         """Render the supervisor's registry to ``metrics_path`` (after every
         child exit and on return): restarts and goodput stay visible in the
-        same dashboard as the child's MFU even between scrapes."""
-        if not self.metrics_path:
-            return
-        try:
-            os.makedirs(os.path.dirname(self.metrics_path) or ".",
-                        exist_ok=True)
-            with open(self.metrics_path, "w") as f:
-                f.write(self.registry.render())
-        except OSError as e:
-            LOG.warning("could not persist supervisor metrics: %r", e)
+        same dashboard as the child's MFU even between scrapes.  In a fleet,
+        the same render also lands at
+        ``<fleet_dir>/obs/supervisor_r<rank>.prom`` — every series already
+        carries this host's ``rank`` label, so N supervisors sharing the
+        fleet dir render N distinct per-rank files that federate cleanly
+        instead of N colliding unlabeled ones."""
+        text = None
+        if self.metrics_path:
+            try:
+                os.makedirs(os.path.dirname(self.metrics_path) or ".",
+                            exist_ok=True)
+                text = self.registry.render()
+                with open(self.metrics_path, "w") as f:
+                    f.write(text)
+            except OSError as e:
+                LOG.warning("could not persist supervisor metrics: %r", e)
+        if self.fleet is not None:
+            try:
+                d = fleet_obs.obs_dir(self.fleet.dir)
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(d, f"supervisor_r{self.rank}.prom")
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    f.write(text if text is not None
+                            else self.registry.render())
+                os.replace(tmp, path)
+            except OSError as e:
+                LOG.warning("could not post supervisor metrics to the "
+                            "fleet dir: %r", e)
 
     def _on_peer_down(self, peer_rank: int) -> bool:
         """Returns True once a termination signal reached the live child
@@ -577,7 +620,7 @@ class Supervisor:
                 LOG.info("training completed cleanly at %s "
                          "(%d restart(s), goodput %.3f)", last,
                          self.restarts, self.goodput())
-                self._exits.labels(outcome="clean").inc()
+                self._exits.labels(outcome="clean", rank=self.rank).inc()
                 self.write_metrics()
                 if self.fleet is not None:
                     # post so peers never block on us, but do NOT hold the
@@ -596,7 +639,7 @@ class Supervisor:
                        "peer_lost" if peer_lost else
                        "anomaly_halt" if rc == EXIT_ANOMALY_HALT else
                        "crash")
-            self._exits.labels(outcome=outcome).inc()
+            self._exits.labels(outcome=outcome, rank=self.rank).inc()
             # render AFTER the outcome counter: the on-disk file must show
             # this exit during the (possibly long) next child lifetime
             self.write_metrics()
@@ -611,7 +654,8 @@ class Supervisor:
                         "progress (stuck at %s, last exit code %d); "
                         "aborting with %d", failures_no_progress, last, rc,
                         EXIT_CRASH_LOOP)
-                    self._exits.labels(outcome="crash_loop_abort").inc()
+                    self._exits.labels(outcome="crash_loop_abort",
+                                       rank=self.rank).inc()
                     self.write_metrics()
                     if self.fleet is not None:
                         # exit already posted above; the tombstone tells
@@ -731,9 +775,25 @@ def main(argv=None) -> int:
             env["HBNLP_DIST_COORDINATOR"] = args.coordinator
         fleet = FleetCoordinator(args.fleet_dir, args.rank, args.world_size,
                                  peer_timeout_s=args.peer_timeout)
+        # fleet-obs identity plumbing (docs/observability.md "Fleet
+        # observability"): the child posts step timestamps / metrics
+        # snapshots / traces under <fleet_dir>/obs as this rank — injected
+        # even for supervision-only fleets, where HBNLP_DIST_* stays unset
+        env[fleet_obs.ENV_FLEET_DIR] = fleet.dir
+        env[fleet_obs.ENV_FLEET_RANK] = str(args.rank)
+        env[fleet_obs.ENV_FLEET_WORLD] = str(args.world_size)
+
+    def launch() -> int:
+        if fleet is None:
+            return launcher()
+        # per-launch: the child's /healthz identity block, run-start
+        # marker, and step posts name the generation that launched it
+        return launcher(extra_env={
+            fleet_obs.ENV_FLEET_GENERATION: str(fleet.generation)})
+
     launcher = SubprocessLauncher(args.command, env=env)
     sup = Supervisor(
-        launcher,
+        launch,
         lambda: progress_signature(args.model_path),
         max_failures_no_progress=args.max_failures_no_progress,
         backoff_base_s=args.backoff_base, backoff_max_s=args.backoff_max,
@@ -741,11 +801,29 @@ def main(argv=None) -> int:
         max_restarts=args.max_restarts,
         metrics_path=os.path.join(args.model_path,
                                   "supervisor_metrics.prom"),
-        fleet=fleet, terminate=launcher.terminate)
+        fleet=fleet, terminate=launcher.terminate, rank=args.rank)
     server = None
-    if args.obs_port:
-        # the exporter import pulls the full package (and jax); degrade to
-        # no endpoint rather than dying — supervision is the job here
+    if args.obs_port and fleet is not None:
+        # fleet mode: serve the FEDERATED view — per-rank child +
+        # supervisor series (rank-labeled) with fleet aggregates, plus the
+        # skew/straggler gauges, and a fleet /healthz.  Stdlib-only
+        # (obs/fleet.py), so a broken jax install cannot take it down.
+        federation = fleet_obs.FleetFederation(
+            args.fleet_dir, own_registry=sup.registry, own_rank=args.rank,
+            world_size=args.world_size,
+            identity_doc={"rank": args.rank,
+                          "world_size": args.world_size,
+                          "coordinator": args.coordinator},
+            generation=lambda: fleet.generation)
+        try:
+            server = fleet_obs.serve_federation(args.obs_port, federation)
+        except OSError as e:
+            LOG.warning("--obs-port unavailable (%r); supervising without "
+                        "a federated endpoint", e)
+    elif args.obs_port:
+        # single-host: the exporter import pulls the full package (and
+        # jax); degrade to no endpoint rather than dying — supervision is
+        # the job here
         try:
             from homebrewnlp_tpu.obs.exporter import start_server
             server = start_server(args.obs_port, registry=sup.registry)
@@ -757,8 +835,11 @@ def main(argv=None) -> int:
     finally:
         sup.write_metrics()  # final render incl. the last exit's counters
         if server is not None:
-            from homebrewnlp_tpu.obs.exporter import stop_server
-            stop_server(server)
+            if fleet is not None:
+                fleet_obs.stop_federation(server)
+            else:
+                from homebrewnlp_tpu.obs.exporter import stop_server
+                stop_server(server)
 
 
 if __name__ == "__main__":
