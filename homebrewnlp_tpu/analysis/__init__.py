@@ -33,7 +33,7 @@ from .ast_rules import run_ast_rules  # noqa: F401
 
 GRAPH_RULES = ("collective-census", "dtype-promotion", "quant-dtype",
                "donation", "sharding-spec", "constant-bloat",
-               "resource-budget")
+               "resource-budget", "mesh-rank")
 # "dtype-promotion" appears in both: the AST pass carries its static twin
 AST_RULES = ("axis-literal", "x-escape", "traced-rng", "partitionspec-axis",
              "dtype-promotion", "host-sync", "obs-in-trace", "bare-io")

@@ -409,6 +409,18 @@ def check_resource_budget(traces: ConfigTraces,
     target = str(getattr(traces.cfg, "target_device", "") or "")
     spec = resolve_device(target) if target else None
 
+    if not target and int(getattr(traces.cfg, "tpu_size", 1)) > 1:
+        # a multi-device config without a target device runs with the
+        # OOM-before-compile gate DEAD (exactly how all nine committed
+        # goldens shipped with target_device: "") — surface it
+        findings.append(Finding(
+            "resource-budget", "warning", _loc(traces, "*"),
+            f"tpu_size={traces.cfg.tpu_size} but target_device is empty — "
+            f"the OOM-before-compile gate cannot run and the roofline/mesh "
+            f"search falls back to {DEFAULT_VERDICT_DEVICE!r}; set "
+            f"target_device to the fleet's device kind "
+            f"(homebrewnlp_tpu/devices.py)"))
+
     # OOM-before-compile gate: independent of the golden, so an inflated
     # context/batch fails even on a freshly re-recorded budget
     if spec is not None:

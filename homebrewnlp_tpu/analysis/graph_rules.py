@@ -406,24 +406,47 @@ def check_trace_errors(traces: ConfigTraces) -> typing.List[Finding]:
     return findings
 
 
+def _config_tpu_size(name: str) -> typing.Optional[int]:
+    """tpu_size from the raw config JSON (no Config construction, no jax) —
+    None when the file is absent/unreadable.  The fallback default MUST
+    match config.py's ``_DEFAULTS`` tpu_size."""
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "configs", name + ".json")
+    try:
+        with open(path) as f:
+            return int(json.load(f).get("tpu_size", 32))
+    except (OSError, ValueError, TypeError):
+        return None
+
+
 def check_golden_coverage(config_names: typing.Sequence[str]
                           ) -> typing.List[Finding]:
     """Tree-wide gate (run under --all-configs): every bundled config must
-    have BOTH a census golden and a resources golden, and no golden may
-    outlive its config.  Previously a brand-new config silently skipped the
-    census until someone traced it by hand — coverage is now an invariant,
-    not a convention."""
+    have BOTH a census golden and a resources golden — and, when it
+    declares a multi-device topology (tpu_size > 1), a mesh golden too —
+    and no golden may outlive its config.  Previously a brand-new config
+    silently skipped the census until someone traced it by hand — coverage
+    is now an invariant, not a convention."""
     from .cost_model import resources_golden_path
+    from .mesh_search import mesh_golden_path
     findings: typing.List[Finding] = []
     names = set(config_names)
     for kind, path_fn in (("census", golden_path),
-                          ("resources", resources_golden_path)):
+                          ("resources", resources_golden_path),
+                          ("mesh", mesh_golden_path)):
         have = set()
         d = os.path.dirname(path_fn("_"))
         if os.path.isdir(d):
             have = {os.path.splitext(f)[0] for f in os.listdir(d)
                     if f.endswith(".json")}
-        for name in sorted(names - have):
+        missing = names - have
+        if kind == "mesh":
+            # only multi-device configs factor a mesh; a config whose raw
+            # JSON cannot be read (e.g. a hypothetical name probed by
+            # tests) is not held to the multi-device requirement
+            missing = {n for n in missing
+                       if (_config_tpu_size(n) or 1) > 1}
+        for name in sorted(missing):
             findings.append(Finding(
                 "golden-coverage", "error", f"configs/{name}.json",
                 f"config has no {kind} golden — it would silently skip the "
@@ -441,6 +464,7 @@ def run_graph_rules(traces: ConfigTraces, update_goldens: bool = False,
                     rules: typing.Optional[typing.Sequence[str]] = None
                     ) -> typing.List[Finding]:
     from .cost_model import check_resource_budget
+    from .mesh_search import check_mesh_rank
     table = {
         "collective-census": lambda t: check_collective_census(t, update_goldens),
         "dtype-promotion": check_dtype_promotion,
@@ -449,6 +473,7 @@ def run_graph_rules(traces: ConfigTraces, update_goldens: bool = False,
         "sharding-spec": check_sharding_specs,
         "constant-bloat": check_constant_bloat,
         "resource-budget": lambda t: check_resource_budget(t, update_goldens),
+        "mesh-rank": lambda t: check_mesh_rank(t, update_goldens),
     }
     findings = check_trace_errors(traces)
     for name, fn in table.items():

@@ -1,0 +1,423 @@
+"""graftmesh: topology-aware mesh auto-search as a static-analysis pass.
+
+The reference framework hand-writes its mesh layouts (``SimdMeshImpl``
+device assignment — two integers, ``tpu_size`` and ``heads``, guessed and
+checked against a real pod); graftcost (PR 7) already prices any candidate
+sharding statically — per-device HBM, per-axis alpha-beta collective bytes,
+``static_step_times`` — in seconds on a CPU.  This module turns that
+objective into a *search*: enumerate the DP/SP/PP/TP factorizations of a
+slice topology (``parallel/mesh.py::mesh_factorizations``), score every
+candidate with the one time model the roofline verdict and graftprof
+already share, gate each against the ``target_device``'s HBM capacity
+(OOM-before-compile), and rank.
+
+**Objective.**  Predicted train-step seconds
+``max(mxu, hbm) + ici``: compute and HBM traffic overlap within the chip
+(the roofline assumption), collectives serialize against both (matching
+the current non-overlapped sharded einsums — when collective/compute
+overlap lands, this is the constant to revisit).  Candidates whose
+predicted peak HBM exceeds the scoring device's capacity rank strictly
+after every fitting candidate.  Times within :data:`RANK_RTOL` of each
+other are TIED — the model's calibration error (the ``tolerance.xla``
+story in docs/static_analysis.md) cannot defend finer distinctions.
+
+**Enumeration semantics.**  By default the sequence and pipeline axes stay
+pinned to the config's declared values — they are *structural* choices
+that change the traced program (ring-attention chunking, pipeline stage
+scans), exactly the degrees of freedom ``axis_sizes`` itself holds fixed —
+so every candidate prices the SAME traced jaxpr under a different intended
+mesh and the whole search costs one abstract trace.  ``free_axes``
+unlocks them: each distinct (seq, pipe) structure is re-traced with an
+overridden config (seconds per structure; requires the raw config dict).
+
+**Implicit data-parallel gradient all-reduce.**  The traced jaxpr only
+contains *manual* collectives (ring ppermutes, pipeline hops, sharding
+constraints); the gradient all-reduce GSPMD inserts for a >1 data axis is
+implicit and would make pure DP look free.  The searcher prices it
+analytically — per-device gradient bytes (~ the sharded param bytes) ring
+all-reduced over the data axis — on top of the walked collectives, for
+every candidate including the hand-written mesh.  Implicit *model-axis*
+activation reductions are still unpriced (a known model gap, recorded in
+docs/static_analysis.md); both sides of the comparison omit them equally.
+
+Consumers: ``tools/graftmesh.py`` (ranked sheet + ``--check``), the
+ratcheted ``mesh-rank`` graph rule (per-config goldens under
+``analysis/goldens/mesh/``), and ``reliability/dist.py::suggest_mesh``
+(degraded-resume world-size renegotiation).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import typing
+
+from ..devices import resolve_device
+from ..parallel.mesh import (DATA_AXIS, MESH_AXES, axis_sizes,
+                             mesh_factorizations)
+from .cost_model import (DEFAULT_VERDICT_DEVICE, CommModel, StepResources,
+                         format_bytes, static_step_times, step_resources)
+from .findings import Finding
+from .trace import ConfigTraces, trace_config
+
+GOLDENS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "goldens")
+
+#: relative tolerance under which two candidates' predicted step times tie —
+#: the cost model is calibrated to within 2x of XLA's own estimates
+#: (``tolerance.xla`` in the resources goldens), so sub-10% distinctions
+#: between layouts are noise it cannot defend; a real TPU round
+#: (MULTICHIP ``mesh_search`` row) is what resolves finer orderings
+RANK_RTOL = 0.10
+
+#: the ranking objective, recorded in every golden so a future change to the
+#: arithmetic is a visible golden diff, not a silent re-ranking
+OBJECTIVE = "max(mxu,hbm)+ici"
+
+
+@dataclasses.dataclass
+class MeshCandidate:
+    """One scored factorization (``predicted`` empty when the candidate's
+    structure failed to trace — see ``error``)."""
+    axes: typing.Dict[str, int]
+    predicted: typing.Dict[str, float] = dataclasses.field(
+        default_factory=dict)  # mxu_s / hbm_s / ici_s / step_s
+    hbm_peak: int = 0
+    fits: typing.Optional[bool] = None
+    retraced: bool = False
+    is_hand: bool = False
+    rank: int = 0
+    error: str = ""
+
+    @property
+    def step_s(self) -> float:
+        return self.predicted.get("step_s", float("inf"))
+
+    def key(self) -> typing.Tuple[typing.Tuple[str, int], ...]:
+        return tuple((a, int(self.axes.get(a, 1))) for a in MESH_AXES)
+
+    def describe(self) -> str:
+        return " ".join(f"{a}{v}" for a, v in self.key() if v > 1) or "1chip"
+
+    def as_golden(self) -> dict:
+        return {"axes": {a: int(v) for a, v in self.key()},
+                "step_time_s": float(f"{self.step_s:.6g}"),
+                "ici_s": float(f"{self.predicted.get('ici_s', 0.0):.6g}"),
+                "hbm_peak_bytes": int(self.hbm_peak),
+                "fits": self.fits,
+                "rank": int(self.rank)}
+
+
+@dataclasses.dataclass
+class MeshSearchResult:
+    config_name: str
+    n_devices: int
+    device_kind: str
+    free_axes: typing.Tuple[str, ...]
+    candidates: typing.List[MeshCandidate]  # ranked, best first
+    skipped: typing.List[MeshCandidate]  # structures that failed to trace
+    hand_axes: typing.Dict[str, int]
+    hand_rank: int
+
+    @property
+    def top(self) -> MeshCandidate:
+        return self.candidates[0]
+
+    @property
+    def hand(self) -> MeshCandidate:
+        return next(c for c in self.candidates if c.is_hand)
+
+    def as_json(self) -> dict:
+        return {"config": self.config_name,
+                "n_devices": self.n_devices,
+                "device": self.device_kind,
+                "objective": OBJECTIVE,
+                "rank_rtol": RANK_RTOL,
+                "free_axes": list(self.free_axes),
+                "hand_mesh": {a: int(v) for a, v in
+                              sorted(self.hand_axes.items())},
+                "hand_rank": self.hand_rank,
+                "candidates": [c.as_golden() for c in self.candidates],
+                "skipped": [{"axes": c.axes, "error": c.error}
+                            for c in self.skipped]}
+
+
+def _with_implicit_grad_allreduce(res: StepResources,
+                                  axes: typing.Dict[str, int]) -> CommModel:
+    """The walked collectives plus the implicit data-axis gradient
+    all-reduce (see module docstring): per-device grad bytes ~ per-device
+    param bytes, ring-reduced (2(n-1)/n chunk factor, one fused launch)."""
+    comm = CommModel(dict(res.comm.bytes_per_axis),
+                     dict(res.comm.count_per_axis))
+    d = int(axes.get(DATA_AXIS, 1))
+    if d > 1 and res.hbm.get("params", 0) > 0:
+        moved = int(res.hbm["params"] * 2.0 * (d - 1) / d)
+        comm.bytes_per_axis[DATA_AXIS] = (
+            comm.bytes_per_axis.get(DATA_AXIS, 0) + moved)
+        comm.count_per_axis[DATA_AXIS] = (
+            comm.count_per_axis.get(DATA_AXIS, 0) + 1)
+    return comm
+
+
+def _price(traces: ConfigTraces, step: str, axes: typing.Dict[str, int],
+           device_kind: str, spec) -> MeshCandidate:
+    from .graph_rules import _IntendedMesh
+    st = traces.steps[step]
+    res = step_resources(traces, step, st, _IntendedMesh(dict(axes)),
+                         device_kind)
+    comm = _with_implicit_grad_allreduce(res, axes)
+    times = static_step_times(res.flops_per_device, res.hbm_traffic_bytes,
+                              comm, dict(axes), device_kind)
+    assert times is not None  # device_kind is resolved before pricing
+    predicted = {"mxu_s": float(times["mxu"]), "hbm_s": float(times["hbm"]),
+                 "ici_s": float(times["ici"]),
+                 "step_s": float(max(times["mxu"], times["hbm"])
+                                 + times["ici"])}
+    peak = int(res.hbm["peak"])
+    fits = bool(peak <= spec.hbm_bytes) if spec is not None else None
+    return MeshCandidate(axes=dict(axes), predicted=predicted, hbm_peak=peak,
+                         fits=fits)
+
+
+def _assign_ranks(cands: typing.List[MeshCandidate]
+                  ) -> typing.List[MeshCandidate]:
+    """Sort best-first and assign tie-tolerant ranks: a candidate's rank is
+    1 + the number of fitting candidates strictly more than RANK_RTOL
+    faster.  Non-fitting candidates rank after every fitting one, ordered
+    by predicted peak (least-overcommitted first)."""
+    fitting = sorted((c for c in cands if c.fits is not False),
+                     key=lambda c: (c.step_s, c.key()))
+    oom = sorted((c for c in cands if c.fits is False),
+                 key=lambda c: (c.hbm_peak, c.key()))
+    for c in fitting:
+        c.rank = 1 + sum(1 for o in fitting
+                         if o.step_s < c.step_s * (1.0 - RANK_RTOL))
+    for i, c in enumerate(oom):
+        c.rank = len(fitting) + 1 + i
+    return fitting + oom
+
+
+def search(cfg, config_name: str = "config", *,
+           n_devices: typing.Optional[int] = None, device_kind: str = "",
+           traces: typing.Optional[ConfigTraces] = None,
+           raw: typing.Optional[dict] = None,
+           free_axes: typing.Sequence[str] = (),
+           step: str = "train") -> MeshSearchResult:
+    """Enumerate + score + rank the mesh factorizations of ``n_devices``
+    (default: the config's ``tpu_size``) for one config.
+
+    ``traces`` reuses an existing abstract trace for the declared-structure
+    candidates (the mesh-rank rule path: zero extra traces); ``raw`` (the
+    config's raw JSON dict) is required only when ``free_axes`` asks for
+    structural candidates, which re-trace per distinct (seq, pipe).
+    Deterministic by construction: no RNG, stable sort keys."""
+    n = int(n_devices) if n_devices else max(int(cfg.tpu_size), 1)
+    kind = device_kind or str(getattr(cfg, "target_device", "") or "") \
+        or DEFAULT_VERDICT_DEVICE
+    spec = resolve_device(kind)
+    if spec is None:
+        raise ValueError(f"cannot score meshes on unknown device kind "
+                         f"{kind!r}; pass --device one of the kinds in "
+                         f"homebrewnlp_tpu/devices.py")
+    hand = axis_sizes(cfg, n, quiet=True)
+    factors = mesh_factorizations(cfg, n, free_axes)
+    if not any(f == hand for f in factors):
+        factors.append(dict(hand))  # always price the committed layout
+
+    declared = (cfg.sequence_parallel, cfg.pipeline_parallel)
+    groups: typing.Dict[typing.Tuple[int, int],
+                        typing.List[typing.Dict[str, int]]] = {}
+    for f in factors:
+        groups.setdefault(
+            (f["sequence_parallel"], f["pipeline"]), []).append(f)
+
+    scored: typing.List[MeshCandidate] = []
+    skipped: typing.List[MeshCandidate] = []
+    for (seq, pipe), members in sorted(groups.items()):
+        if (seq, pipe) == declared:
+            gtraces = traces
+            if gtraces is None or step not in gtraces.steps:
+                gtraces = trace_config(cfg, config_name, steps=(step,),
+                                       quiet=True)
+            retraced = False
+        else:
+            if raw is None:
+                skipped.extend(MeshCandidate(
+                    axes=m, error="structural candidate needs the raw "
+                    "config dict (pass raw= / run via tools/graftmesh.py)")
+                    for m in members)
+                continue
+            from ..config import Config
+            cand_raw = dict(raw)
+            cand_raw.pop("_comment", None)
+            cand_raw["sequence_parallel"] = seq
+            cand_raw["pipeline_parallel"] = pipe
+            try:
+                gtraces = trace_config(Config(cand_raw),
+                                       f"{config_name}@s{seq}p{pipe}",
+                                       steps=(step,), quiet=True)
+            except Exception as e:
+                gtraces = None
+                err = f"{type(e).__name__}: {e}"
+            if gtraces is None or step not in gtraces.steps:
+                err = (gtraces.errors.get(step, "step not traced")
+                       if gtraces is not None else err)
+                skipped.extend(MeshCandidate(axes=m, error=err)
+                               for m in members)
+                continue
+            retraced = True
+        if step not in gtraces.steps:
+            skipped.extend(MeshCandidate(
+                axes=m, error=gtraces.errors.get(step, "step not traced"))
+                for m in members)
+            continue
+        for m in members:
+            c = _price(gtraces, step, m, kind, spec)
+            c.retraced = retraced
+            c.is_hand = (m == hand)
+            scored.append(c)
+
+    ranked = _assign_ranks(scored)
+    hand_rank = next((c.rank for c in ranked if c.is_hand), 0)
+    return MeshSearchResult(
+        config_name=config_name, n_devices=n, device_kind=kind,
+        free_axes=tuple(free_axes), candidates=ranked, skipped=skipped,
+        hand_axes=dict(hand), hand_rank=hand_rank)
+
+
+# -- degraded-resume suggestion (reliability/dist.py::suggest_mesh) ----------
+
+@dataclasses.dataclass
+class MeshSuggestion:
+    """The searcher's answer for a renegotiated world size: the best
+    candidate, the axis_sizes fallback the runtime would otherwise build,
+    and the predicted step-time delta between them (negative = the
+    suggestion is faster)."""
+    world_size: int
+    device_kind: str
+    best: MeshCandidate
+    fallback: MeshCandidate
+    result: MeshSearchResult
+
+    @property
+    def delta_frac(self) -> float:
+        """(best - fallback) / fallback predicted step time."""
+        fb = self.fallback.step_s
+        return (self.best.step_s - fb) / fb if fb > 0 else 0.0
+
+    def describe(self) -> str:
+        return (f"mesh search for world_size={self.world_size} on "
+                f"{self.device_kind}: suggest {{{self.best.describe()}}} "
+                f"(predicted {self.best.step_s * 1e3:.3f} ms/step, peak "
+                f"{format_bytes(self.best.hbm_peak).strip()}/dev) vs "
+                f"fallback {{{self.fallback.describe()}}} "
+                f"({self.fallback.step_s * 1e3:.3f} ms/step, "
+                f"{self.delta_frac:+.1%})")
+
+
+def suggest(cfg, world_size: int, *, config_name: str = "config",
+            device_kind: str = "",
+            traces: typing.Optional[ConfigTraces] = None) -> MeshSuggestion:
+    """Searched mesh for a degraded/renegotiated ``world_size`` using the
+    config's declared structure (one abstract trace; no RNG).  Raises
+    ValueError when the declared seq x pipe structure cannot factor the
+    world — that case stays operator-assisted (docs/reliability.md)."""
+    fallback_axes = axis_sizes(cfg, world_size, quiet=True)
+    result = search(cfg, config_name, n_devices=world_size,
+                    device_kind=device_kind, traces=traces)
+    fallback = next((c for c in result.candidates
+                     if c.axes == fallback_axes), None)
+    if fallback is None:  # unreachable: search always prices the hand mesh
+        fallback = result.hand
+    return MeshSuggestion(world_size=int(world_size),
+                          device_kind=result.device_kind,
+                          best=result.top, fallback=fallback, result=result)
+
+
+# -- the ratcheted mesh-rank graph rule --------------------------------------
+
+def mesh_golden_path(config_name: str) -> str:
+    return os.path.join(GOLDENS_DIR, "mesh", config_name + ".json")
+
+
+def _loc(traces: ConfigTraces) -> str:
+    return f"configs/{traces.config_name}.json[train]"
+
+
+def check_mesh_rank(traces: ConfigTraces,
+                    update_goldens: bool = False) -> typing.List[Finding]:
+    """The graph rule: each committed multi-device config's hand-written
+    mesh must rank within the top ``mesh_search_top_k`` of the searcher's
+    prediction for its declared topology, pinned by a per-config golden
+    (``analysis/goldens/mesh/<config>.json``).  Ratchet semantics: the
+    hand mesh's rank may not worsen past the recorded one; an improved
+    rank asks for a re-record; a moved top pick is a warning."""
+    cfg = traces.cfg
+    if int(getattr(cfg, "tpu_size", 1)) <= 1:
+        return []  # single-device configs have nothing to factor
+    if "train" not in traces.steps:
+        return []  # the trace failure is already a `trace` finding
+    findings: typing.List[Finding] = []
+    try:
+        result = search(cfg, traces.config_name, traces=traces)
+    except Exception as e:  # a searcher crash must name itself, not pass
+        return [Finding("mesh-rank", "error", _loc(traces),
+                        f"mesh search failed: {type(e).__name__}: {e}")]
+    top_k = int(getattr(cfg, "mesh_search_top_k", 3))
+    hand = result.hand
+    if result.hand_rank > top_k:
+        findings.append(Finding(
+            "mesh-rank", "error", _loc(traces),
+            f"hand-written mesh {{{hand.describe()}}} ranks "
+            f"#{result.hand_rank} of {len(result.candidates)} (predicted "
+            f"{hand.step_s * 1e3:.3f} ms/step vs the searcher's pick "
+            f"{{{result.top.describe()}}} at "
+            f"{result.top.step_s * 1e3:.3f} ms) — outside "
+            f"mesh_search_top_k={top_k} on {result.device_kind}; adopt the "
+            f"searched layout (or raise mesh_search_top_k in the config — "
+            f"re-recording the golden cannot clear this bar)"))
+    path = mesh_golden_path(traces.config_name)
+    if update_goldens:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        import jax
+        with open(path, "w") as f:
+            json.dump(dict(result.as_json(), jax=jax.__version__,
+                           top_k=top_k), f, indent=2, sort_keys=True)
+            f.write("\n")
+        findings.append(Finding(
+            "mesh-rank", "info", path,
+            f"mesh golden updated (hand rank #{result.hand_rank} of "
+            f"{len(result.candidates)} on {result.device_kind})"))
+        return findings
+    if not os.path.exists(path):
+        findings.append(Finding(
+            "mesh-rank", "error", _loc(traces),
+            f"no mesh golden at {os.path.relpath(path)}; run `python "
+            f"tools/graftcheck.py --config configs/{traces.config_name}"
+            f".json --update-goldens`"))
+        return findings
+    with open(path) as f:
+        golden = json.load(f)
+    want_rank = int(golden.get("hand_rank", 1))
+    if result.hand_rank > want_rank:
+        findings.append(Finding(
+            "mesh-rank", "error", _loc(traces),
+            f"hand-written mesh's searcher rank regressed "
+            f"#{want_rank} -> #{result.hand_rank} (of "
+            f"{len(result.candidates)} candidates on {result.device_kind}) "
+            f"— the cost model now prefers {{{result.top.describe()}}}; "
+            f"adopt it or re-record with --update-goldens"))
+    elif result.hand_rank < want_rank:
+        findings.append(Finding(
+            "mesh-rank", "info", _loc(traces),
+            f"hand-written mesh's searcher rank improved "
+            f"#{want_rank} -> #{result.hand_rank}; re-record with "
+            f"--update-goldens to ratchet the gain"))
+    want_top = (golden.get("candidates") or [{}])[0].get("axes")
+    got_top = result.top.as_golden()["axes"]
+    if want_top is not None and want_top != got_top:
+        findings.append(Finding(
+            "mesh-rank", "warning", _loc(traces),
+            f"searcher's top pick moved {want_top} -> {got_top} — the cost "
+            f"model's preferred layout changed; re-record if intended"))
+    return findings

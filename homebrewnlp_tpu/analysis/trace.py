@@ -320,10 +320,12 @@ def trace_decode(cfg: Config, params, mesh=None) -> StepTrace:
 
 def trace_config(cfg: Config, config_name: str,
                  steps: typing.Sequence[str] = ("train", "decode"),
-                 ) -> ConfigTraces:
+                 quiet: bool = False) -> ConfigTraces:
     """Trace the requested steps of one config, collecting per-step failures
-    instead of aborting the whole audit."""
-    mesh = make_mesh(cfg)
+    instead of aborting the whole audit.  ``quiet`` suppresses the local
+    mesh's axis-fold warnings (the mesh searcher's internal traces would
+    otherwise re-print the very warning its suggestion replaces)."""
+    mesh = make_mesh(cfg, quiet=quiet)
     out: typing.Dict[str, StepTrace] = {}
     errors: typing.Dict[str, str] = {}
     params: typing.Dict[str, typing.Any] = {}

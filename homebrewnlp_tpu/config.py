@@ -340,6 +340,11 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # skips the capacity gate; predictions and the roofline verdict are
     # still recorded against the default verdict device.
     target_device="",
+    # how deep into the mesh searcher's ranked sheet the committed
+    # hand-written mesh may sit before graftcheck's mesh-rank rule fails
+    # (docs/static_analysis.md "Mesh search"); 1 = the hand mesh must BE
+    # the searcher's (possibly tied) top pick
+    mesh_search_top_k=3,
     # parallelism (the reference's two knobs, plus TPU-native extensions)
     tpu_size=32,
     sequence_parallel=1,  # extension: size of the sequence-parallel mesh axis
@@ -450,6 +455,11 @@ class Config:
                     f"unknown target_device {self.target_device!r}; known "
                     f"kinds: {', '.join(known_kinds())} (or \"\" to skip "
                     f"the HBM capacity gate)")
+        if int(self.mesh_search_top_k) < 1:
+            raise ValueError("mesh_search_top_k must be >= 1 (the rank the "
+                             "hand-written mesh must reach in the searcher's "
+                             "sheet)")
+        self.mesh_search_top_k = int(self.mesh_search_top_k)
         if float(self.serve_queue_deadline_s) < 0:
             raise ValueError("serve_queue_deadline_s must be >= 0 "
                              "(0 = requests wait in the engine queue forever)")

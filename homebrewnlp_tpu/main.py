@@ -205,7 +205,23 @@ def _train_loop(cfg, args, obs, grace) -> None:
 
     have_data = _have_dataset_files(cfg)
     from .parallel import make_mesh
-    mesh = make_mesh(cfg)
+    # elastic runs suppress the "axis shrunk" fold warnings: when the fleet
+    # resumes degraded (the device count no longer factors the declared
+    # mesh — the model axis folded, or the batch-bound data axis dropped
+    # devices), the mesh searcher's suggestion replaces them
+    # (docs/reliability.md "Multi-host elasticity"; analysis/
+    # mesh_search.py).  Non-elastic runs keep the plain warnings — running
+    # a pod config on one bench chip is deliberate, not degraded.
+    from .parallel.mesh import MODEL_AXIS
+    elastic = dist.settings(cfg) is not None
+    mesh = make_mesh(cfg, quiet=elastic)
+    n_avail = len(jax.devices())
+    if elastic and jax.process_index() == 0 and (
+            int(dict(mesh.shape).get(MODEL_AXIS, 1)) != cfg.mesh_model
+            or mesh.size < n_avail):
+        # process 0 only: the search re-traces the config (seconds on a
+        # flagship) and every host would log the identical suggestion
+        dist.log_mesh_suggestion(cfg, mesh, n_devices=n_avail)
     # processes sharing a data-axis coordinate (pipe axis spanning hosts)
     # read the SAME dataset slice (data/feed.py::data_slice_for_process);
     # data-major topologies reduce to (process_index, process_count)

@@ -300,5 +300,70 @@ def check_peers(step: int) -> None:
                   action)
 
 
+#: env kill-switch for the degraded-resume mesh search (the suggestion costs
+#: one abstract trace — seconds; "0" skips it)
+ENV_MESH_SUGGEST = "HBNLP_MESH_SUGGEST"
+
+
+def suggest_mesh(cfg, world_size: int, *,
+                 device_kind: str = "", traces=None):
+    """World-size renegotiation consults the mesh searcher
+    (analysis/mesh_search.py): the best DP/SP/PP/TP factorization of
+    ``world_size`` devices for this config under its declared structure,
+    plus the predicted step-time delta vs the ``axis_sizes`` fallback the
+    runtime would otherwise silently build.
+
+    Returns a :class:`~homebrewnlp_tpu.analysis.mesh_search.MeshSuggestion`,
+    or None when the search cannot run (declared seq x pipe structure does
+    not factor the world, unpriceable device, or ``HBNLP_MESH_SUGGEST=0``)
+    — those cases stay operator-assisted, as docs/reliability.md documents
+    for coordinator-mode fleets."""
+    if os.environ.get(ENV_MESH_SUGGEST, "1") == "0":
+        return None
+    from ..analysis import mesh_search  # lazy: jax-heavy, resume-path only
+    try:
+        return mesh_search.suggest(
+            cfg, world_size, device_kind=device_kind, traces=traces,
+            config_name=os.path.basename(
+                str(getattr(cfg, "model_path", "") or "config")))
+    except Exception as e:
+        LOG.warning("mesh search for world_size=%d unavailable (%s: %s); "
+                    "falling back to the folded axis_sizes mesh",
+                    world_size, type(e).__name__, e)
+        return None
+
+
+def log_mesh_suggestion(cfg, mesh, n_devices: typing.Optional[int] = None
+                        ) -> typing.Optional[typing.Any]:
+    """Degraded-resume replacement for the old "axis shrunk" fold warnings:
+    log the searcher's chosen mesh and its predicted step-time delta vs the
+    mesh actually built.  ``n_devices`` is the AVAILABLE device count (the
+    world the searcher factors) — it can exceed ``mesh.size`` when the
+    batch-bound data axis dropped devices out of the built mesh.
+    Best-effort — never raises, returns the suggestion (or None) so
+    callers/tests can inspect it."""
+    world = int(n_devices) if n_devices else int(mesh.size)
+    try:
+        suggestion = suggest_mesh(cfg, world)
+    except Exception:  # pragma: no cover - suggest_mesh already guards
+        return None
+    built = {k: int(v) for k, v in dict(mesh.shape).items()}
+    unused = ""
+    if world > int(mesh.size):
+        unused = (f" ({world - int(mesh.size)} of {world} device(s) left "
+                  f"out of the built mesh)")
+    if suggestion is None:
+        LOG.warning(
+            "resuming degraded on %d device(s) (tpu_size=%d) with mesh "
+            "%s%s; no searched suggestion available", world,
+            int(getattr(cfg, "tpu_size", 1)), built, unused)
+        return None
+    LOG.warning(
+        "resuming degraded on %d device(s) (tpu_size=%d), built mesh %s%s; "
+        "%s", world, int(getattr(cfg, "tpu_size", 1)), built, unused,
+        suggestion.describe())
+    return suggestion
+
+
 def _reset_for_tests() -> None:
     _STATE.update(initialized=False, settings=None, init_seconds=None)

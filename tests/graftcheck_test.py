@@ -93,7 +93,8 @@ def test_trace_tiny_config_train_and_decode(eight_devices):
     # clean tree: donation + dtype + sharding + const rules all quiet
     # (golden-backed rules excluded: the ad-hoc "tiny" config has none)
     findings = [f for f in graph_rules.run_graph_rules(traces)
-                if f.rule not in ("collective-census", "resource-budget")]
+                if f.rule not in ("collective-census", "resource-budget",
+                                  "mesh-rank")]
     errors = [f for f in findings if f.severity == "error"]
     assert not errors, [f.render() for f in errors]
 
@@ -751,10 +752,16 @@ def test_golden_coverage_gate_detects_missing_and_orphans():
                                   for f in errs)
     assert {("census" in f.message, "resources" in f.message)
             for f in errs} == {(True, False), (False, True)}
-    # a golden whose config was deleted is an orphan warning
+    # a golden whose config was deleted is an orphan warning (census +
+    # resources, plus the mesh golden when the dropped config is
+    # multi-device — mesh goldens exist only for tpu_size > 1)
     findings = check_golden_coverage(names[1:])
     orphans = [f for f in findings if f.severity == "warning"]
-    assert len(orphans) == 2 and all(names[0] in f.location for f in orphans)
+    raw = json.load(open(os.path.join(REPO, "configs",
+                                      names[0] + ".json")))
+    want = 3 if raw.get("tpu_size", 32) > 1 else 2
+    assert len(orphans) == want and all(names[0] in f.location
+                                        for f in orphans)
 
 
 # -- CLI exit status (ISSUE 7 satellite) -------------------------------------

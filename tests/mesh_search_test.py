@@ -1,0 +1,485 @@
+"""graftmesh: factorization enumeration, cost-model monotonicity, search
+determinism, the implicit DP gradient all-reduce, the mesh-rank ratchet,
+mesh-golden coverage, the degraded-resume suggestion, and the CLI.
+"""
+import json
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from homebrewnlp_tpu.analysis import cost_model, mesh_search
+from homebrewnlp_tpu.analysis import trace as atrace
+from homebrewnlp_tpu.parallel.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
+                                           SEQ_AXIS, axis_sizes,
+                                           mesh_factorizations)
+
+from .backend import tiny_config
+
+
+@pytest.fixture(scope="module")
+def pod_traces():
+    """A tiny 8-device config (heads 4, batch 8) with its train trace —
+    the anchor every in-process search test prices."""
+    cfg = tiny_config(tpu_size=8, train_batch_size=8)
+    traces = atrace.trace_config(cfg, "tinymesh", steps=("train",))
+    assert "train" in traces.steps, traces.errors
+    return cfg, traces
+
+
+# -- factorization enumeration (parallel/mesh.py) ----------------------------
+
+def test_factorizations_product_and_constraints():
+    cfg = tiny_config(tpu_size=8, train_batch_size=8)  # heads=4
+    factors = mesh_factorizations(cfg, 8)
+    assert factors, "no factorizations of 8 devices"
+    for f in factors:
+        n = 1
+        for v in f.values():
+            n *= v
+        assert n == 8, f
+        assert cfg.heads % f[MODEL_AXIS] == 0
+        assert cfg.train_batch_size % f[DATA_AXIS] == 0
+        # default: structural axes pinned to the declared values
+        assert f[SEQ_AXIS] == cfg.sequence_parallel
+        assert f[PIPE_AXIS] == cfg.pipeline_parallel
+    # heads=4 bounds the model axis; batch=8 admits every data size
+    assert {f[MODEL_AXIS] for f in factors} == {1, 2, 4}
+    # the hand-written axis_sizes mesh is always in the space
+    assert axis_sizes(cfg, 8, quiet=True) in factors
+
+
+def test_factorizations_free_axes_unlock_structure():
+    cfg = tiny_config(tpu_size=8, train_batch_size=8)  # seq_len=16, depth=2
+    seqs = {f[SEQ_AXIS] for f in mesh_factorizations(
+        cfg, 8, free_axes=(SEQ_AXIS,))}
+    assert seqs == {1, 2, 4, 8}  # divisors of 8 that divide seq_len 16
+    pipes = {f[PIPE_AXIS] for f in mesh_factorizations(
+        cfg, 8, free_axes=(PIPE_AXIS,))}
+    assert pipes == {1, 2}  # pipe must divide depth=2
+    with pytest.raises(ValueError, match="free_axes"):
+        mesh_factorizations(cfg, 8, free_axes=("data",))
+
+
+def test_factorizations_deterministic_order():
+    cfg = tiny_config(tpu_size=8, train_batch_size=8)
+    a = mesh_factorizations(cfg, 8, free_axes=(SEQ_AXIS, PIPE_AXIS))
+    b = mesh_factorizations(cfg, 8, free_axes=(SEQ_AXIS, PIPE_AXIS))
+    assert a == b and a == sorted(
+        a, key=lambda s: (s[DATA_AXIS], s[SEQ_AXIS], s[PIPE_AXIS],
+                          s[MODEL_AXIS]))
+
+
+# -- cost-model monotonicity (ISSUE satellite) -------------------------------
+
+def test_static_step_times_monotone_in_inputs():
+    """static_step_times must be monotone in flops, HBM traffic, and
+    collective bytes — the searcher's ordering is meaningless otherwise."""
+    shape = {DATA_AXIS: 4, SEQ_AXIS: 1, PIPE_AXIS: 1, MODEL_AXIS: 2}
+    comm = cost_model.CommModel({DATA_AXIS: 1 << 20}, {DATA_AXIS: 2})
+
+    def t(flops=1e12, traffic=1e9, c=comm):
+        out = cost_model.static_step_times(flops, traffic, c, shape, "v4")
+        assert out is not None
+        return out
+
+    assert t(flops=2e12)["mxu"] > t()["mxu"]
+    assert t(traffic=2e9)["hbm"] > t()["hbm"]
+    fatter = cost_model.CommModel({DATA_AXIS: 1 << 22}, {DATA_AXIS: 2})
+    assert t(c=fatter)["ici"] > t()["ici"]
+    chattier = cost_model.CommModel({DATA_AXIS: 1 << 20}, {DATA_AXIS: 64})
+    assert t(c=chattier)["ici"] > t()["ici"]
+    # unknown device kinds make no bandwidth claims
+    assert cost_model.static_step_times(1e12, 1e9, comm, shape, "cpu") is None
+
+
+def test_implicit_dp_grad_allreduce_priced():
+    res = cost_model.StepResources(
+        hbm={"params": 1000, "peak": 1000},
+        comm=cost_model.CommModel({}, {}), flops_per_device=1.0,
+        hbm_traffic_bytes=1.0, verdict="mxu", verdict_device="v4",
+        scaled={})
+    dp = mesh_search._with_implicit_grad_allreduce(
+        res, {DATA_AXIS: 4, MODEL_AXIS: 1})
+    # 2(n-1)/n ring chunk factor over the per-device grad bytes
+    assert dp.bytes_per_axis[DATA_AXIS] == int(1000 * 2 * 3 / 4)
+    assert dp.count_per_axis[DATA_AXIS] == 1
+    nodp = mesh_search._with_implicit_grad_allreduce(
+        res, {DATA_AXIS: 1, MODEL_AXIS: 4})
+    assert DATA_AXIS not in nodp.bytes_per_axis
+    # the original walk model is never mutated
+    assert res.comm.bytes_per_axis == {}
+
+
+# -- the search --------------------------------------------------------------
+
+def test_search_ranks_hand_mesh_first_and_is_deterministic(pod_traces):
+    """ROADMAP acceptance shape + the determinism satellite: the committed
+    axis_sizes mesh ranks at or above the searcher's own pick, and two
+    searches over the same topology produce byte-identical sheets."""
+    cfg, traces = pod_traces
+    a = mesh_search.search(cfg, "tinymesh", traces=traces)
+    b = mesh_search.search(cfg, "tinymesh", traces=traces)
+    assert a.as_json() == b.as_json()
+    assert len(a.candidates) == 3  # model in {1,2,4} x matching data
+    assert a.hand_axes == axis_sizes(cfg, 8, quiet=True)
+    assert a.hand.is_hand and a.hand_rank == a.hand.rank
+    assert a.hand_rank <= a.top.rank, (a.hand_rank, a.top.rank)
+    # ranked best-first, every candidate priced and gated
+    steps = [c.step_s for c in a.candidates]
+    assert steps == sorted(steps)
+    assert all(c.predicted and c.fits for c in a.candidates)
+    # deeper model sharding means fewer implicit DP grad bytes: the
+    # sheet's ici must strictly decrease with the model axis
+    by_model = {c.axes[MODEL_AXIS]: c.predicted["ici_s"]
+                for c in a.candidates}
+    assert by_model[4] < by_model[2] < by_model[1]
+
+
+def test_search_scores_on_target_device(pod_traces):
+    cfg, traces = pod_traces
+    default = mesh_search.search(cfg, "tinymesh", traces=traces)
+    assert default.device_kind == cost_model.DEFAULT_VERDICT_DEVICE
+    v4 = mesh_search.search(cfg, "tinymesh", traces=traces,
+                            device_kind="v4")
+    assert v4.device_kind == "v4"
+    with pytest.raises(ValueError, match="unknown device kind"):
+        mesh_search.search(cfg, "tinymesh", traces=traces,
+                           device_kind="not_a_tpu")
+
+
+def test_rank_assignment_ties_and_oom_ordering():
+    def cand(step_s, fits=True, peak=0):
+        return mesh_search.MeshCandidate(
+            axes={DATA_AXIS: 1}, predicted={"step_s": step_s},
+            hbm_peak=peak, fits=fits)
+
+    a, b, c, d = cand(1.00), cand(1.05), cand(2.0), cand(0.5, fits=False,
+                                                         peak=9)
+    ranked = mesh_search._assign_ranks([d, c, b, a])
+    # OOM candidates rank strictly after every fitting one, however fast
+    assert ranked[-1] is d and d.rank == 4
+    # 1.05 is within RANK_RTOL of 1.00 -> tied at rank 1; 2.0 is not
+    assert a.rank == 1 and b.rank == 1 and c.rank == 3
+
+
+def test_free_axes_candidates_retrace_or_skip(pod_traces):
+    """Structural candidates need the raw config dict; without it they are
+    skipped loudly, with it they re-trace and join the sheet."""
+    cfg, traces = pod_traces
+    no_raw = mesh_search.search(cfg, "tinymesh", traces=traces,
+                                free_axes=(SEQ_AXIS,))
+    assert no_raw.skipped and all("raw config" in c.error
+                                  for c in no_raw.skipped)
+    raw = dict(model_mode="gpt", use_video=False, use_language=True,
+               sequence_length=16, features_per_head=32, heads=4, depth=2,
+               vocab_size=64, train_batch_size=8, tpu_size=8,
+               memory_reduction_strategy="none",
+               intermediate_feed_forward_multiplier_multiplier=0.5,
+               block_config=[{"layer": ["norm-shift-scale",
+                                        "feed_forward-in:relu"]}])
+    wide = mesh_search.search(cfg, "tinymesh", traces=traces, raw=raw,
+                              free_axes=(SEQ_AXIS,))
+    retraced = [c for c in wide.candidates if c.retraced]
+    assert retraced, "no structural candidate joined the sheet"
+    assert {c.axes[SEQ_AXIS] for c in retraced} >= {2}
+    assert len(wide.candidates) > len(no_raw.candidates)
+
+
+# -- the mesh-rank graph rule ------------------------------------------------
+
+def test_mesh_rank_rule_skips_single_device():
+    cfg = tiny_config(tpu_size=1)
+    traces = atrace.trace_config(cfg, "tiny1chip", steps=("train",))
+    assert mesh_search.check_mesh_rank(traces) == []
+
+
+def test_mesh_rank_rule_golden_roundtrip(pod_traces, tmp_path, monkeypatch):
+    _, traces = pod_traces
+    monkeypatch.setattr(mesh_search, "GOLDENS_DIR", str(tmp_path))
+    # no golden yet -> error naming the update command
+    fs = mesh_search.check_mesh_rank(traces)
+    assert any(f.severity == "error" and "no mesh golden" in f.message
+               for f in fs)
+    fs = mesh_search.check_mesh_rank(traces, update_goldens=True)
+    assert [f.severity for f in fs] == ["info"]
+    assert mesh_search.check_mesh_rank(traces) == []
+    path = mesh_search.mesh_golden_path(traces.config_name)
+    golden = json.load(open(path))
+    assert golden["objective"] == mesh_search.OBJECTIVE
+    assert golden["hand_rank"] == 1 and golden["top_k"] == 3
+    # ratchet: the golden claims the hand mesh used to rank better
+    golden["hand_rank"] = 0
+    json.dump(golden, open(path, "w"))
+    fs = mesh_search.check_mesh_rank(traces)
+    assert any(f.severity == "error" and "regressed" in f.message
+               for f in fs), [f.render() for f in fs]
+    # a moved top pick is a warning
+    golden["hand_rank"] = 1
+    golden["candidates"][0]["axes"] = {DATA_AXIS: 8, SEQ_AXIS: 1,
+                                       PIPE_AXIS: 1, MODEL_AXIS: 1}
+    json.dump(golden, open(path, "w"))
+    fs = mesh_search.check_mesh_rank(traces)
+    assert any(f.severity == "warning" and "top pick moved" in f.message
+               for f in fs)
+    # an improved recorded rank asks for a re-record
+    mesh_search.check_mesh_rank(traces, update_goldens=True)
+    golden = json.load(open(path))
+    golden["hand_rank"] = 2
+    json.dump(golden, open(path, "w"))
+    fs = mesh_search.check_mesh_rank(traces)
+    assert any(f.severity == "info" and "improved" in f.message for f in fs)
+
+
+def test_mesh_rank_rule_fails_outside_top_k(pod_traces, tmp_path,
+                                            monkeypatch):
+    """Force the bar to 0 effective headroom by shrinking top_k via a
+    doctored config twin: a hand rank above top_k is an error even with a
+    fresh golden."""
+    cfg, traces = pod_traces
+    monkeypatch.setattr(mesh_search, "GOLDENS_DIR", str(tmp_path))
+    mesh_search.check_mesh_rank(traces, update_goldens=True)
+    # doctor the search result: pretend the hand mesh ranked 5th
+    real_search = mesh_search.search
+
+    def doctored(cfg_, name, **kw):
+        r = real_search(cfg_, name, **kw)
+        r.hand_rank = 5
+        return r
+
+    monkeypatch.setattr(mesh_search, "search", doctored)
+    fs = mesh_search.check_mesh_rank(traces)
+    sev = {f.severity for f in fs}
+    assert "error" in sev, [f.render() for f in fs]
+    assert any("mesh_search_top_k" in f.message for f in fs
+               if f.severity == "error")
+
+
+def test_committed_mesh_goldens_cover_multi_device_configs():
+    """Every bundled multi-device config carries a mesh golden recording
+    hand rank 1 — the acceptance invariant, pinned in-tree."""
+    import glob
+    for p in sorted(glob.glob(os.path.join(REPO, "configs", "*.json"))):
+        name = os.path.splitext(os.path.basename(p))[0]
+        raw = json.load(open(p))
+        gp = mesh_search.mesh_golden_path(name)
+        if int(raw.get("tpu_size", 32)) > 1:
+            assert os.path.exists(gp), name
+            golden = json.load(open(gp))
+            assert golden["hand_rank"] == 1, name
+            assert golden["candidates"][0]["rank"] == 1, name
+        else:
+            assert not os.path.exists(gp), f"orphan mesh golden: {name}"
+
+
+def test_golden_coverage_requires_mesh_goldens(tmp_path, monkeypatch):
+    import glob
+    from homebrewnlp_tpu.analysis import check_golden_coverage
+    names = [os.path.splitext(os.path.basename(p))[0] for p in
+             glob.glob(os.path.join(REPO, "configs", "*.json"))]
+    multi = [n for n in names if json.load(open(os.path.join(
+        REPO, "configs", n + ".json"))).get("tpu_size", 32) > 1]
+    assert multi
+    # committed tree fully covered
+    assert check_golden_coverage(names) == []
+    # an empty mesh-golden dir -> one missing-mesh error per multi-device
+    # config, none for the single-chip ones
+    monkeypatch.setattr(mesh_search, "GOLDENS_DIR", str(tmp_path))
+    findings = check_golden_coverage(names)
+    mesh_errs = [f for f in findings if "mesh golden" in f.message]
+    assert {f.location for f in mesh_errs} == {
+        f"configs/{n}.json" for n in multi}
+    # an orphan mesh golden is a warning
+    os.makedirs(tmp_path / "mesh")
+    (tmp_path / "mesh" / "ghost_config.json").write_text("{}")
+    findings = check_golden_coverage(names)
+    assert any(f.severity == "warning" and "ghost_config" in f.location
+               and "mesh" in f.message for f in findings)
+
+
+# -- resource-budget target_device warning (ISSUE satellite) -----------------
+
+def test_resource_budget_warns_on_multidev_without_target(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.setattr(cost_model, "GOLDENS_DIR", str(tmp_path))
+    cfg = tiny_config(tpu_size=8)
+    traces = atrace.trace_config(cfg, "tinypod", steps=("train",))
+    fs = cost_model.check_resource_budget(traces, update_goldens=True)
+    warn = [f for f in fs if f.severity == "warning"]
+    assert warn and "target_device is empty" in warn[0].message
+    # setting the knob silences it
+    cfg2 = tiny_config(tpu_size=8, target_device="v5e")
+    traces2 = atrace.trace_config(cfg2, "tinypod", steps=("train",))
+    fs2 = cost_model.check_resource_budget(traces2, update_goldens=True)
+    assert not [f for f in fs2 if f.severity == "warning"]
+    # and single-device configs are exempt
+    cfg1 = tiny_config(tpu_size=1)
+    traces1 = atrace.trace_config(cfg1, "tiny1", steps=("train",))
+    fs1 = cost_model.check_resource_budget(traces1, update_goldens=True)
+    assert not [f for f in fs1 if f.severity == "warning"]
+
+
+def test_mesh_search_top_k_knob_validated():
+    assert tiny_config().mesh_search_top_k == 3
+    assert tiny_config(mesh_search_top_k=1).mesh_search_top_k == 1
+    with pytest.raises(ValueError, match="mesh_search_top_k"):
+        tiny_config(mesh_search_top_k=0)
+
+
+# -- degraded-resume suggestion (reliability/dist.py) ------------------------
+
+def test_suggest_mesh_for_degraded_world(pod_traces):
+    cfg, traces = pod_traces
+    s = mesh_search.suggest(cfg, 4, traces=traces)
+    assert s.world_size == 4
+    n = 1
+    for v in s.best.axes.values():
+        n *= v
+    assert n == 4
+    assert s.fallback.axes == axis_sizes(cfg, 4, quiet=True)
+    assert s.delta_frac <= 0.0  # the suggestion is never predicted slower
+    assert "world_size=4" in s.describe() and "ms/step" in s.describe()
+
+
+def test_dist_suggest_mesh_guards(monkeypatch, caplog):
+    from homebrewnlp_tpu.reliability import dist
+    cfg = tiny_config(tpu_size=8, train_batch_size=8)
+    s = dist.suggest_mesh(cfg, 4)
+    assert s is not None and s.world_size == 4
+    # env kill-switch
+    monkeypatch.setenv(dist.ENV_MESH_SUGGEST, "0")
+    assert dist.suggest_mesh(cfg, 4) is None
+    monkeypatch.delenv(dist.ENV_MESH_SUGGEST)
+    # a world the declared structure cannot factor degrades to None with a
+    # warning, never an exception (the resume must go on)
+    cfg2 = tiny_config(tpu_size=8, train_batch_size=8, sequence_parallel=2)
+    with caplog.at_level("WARNING"):
+        assert dist.suggest_mesh(cfg2, 3) is None
+    assert any("mesh search" in r.getMessage() for r in caplog.records)
+
+
+def test_dist_log_mesh_suggestion(caplog):
+    from homebrewnlp_tpu.reliability import dist
+    cfg = tiny_config(tpu_size=8, train_batch_size=8)
+    mesh = types.SimpleNamespace(size=4, shape={DATA_AXIS: 1, SEQ_AXIS: 1,
+                                                PIPE_AXIS: 1, MODEL_AXIS: 4})
+    with caplog.at_level("WARNING"):
+        s = dist.log_mesh_suggestion(cfg, mesh)
+    assert s is not None
+    text = " ".join(r.getMessage() for r in caplog.records)
+    assert "resuming degraded" in text and "suggest" in text
+    # a data-axis fold that dropped devices out of the mesh: the searcher
+    # factors the AVAILABLE world and the log names the unused devices
+    caplog.clear()
+    small = types.SimpleNamespace(size=4, shape={DATA_AXIS: 4, SEQ_AXIS: 1,
+                                                 PIPE_AXIS: 1,
+                                                 MODEL_AXIS: 1})
+    with caplog.at_level("WARNING"):
+        s = dist.log_mesh_suggestion(cfg, small, n_devices=8)
+    assert s is not None and s.world_size == 8
+    text = " ".join(r.getMessage() for r in caplog.records)
+    assert "left out of the built mesh" in text
+
+
+# -- supervisor wiring -------------------------------------------------------
+
+def test_supervisor_mesh_suggestion_subprocess_stub():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import supervise
+
+    sheet = [{"config": "x", "device": "v4", "hand_rank": 1,
+              "candidates": [{"axes": {"data": 2, "model": 2},
+                              "step_time_s": 0.001, "rank": 1}]}]
+
+    def fake_run(cmd, **kw):
+        assert "--world" in cmd and cmd[cmd.index("--world") + 1] == "4"
+        return types.SimpleNamespace(returncode=0,
+                                     stdout=json.dumps(sheet), stderr="")
+
+    doc = supervise.mesh_suggestion("configs/x.json", 4, run=fake_run)
+    assert doc == sheet[0]
+
+    def failing_run(cmd, **kw):
+        return types.SimpleNamespace(returncode=1, stdout="", stderr="boom")
+
+    assert supervise.mesh_suggestion("configs/x.json", 4,
+                                     run=failing_run) is None
+
+
+def test_supervise_cli_accepts_suggest_mesh_flags():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import supervise
+    args = supervise.parse_args(
+        ["--model-path", "runs/x", "--suggest-mesh-config",
+         "configs/8dev_composed_dryrun.json", "--devices-per-host", "4",
+         "--", "true"])
+    assert args.suggest_mesh_config.endswith("8dev_composed_dryrun.json")
+    assert args.devices_per_host == 4
+
+
+# -- CLI ---------------------------------------------------------------------
+
+MINI_POD_CONFIG = dict(
+    model_mode="gpt", use_video=False, use_language=True,
+    sequence_length=32, features_per_head=16, heads=4, depth=2,
+    vocab_size=64, train_batch_size=8, tpu_size=8, target_device="v5e",
+    memory_reduction_strategy="none",
+    intermediate_feed_forward_multiplier_multiplier=0.5,
+    optimizer="adam-learning_rate",
+    block_config=[{"layer": ["norm-shift-scale", "feed_forward-in:relu"]}],
+)
+
+
+def test_graftmesh_cli_check_json(tmp_path):
+    cfg_path = tmp_path / "minipod.json"
+    cfg_path.write_text(json.dumps(MINI_POD_CONFIG))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftmesh.py"),
+         "--config", str(cfg_path), "--check", "--json",
+         "--emit", str(tmp_path / "out")],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)[0]
+    assert doc["device"] == "v5e" and doc["hand_rank"] == 1
+    assert doc["objective"] == mesh_search.OBJECTIVE
+    assert len(doc["candidates"]) == 3
+    # --emit wrote the ranked sheet + the winner's golden-style files
+    emitted = sorted(os.listdir(tmp_path / "out"))
+    assert emitted == ["minipod_census.json", "minipod_mesh.json",
+                       "minipod_resources.json"]
+    win = json.load(open(tmp_path / "out" / "minipod_resources.json"))
+    assert win["mesh"] == doc["candidates"][0]["axes"]
+    assert win["steps"]["train"]["hbm"]["peak"] > 0
+
+
+def test_graftmesh_cli_rejects_unknown_free_axes():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftmesh.py"),
+         "--config", os.path.join(REPO, "configs",
+                                  "8dev_composed_dryrun.json"),
+         "--free-axes", "bogus"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 2, (proc.stdout, proc.stderr)
+    assert "unknown --free-axes" in proc.stderr
+
+
+@pytest.mark.slow
+def test_graftmesh_cli_composed_acceptance():
+    """THE acceptance bar: the committed composed dryrun's hand-written
+    mesh ranks at or above the searcher's own top pick, in one CLI run
+    (CI wraps the same command in `timeout 60`)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools/graftmesh.py"),
+         "--config", os.path.join(REPO, "configs",
+                                  "8dev_composed_dryrun.json"),
+         "--check", "--strict-check", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)[0]
+    assert doc["hand_rank"] == 1
+    assert doc["hand_mesh"] == {"data": 1, "model": 2, "pipeline": 2,
+                                "sequence_parallel": 2}

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""graftmesh: topology-aware mesh auto-search — no TPU, no XLA compile.
+
+Enumerates the DP/SP/PP/TP factorizations of a config's slice topology
+(``parallel/mesh.py::mesh_factorizations``), scores every candidate with the
+static cost model's ``static_step_times`` (plus the implicit data-parallel
+gradient all-reduce the traced jaxpr cannot show) against the config's
+``target_device``, gates each candidate on that device's HBM capacity
+(OOM-before-compile), and prints the ranked sheet with the committed
+hand-written mesh marked.  By default the sequence/pipeline axes stay pinned
+to the config's declared structure (one abstract trace prices every
+candidate); ``--free-axes sequence_parallel,pipeline`` widens the search and
+re-traces per structure (seconds each).  See docs/static_analysis.md
+"Mesh search".
+
+Usage:
+  python tools/graftmesh.py --config configs/8dev_composed_dryrun.json
+  python tools/graftmesh.py --config configs/32big_mixer.json --device v4
+  python tools/graftmesh.py --config configs/x.json --world 4     # degraded
+  python tools/graftmesh.py --config configs/x.json \
+      --free-axes sequence_parallel,pipeline
+  python tools/graftmesh.py --all-configs --check --json
+  python tools/graftmesh.py --config configs/x.json --emit out/   # goldens
+
+Exit code: 0 ok; 1 when --check fails (a hand-written mesh ranks below its
+config's mesh_search_top_k — with --strict-check, below the searcher's own
+top pick) or when any config fails to load/trace; 2 on usage errors.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# same virtual mesh as graftcheck/graftcost so traces are reproducible
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--config", action="append", default=[],
+                   help="config JSON to search (repeatable)")
+    p.add_argument("--all-configs", action="store_true")
+    p.add_argument("--world", type=int, default=0,
+                   help="device count to factor (default: the config's "
+                        "tpu_size) — the degraded-resume question")
+    p.add_argument("--device", default="",
+                   help="device kind to score on (default: the config's "
+                        "target_device, else the default verdict device)")
+    p.add_argument("--free-axes", default="",
+                   help="comma list of structural axes to unlock "
+                        "(sequence_parallel,pipeline); each distinct "
+                        "structure re-traces")
+    p.add_argument("--top-k", type=int, default=0,
+                   help="override the config's mesh_search_top_k for "
+                        "--check")
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 unless every hand-written mesh ranks "
+                        "within top-k")
+    p.add_argument("--strict-check", action="store_true",
+                   help="with --check: the hand mesh must rank at or above "
+                        "the searcher's own top pick (rank 1, ties count)")
+    p.add_argument("--emit", default="",
+                   help="directory to write the winning mesh's ranked "
+                        "sheet + resources/census golden-style JSON into")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    return p.parse_args(argv)
+
+
+def _sheet_text(result) -> str:
+    from homebrewnlp_tpu.analysis.cost_model import format_bytes
+    lines = [f"\n== {result.config_name}  ({result.n_devices} devices, "
+             f"scored on {result.device_kind}"
+             + (f", free axes: {','.join(result.free_axes)}"
+                if result.free_axes else "") + ")"]
+    for c in result.candidates:
+        mark = "  <- hand-written" if c.is_hand else ""
+        fit = "" if c.fits else "  [OOM]"
+        lines.append(
+            f"  #{c.rank:<2d} {c.describe():28s} "
+            f"step {c.step_s * 1e3:9.4f} ms  (ici "
+            f"{c.predicted.get('ici_s', 0.0) * 1e3:8.4f} ms, peak "
+            f"{format_bytes(c.hbm_peak, width=7)}/dev)"
+            f"{fit}{mark}")
+    for c in result.skipped:
+        lines.append(f"  --  {c.axes}: skipped ({c.error})")
+    lines.append(f"  hand-written mesh rank: #{result.hand_rank} of "
+                 f"{len(result.candidates)}")
+    return "\n".join(lines)
+
+
+def _emit(result, traces, raw, out_dir: str) -> None:
+    """Write the ranked sheet plus golden-style resources/census JSON for
+    the winning mesh (what committing the searched layout would pin)."""
+    from homebrewnlp_tpu.analysis import trace_config
+    from homebrewnlp_tpu.analysis.cost_model import step_resources
+    from homebrewnlp_tpu.analysis.graph_rules import _IntendedMesh, census_of
+    from homebrewnlp_tpu.config import Config
+    os.makedirs(out_dir, exist_ok=True)
+    base = os.path.join(out_dir, result.config_name)
+    with open(base + "_mesh.json", "w") as f:
+        json.dump(result.as_json(), f, indent=2, sort_keys=True)
+        f.write("\n")
+    top = result.top
+    if top.retraced:
+        # a free-axes winner runs a DIFFERENT program than the declared
+        # structure — its goldens must come from a trace of that program,
+        # not from the anchor trace search() only kept the scores of
+        win_raw = dict(raw)
+        win_raw.pop("_comment", None)
+        win_raw["sequence_parallel"] = top.axes["sequence_parallel"]
+        win_raw["pipeline_parallel"] = top.axes["pipeline"]
+        traces = trace_config(Config(win_raw), result.config_name,
+                              steps=tuple(traces.steps) or ("train",))
+    imesh = _IntendedMesh(dict(top.axes))
+    steps = {}
+    for name, st in sorted(traces.steps.items()):
+        steps[name] = step_resources(traces, name, st, imesh,
+                                     result.device_kind).as_golden()
+    with open(base + "_resources.json", "w") as f:
+        json.dump({"config": result.config_name,
+                   "mesh": {k: int(v) for k, v in sorted(top.axes.items())},
+                   "steps": steps}, f, indent=2, sort_keys=True)
+        f.write("\n")
+    with open(base + "_census.json", "w") as f:
+        json.dump({"config": result.config_name,
+                   "mesh": {k: int(v) for k, v in sorted(top.axes.items())},
+                   "steps": {name: census_of(st) for name, st
+                             in sorted(traces.steps.items())}},
+                  f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"[graftmesh] wrote {base}_mesh.json + winner resources/census "
+          f"goldens", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    config_paths = list(args.config)
+    if args.all_configs:
+        config_paths += sorted(glob.glob(os.path.join(REPO, "configs",
+                                                      "*.json")))
+    if not config_paths:
+        print("nothing to do: pass --config or --all-configs",
+              file=sys.stderr)
+        return 2
+    free_axes = tuple(a.strip() for a in args.free_axes.split(",")
+                      if a.strip())
+    unknown = sorted(set(free_axes) - {"sequence_parallel", "pipeline"})
+    if unknown:
+        print(f"unknown --free-axes {', '.join(unknown)}; valid: "
+              f"sequence_parallel, pipeline", file=sys.stderr)
+        return 2
+
+    import contextlib
+
+    from homebrewnlp_tpu.analysis import mesh_search, trace_config
+    from homebrewnlp_tpu.config import Config
+    results = []
+    failed = []
+    t0 = time.time()
+    quiet = (contextlib.redirect_stdout(sys.stderr) if args.as_json
+             else contextlib.nullcontext())
+    for path in config_paths:
+        name = os.path.splitext(os.path.basename(path))[0]
+        with open(path) as f:
+            raw = json.load(f)
+        raw.pop("_comment", None)
+        with quiet:
+            try:
+                cfg = Config(dict(raw))
+            except Exception as e:
+                print(f"[graftmesh] {name}: config failed to load "
+                      f"({type(e).__name__}: {e})", file=sys.stderr)
+                failed.append(name)
+                continue
+            if max(cfg.tpu_size, 1) <= 1 and not args.world:
+                print(f"[graftmesh] {name}: tpu_size=1 — nothing to "
+                      f"factor (pass --world N to search anyway)",
+                      file=sys.stderr)
+                continue
+            # quiet: a config whose heads cannot factor the local virtual
+            # mesh would otherwise print the very fold warning this tool
+            # supersedes into its own ranked sheet
+            traces = trace_config(cfg, name, steps=("train",), quiet=True)
+            if "train" not in traces.steps:
+                print(f"[graftmesh] {name}: train step failed to trace "
+                      f"({traces.errors.get('train', '?')})",
+                      file=sys.stderr)
+                failed.append(name)
+                continue
+            try:
+                result = mesh_search.search(
+                    cfg, name, n_devices=args.world or None,
+                    device_kind=args.device, traces=traces, raw=raw,
+                    free_axes=free_axes)
+            except ValueError as e:
+                print(f"[graftmesh] {name}: {e}", file=sys.stderr)
+                return 2
+        results.append(result.as_json())
+        if not args.as_json:
+            print(_sheet_text(result))
+        if args.emit:
+            with quiet:
+                _emit(result, traces, raw, args.emit)
+        top_k = args.top_k or cfg.mesh_search_top_k
+        bar = 1 if args.strict_check else top_k
+        if args.check and result.hand_rank > bar:
+            failed.append(name)
+            print(f"[graftmesh] CHECK FAILED: {name} hand-written mesh "
+                  f"ranks #{result.hand_rank} (> {bar}); searcher prefers "
+                  f"{{{result.top.describe()}}}", file=sys.stderr)
+    if args.as_json:
+        print(json.dumps(results, indent=2))
+    else:
+        print(f"\n[graftmesh] total {time.time() - t0:.1f}s", file=sys.stderr)
+    if failed:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

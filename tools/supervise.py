@@ -489,9 +489,16 @@ class Supervisor:
                  fleet: typing.Optional[FleetCoordinator] = None,
                  terminate: typing.Optional[
                      typing.Callable[[], None]] = None,
-                 rank: int = 0):
+                 rank: int = 0,
+                 suggest_mesh: typing.Optional[
+                     typing.Callable[[int], typing.Any]] = None):
         self.launch = launch
         self.progress = progress
+        # called with the surviving rank count when a fleet barrier expires
+        # and the relaunch proceeds DEGRADED — wired to the mesh searcher
+        # (mesh_suggestion below) so the log carries a searched layout for
+        # the shrunken world instead of only the old fold warning
+        self.suggest_mesh = suggest_mesh
         # every supervisor series carries this host's rank: N supervisors
         # sharing one fleet (or registry, or scrape target) must render N
         # distinguishable series, not N colliding unlabeled ones
@@ -595,6 +602,14 @@ class Supervisor:
         others = {r: c for r, c in peers.items() if r != self.fleet.rank}
         LOG.info("fleet generation %d complete: own exit %d, peers %s",
                  self.fleet.generation, rc, others or "(none posted)")
+        if len(peers) < self.fleet.world_size and self.suggest_mesh is not None:
+            # DEGRADED relaunch: some rank never posted readiness — consult
+            # the mesh searcher for the shrunken world before relaunching,
+            # best-effort (the suggestion is a log line, never a blocker)
+            try:
+                self.suggest_mesh(len(peers))
+            except Exception as e:
+                LOG.warning("degraded-resume mesh suggestion failed: %r", e)
         self.fleet.advance()
 
     def run(self) -> int:
@@ -695,6 +710,41 @@ class Supervisor:
                 self._fleet_barrier(rc)
 
 
+def mesh_suggestion(config_path: str, world_devices: int, *,
+                    run: typing.Callable = subprocess.run,
+                    timeout_s: float = 180.0) -> typing.Optional[dict]:
+    """Ask the mesh searcher for the degraded world's layout — in a
+    SUBPROCESS (tools/graftmesh.py), because the supervisor must stay
+    loadable on a broken jax install.  Best-effort: logs the searcher's
+    top pick + the hand mesh's rank and returns the parsed sheet, or None
+    (with a warning) on any failure."""
+    cmd = [sys.executable,
+           os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "graftmesh.py"),
+           "--config", config_path, "--world", str(int(world_devices)),
+           "--json"]
+    try:
+        r = run(cmd, capture_output=True, text=True, timeout=timeout_s)
+        docs = json.loads(r.stdout) if (r.stdout or "").strip() else []
+        if r.returncode != 0 or not docs:
+            raise RuntimeError(f"rc={r.returncode}: "
+                               f"{(r.stderr or '')[-500:]}")
+        doc = docs[0]
+        top = doc["candidates"][0]
+        LOG.warning(
+            "fleet degraded to %d device(s): mesh search suggests %s "
+            "(predicted %.3f ms/step on %s; hand-written mesh ranks #%d) "
+            "— %s", world_devices, top["axes"],
+            top["step_time_s"] * 1e3, doc["device"], doc["hand_rank"],
+            config_path)
+        return doc
+    except Exception as e:
+        LOG.warning("degraded-resume mesh suggestion unavailable "
+                    "(%s: %s); the child will fold axes as before",
+                    type(e).__name__, e)
+        return None
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -738,6 +788,15 @@ def parse_args(argv=None):
                    help="seconds to hold the fleet relaunch barrier for a "
                         "peer supervisor's exit posting before relaunching "
                         "degraded without it")
+    p.add_argument("--suggest-mesh-config", type=str, default="",
+                   help="config JSON to run the mesh searcher on when a "
+                        "fleet relaunch proceeds DEGRADED (tools/"
+                        "graftmesh.py in a subprocess; logs the searched "
+                        "layout for the shrunken world)")
+    p.add_argument("--devices-per-host", type=int, default=1,
+                   help="accelerator devices each rank contributes — "
+                        "scales the surviving rank count into the device "
+                        "world the mesh searcher factors")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command after '--'")
     args = p.parse_args(argv)
@@ -801,7 +860,12 @@ def main(argv=None) -> int:
         max_restarts=args.max_restarts,
         metrics_path=os.path.join(args.model_path,
                                   "supervisor_metrics.prom"),
-        fleet=fleet, terminate=launcher.terminate, rank=args.rank)
+        fleet=fleet, terminate=launcher.terminate, rank=args.rank,
+        suggest_mesh=(
+            (lambda ranks: mesh_suggestion(
+                args.suggest_mesh_config,
+                ranks * max(1, args.devices_per_host)))
+            if args.suggest_mesh_config else None))
     server = None
     if args.obs_port and fleet is not None:
         # fleet mode: serve the FEDERATED view — per-rank child +
