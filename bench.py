@@ -162,12 +162,17 @@ SERVE_GOODPUT_RATIO = 2.0 / 3.0
 SERVE_SHAPE_OVERRIDDEN = any(
     os.environ.get(k) for k in
     ("HBNLP_BENCH_SERVE_CONFIG", "HBNLP_BENCH_SERVE_REQUESTS",
-     "HBNLP_BENCH_SERVE_CONCURRENCY", "HBNLP_BENCH_SERVE_RESPONSE_LEN"))
+     "HBNLP_BENCH_SERVE_CONCURRENCY", "HBNLP_BENCH_SERVE_RESPONSE_LEN",
+     "HBNLP_BENCH_SERVE_MAX_BATCH"))
 SERVE_CONFIG = os.environ.get("HBNLP_BENCH_SERVE_CONFIG", "32big_mixer")
 SERVE_REQUESTS = int(os.environ.get("HBNLP_BENCH_SERVE_REQUESTS", "24"))
 SERVE_CONCURRENCY = int(os.environ.get("HBNLP_BENCH_SERVE_CONCURRENCY", "4"))
 SERVE_RESPONSE_LEN = int(os.environ.get("HBNLP_BENCH_SERVE_RESPONSE_LEN",
                                         "16"))
+#: decode lanes for the serving row's continuous-batching engine
+#: (docs/observability.md "Continuous batching"); 1 = the pre-engine
+#: serialized path (what the committed baselines were measured under)
+SERVE_MAX_BATCH = int(os.environ.get("HBNLP_BENCH_SERVE_MAX_BATCH", "4"))
 
 # Peak table + MFU arithmetic shared with the LIVE utilization accounting
 # (homebrewnlp_tpu/train/flops.py): bench's offline mfu and the run's
@@ -774,9 +779,24 @@ def bench_serving() -> dict:
     (``e2e_p50_s``, ``goodput_tok_s``) are written into the row BEFORE the
     server-scrape/reconcile sub-sections, each of which is contained — a
     scrape failure lands in ``server.error`` without dropping the gate."""
+    import shutil
     import sys
+    import tempfile
     sys.path.insert(0, os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tools"))
+    t0 = time.perf_counter()
+    # the continuous-batching engine serves the row by default
+    # (serve_max_batch lanes, AOT executables cached in a fresh dir so
+    # one run measures BOTH the cold compile and the warm reload); 1 =
+    # the pre-engine serialized path
+    aot_dir = tempfile.mkdtemp(prefix="hbnlp_aot_")
+    try:
+        return _bench_serving_inner(aot_dir, t0)
+    finally:
+        shutil.rmtree(aot_dir, ignore_errors=True)
+
+
+def _bench_serving_inner(aot_dir: str, t0: float) -> dict:
     import graftload
 
     from homebrewnlp_tpu.models import init_params
@@ -784,17 +804,20 @@ def bench_serving() -> dict:
     from homebrewnlp_tpu.serve import RestAPI, serve
     from homebrewnlp_tpu.utils import load_config, random_text_batch
 
-    t0 = time.perf_counter()
     cfg = load_config(f"configs/{SERVE_CONFIG}.json", **_COMMON,
-                      train_batch_size=1)
+                      train_batch_size=1, serve_max_batch=SERVE_MAX_BATCH,
+                      serve_aot_cache_dir=aot_dir if SERVE_MAX_BATCH > 1
+                      else "")
     params, _ = init_params(cfg, random_text_batch(cfg))
     # a dedicated registry: the serving histograms this row reconciles
     # against must contain exactly this run's requests, not the training
     # workloads' REST leftovers
     reg = MetricsRegistry()
+    t_engine0 = time.perf_counter()
     api = RestAPI(cfg, params)
     server = serve(cfg, None, port=0, background=True, registry=reg,
                    obs_port=0, api=api)
+    cold = {}
     try:
         url = f"http://127.0.0.1:{server.server_address[1]}"
         murl = f"http://127.0.0.1:{server._obs_server.server_address[1]}"
@@ -806,6 +829,12 @@ def bench_serving() -> dict:
         # percentiles are honest; timed apart as compile_and_warmup_s
         api.wrapper.complete([1, 2, 3], 0.0, SERVE_RESPONSE_LEN)
         compile_and_warmup_s = time.perf_counter() - t0
+        # cold start (engine build -> first token served), split into the
+        # engine's own compile vs AOT-reload accounting when available
+        cold["cold_start_s"] = round(time.perf_counter() - t_engine0, 3)
+        for k in ("compile_s", "aot_reload_s", "aot_cache_hit"):
+            v = getattr(api.engine, k, None)
+            cold[k] = round(v, 3) if isinstance(v, float) else v
         report = graftload.drive(
             url, metrics_url=murl, n_requests=SERVE_REQUESTS,
             concurrency=SERVE_CONCURRENCY, vocab=cfg.vocab_size,
@@ -818,6 +847,26 @@ def bench_serving() -> dict:
         # full serving-config weights) through every later bench section
         # unless told to exit
         api.wrapper.close()
+    if SERVE_MAX_BATCH > 1 and cold.get("compile_s") is not None:
+        # second server start against the populated AOT cache: the replica
+        # autoscaling number — deserialization must beat compilation
+        # (contained: a probe failure lands in cold["error"], the row and
+        # its core figures survive)
+        try:
+            from homebrewnlp_tpu.serve.engine import BatchEngine
+            t1 = time.perf_counter()
+            e2 = BatchEngine(cfg, params)
+            e2.complete_tokens([1, 2, 3], 0.0, SERVE_RESPONSE_LEN)
+            cold["warm_start_s"] = round(time.perf_counter() - t1, 3)
+            cold["aot_reload_s"] = (round(e2.aot_reload_s, 3)
+                                    if e2.aot_reload_s is not None else None)
+            cold["aot_cache_hit"] = e2.aot_cache_hit
+            e2.close()
+        except Exception as e:  # noqa: BLE001
+            # NOT "error": that key at row top level flips the serve_ok
+            # gate, and a failed warm-start probe must not sink a row whose
+            # core serving figures are healthy
+            cold["warm_probe_error"] = f"{type(e).__name__}: {e}"[:200]
     c = report["client"]
     e2e = c.get("e2e_s") or {}
     row = {
@@ -835,14 +884,17 @@ def bench_serving() -> dict:
         "n_rejected": c.get("n_rejected"),
         "concurrency": SERVE_CONCURRENCY,
         "response_len": SERVE_RESPONSE_LEN,
+        "serve_max_batch": SERVE_MAX_BATCH,
         "compile_and_warmup_s": round(compile_and_warmup_s, 1),
     }
+    row.update(cold)
     srv = report.get("server") or {}
     if isinstance(srv, dict) and "error" not in srv:
         for key, out_key in (("ttft_s", "ttft"), ("queue_wait_s",
                                                   "queue_wait"),
                              ("engine_s", "engine"),
-                             ("decode_tokens_per_sec", "decode_rate")):
+                             ("decode_tokens_per_sec", "decode_rate"),
+                             ("batch_size", "batch_size")):
             if isinstance(srv.get(key), dict):
                 row[f"{out_key}_p50"] = srv[key].get("p50")
                 row[f"{out_key}_p95"] = srv[key].get("p95")
@@ -881,6 +933,16 @@ def evaluate_serve_baseline(row: dict, baseline: dict,
         passed = bool(ratio >= min_goodput_ratio)
         out["goodput"] = {"baseline_tok_s": base_good,
                           "ratio": round(ratio, 3), "pass": passed}
+        ok = ok and passed
+    # cold-start ratchet (continuous-batching PR): once a baseline has
+    # recorded cold_start_s, a later round may not regress it past the
+    # latency ratio — AOT reload keeps replica cold starts in seconds
+    cold, base_cold = row.get("cold_start_s"), baseline.get("cold_start_s")
+    if isinstance(cold, (int, float)) and base_cold:
+        ratio = cold / base_cold
+        passed = bool(ratio <= max_latency_ratio)
+        out["cold_start"] = {"baseline_s": base_cold,
+                             "ratio": round(ratio, 3), "pass": passed}
         ok = ok and passed
     return (out or None), ok
 
@@ -1059,6 +1121,16 @@ def main() -> None:
                 dev_serve.update({
                     "e2e_p50_s": srow["e2e_p50_s"],
                     "goodput_tok_s": srow.get("goodput_tok_s"),
+                    # continuous-batching figures self-record so the NEXT
+                    # round ratchets them (cold start + the serialization
+                    # overhead the engine exists to collapse)
+                    "queue_wait_p50_s": srow.get("queue_wait_p50"),
+                    "serialization_overhead_s": srow.get(
+                        "serialization_overhead_s"),
+                    "cold_start_s": srow.get("cold_start_s"),
+                    "compile_s": srow.get("compile_s"),
+                    "aot_reload_s": srow.get("aot_reload_s"),
+                    "serve_max_batch": srow.get("serve_max_batch"),
                     "shape": shape,
                     "recorded": time.time()})
                 with open(SERVE_BASELINE_FILE, "w") as f:

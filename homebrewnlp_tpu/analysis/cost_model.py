@@ -213,9 +213,16 @@ def _batch_bytes(cfg, imesh) -> typing.Tuple[int, typing.List[ScaledBytes]]:
 
 def _kv_bytes(traces: ConfigTraces, imesh
               ) -> typing.Tuple[int, typing.List[ScaledBytes]]:
-    """Per-device KV-cache bytes for the decode trace's batch-of-1 anchor;
-    scales linearly in batch x context by construction."""
-    from ..infer.kv_cache import cache_shapes
+    """Per-device bytes of the serving KV POOL: the block allocator's
+    geometry — ``pool_blocks x block_rows`` rows (infer/kv_cache.py, the
+    continuous-batching engine's fixed-capacity pool) — times per-row
+    cache bytes.  At the default serve knobs (one lane, whole-sequence
+    blocks) this is exactly the decode trace's batch-of-1 monolithic
+    cache; ``serve_max_batch``/``serve_kv_blocks`` scale it to the pool
+    the engine actually allocates.  Scales linearly in batch x context by
+    construction."""
+    from ..infer.kv_cache import (block_rows, cache_eligible, cache_shapes,
+                                  pool_blocks)
     cfg = traces.cfg
     params = traces.param_shapes
     if cfg.pipeline_parallel > 1:
@@ -225,12 +232,21 @@ def _kv_bytes(traces: ConfigTraces, imesh
             params = jax.eval_shape(
                 lambda p: unstack_pipeline_params(cfg, p), params)
     shapes = cache_shapes(cfg, params, 1)
+    seq_rows = max(1, cfg.sequence_length // cfg.token_patch_size)
+    # price the pool only where the batch engine actually allocates one
+    # (serve_max_batch > 1 on an eligible stack — serve/engine.py's
+    # use_batch_engine gate); the serialized path allocates the monolithic
+    # batch-1 cache per call regardless of the pool knobs
+    if getattr(cfg, "serve_max_batch", 1) > 1 and cache_eligible(cfg):
+        pool_factor = pool_blocks(cfg) * block_rows(cfg) / seq_rows
+    else:
+        pool_factor = 1.0
     total = 0.0
     scaled: typing.List[ScaledBytes] = []
     for kv in shapes.values():
         for sds in kv:
             div = activation_divisor(sds.shape, cfg, imesh)
-            b = aval_nbytes(sds) / div
+            b = aval_nbytes(sds) / div * pool_factor
             total += b
             c = classify_shape(sds.shape, b, cfg)
             # every cache row is per generated position and per sequence:

@@ -376,6 +376,27 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # immediately (REST: 503 + Retry-After) without waiting out the
     # deadline; 0 = unbounded queue
     serve_queue_limit=0,
+    # continuous-batching engine (docs/observability.md "Continuous
+    # batching").  serve_max_batch: decode lanes sharing one persistent
+    # decode loop — >1 replaces the serialized InterfaceWrapper with the
+    # serve/engine.py scheduler (requests admitted BETWEEN decode steps);
+    # 1 (default) keeps the reference-shaped serialized path bit-identical
+    serve_max_batch=1,
+    # serve_block_tokens: tokens per KV-pool block (must be a multiple of
+    # token_patch_size so blocks hold whole decode rows); 0 = one
+    # whole-sequence block per lane, which makes the pool byte-identical
+    # to the monolithic per-lane cache
+    serve_block_tokens=0,
+    # serve_kv_blocks: total blocks in the fixed-capacity KV pool shared
+    # by all lanes — admission takes a request's whole block footprint up
+    # front and recycles it on completion; 0 = auto
+    # (serve_max_batch x blocks-per-sequence, i.e. the physical pool)
+    serve_kv_blocks=0,
+    # serve_aot_cache_dir: directory for serialized prefill/decode
+    # executables keyed by config hash + mesh + toolchain — a second
+    # server start deserializes instead of re-compiling (cold start in
+    # seconds, not minutes); "" = AOT executable serialization off
+    serve_aot_cache_dir="",
     equal_debugging_items_per_check=16,
     debug_sample=False,
     default_sleep_duration=0.1,
@@ -468,6 +489,39 @@ class Config:
             raise ValueError("serve_queue_limit must be >= 0 "
                              "(0 = unbounded engine queue)")
         self.serve_queue_limit = int(self.serve_queue_limit)
+        if int(self.serve_max_batch) < 1:
+            raise ValueError("serve_max_batch must be >= 1 (1 = the "
+                             "serialized reference-shaped engine; >1 = the "
+                             "continuous-batching scheduler)")
+        self.serve_max_batch = int(self.serve_max_batch)
+        if int(self.serve_block_tokens) < 0:
+            raise ValueError("serve_block_tokens must be >= 0 "
+                             "(0 = one whole-sequence block per lane)")
+        self.serve_block_tokens = int(self.serve_block_tokens)
+        if (self.serve_block_tokens
+                and self.serve_block_tokens % self.token_patch_size):
+            raise ValueError(
+                f"serve_block_tokens={self.serve_block_tokens} must be a "
+                f"multiple of token_patch_size={self.token_patch_size} "
+                "(KV-pool blocks hold whole decode rows)")
+        if int(self.serve_kv_blocks) < 0:
+            raise ValueError("serve_kv_blocks must be >= 0 "
+                             "(0 = auto: serve_max_batch x blocks per "
+                             "sequence)")
+        self.serve_kv_blocks = int(self.serve_kv_blocks)
+        if self.serve_kv_blocks:
+            # the pool must admit at least one full-length request, or every
+            # completion sheds at admission forever — surface the dead pool
+            # at config load, not in production 503s
+            from .infer.kv_cache import blocks_per_sequence
+            need = blocks_per_sequence(self)
+            if self.serve_kv_blocks < need:
+                raise ValueError(
+                    f"serve_kv_blocks={self.serve_kv_blocks} cannot hold one "
+                    f"full-length sequence ({need} blocks of "
+                    f"{self.serve_block_tokens or self.sequence_length} "
+                    "tokens); raise serve_kv_blocks or serve_block_tokens")
+        self.serve_aot_cache_dir = str(self.serve_aot_cache_dir or "")
         if self.watchdog_factor < 0:
             raise ValueError("watchdog_factor must be >= 0 "
                              "(0 = watchdog disabled)")

@@ -135,6 +135,120 @@ def init_caches(cfg: Config, params: dict, batch_size: int,
             for k, kv in shapes.items()}
 
 
+def block_rows(cfg: Config) -> int:
+    """Decode rows (``token_patch_size`` tokens each) per KV-pool block.
+    ``serve_block_tokens=0`` means one whole-sequence block, which makes
+    the pool byte-identical to the monolithic per-lane cache."""
+    rows = cfg.sequence_length // cfg.token_patch_size
+    if not getattr(cfg, "serve_block_tokens", 0):
+        return rows
+    return max(1, min(rows, cfg.serve_block_tokens // cfg.token_patch_size))
+
+
+def blocks_per_sequence(cfg: Config) -> int:
+    """Blocks a full-length request occupies (admission takes the whole
+    footprint up front — the engine never grows a request mid-decode)."""
+    rows = cfg.sequence_length // cfg.token_patch_size
+    return -(-rows // block_rows(cfg))
+
+
+def pool_blocks(cfg: Config) -> int:
+    """Effective pool capacity in blocks: ``serve_kv_blocks`` when set,
+    else the physical pool (``serve_max_batch`` lanes x blocks/sequence)."""
+    return (getattr(cfg, "serve_kv_blocks", 0)
+            or getattr(cfg, "serve_max_batch", 1) * blocks_per_sequence(cfg))
+
+
+def pool_shapes(cfg: Config, params: dict,
+                seq: typing.Optional[int] = None) -> typing.Dict[str, tuple]:
+    """Abstract shapes of the engine's pooled caches — ``cache_shapes`` at
+    a batch of ``serve_max_batch`` lanes (``params`` may be
+    ShapeDtypeStructs; nothing runs)."""
+    return cache_shapes(cfg, params, getattr(cfg, "serve_max_batch", 1), seq)
+
+
+def pool_nbytes(cfg: Config, params: dict,
+                seq: typing.Optional[int] = None) -> int:
+    """Bytes of the block-allocated KV pool under the serve knobs: the
+    allocator's block geometry (``pool_blocks x block_rows``) times the
+    per-row cache bytes summed over layers — the ``kv`` term the static
+    cost model prices for serving (analysis/cost_model.py).  Defaults
+    (one lane, whole-sequence blocks) equal the monolithic batch-1 cache
+    exactly."""
+    rows = (cfg.sequence_length // cfg.token_patch_size if seq is None
+            else int(seq))
+    per_row = cache_nbytes(cache_shapes(cfg, params, 1, rows)) / max(1, rows)
+    return int(round(pool_blocks(cfg) * block_rows(cfg) * per_row))
+
+
+class BlockAllocator:
+    """Fixed-capacity KV-pool accountant (docs/observability.md
+    "Continuous batching"): ``n_blocks`` blocks of ``block_tokens`` tokens,
+    handed out per request at ADMISSION (the whole footprint — prompt +
+    response — is known up front, so a request never grows mid-decode) and
+    recycled on completion.  Blocks are fungible — any block serves any
+    lane — so the free list cannot fragment: an allocation succeeds iff
+    enough blocks are free, regardless of the alloc/free history.
+
+    Thread-safe: the scheduler thread allocates/frees while the admission
+    path and the ``hbnlp_serve_kv_blocks_free`` gauge probe read."""
+
+    def __init__(self, n_blocks: int, block_tokens: int):
+        if n_blocks < 1:
+            raise ValueError("BlockAllocator needs n_blocks >= 1")
+        if block_tokens < 1:
+            raise ValueError("BlockAllocator needs block_tokens >= 1")
+        self.n_blocks = int(n_blocks)
+        self.block_tokens = int(block_tokens)
+        import threading
+        self._lock = threading.Lock()
+        # LIFO free list: a finishing request's blocks go straight to the
+        # next admission (warm reuse), and ids stay stable for tests
+        self._free = list(range(self.n_blocks - 1, -1, -1))
+        self._held: typing.Dict[typing.Hashable, typing.Tuple[int, ...]] = {}
+
+    def blocks_needed(self, tokens: int) -> int:
+        return max(1, -(-max(0, int(tokens)) // self.block_tokens))
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def held(self, owner: typing.Hashable) -> typing.Tuple[int, ...]:
+        with self._lock:
+            return self._held.get(owner, ())
+
+    def fits(self, tokens: int) -> bool:
+        """Whether a ``tokens``-long request could EVER be admitted (its
+        footprint fits the whole pool) — the admission path sheds
+        impossible requests immediately instead of queueing them forever."""
+        return self.blocks_needed(tokens) <= self.n_blocks
+
+    def alloc(self, owner: typing.Hashable, tokens: int
+              ) -> typing.Optional[typing.Tuple[int, ...]]:
+        """Take ``blocks_needed(tokens)`` blocks for ``owner``; None when
+        the pool is too empty right now (caller keeps the request queued).
+        One live allocation per owner."""
+        need = self.blocks_needed(tokens)
+        with self._lock:
+            if owner in self._held:
+                raise ValueError(f"owner {owner!r} already holds blocks")
+            if need > len(self._free):
+                return None
+            ids = tuple(self._free.pop() for _ in range(need))
+            self._held[owner] = ids
+            return ids
+
+    def free(self, owner: typing.Hashable) -> int:
+        """Recycle ``owner``'s blocks; returns how many came back (0 for
+        an unknown owner — freeing twice is a no-op, not a leak)."""
+        with self._lock:
+            ids = self._held.pop(owner, ())
+            self._free.extend(ids)
+            return len(ids)
+
+
 def make_cached_text_sampler(cfg: Config, params: dict,
                              first_token_callback: typing.Optional[
                                  typing.Callable] = None):

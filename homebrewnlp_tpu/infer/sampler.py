@@ -87,6 +87,52 @@ def _gumbel_argmax(logits: jnp.ndarray, temperature, key: jax.Array,
     return jnp.argmax(logits + temperature * gumbel, axis=-1).astype(jnp.int32)
 
 
+def _truncate_logits_lanes(logits: jnp.ndarray, top_k: jnp.ndarray,
+                           top_p: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane TRACED truncation for the continuous-batching decode step
+    (serve/engine.py): ``logits`` [lanes, ...], ``top_k`` int32 [lanes]
+    (<=0 disables), ``top_p`` f32 [lanes] (>=1 disables).  Unlike
+    :func:`_truncate_logits` the knobs are traced operands, so ONE
+    compilation serves every request mix — at the cost of always paying
+    the full descending sort (the k-th-threshold fast path needs a static
+    k).  Semantics match the static path: temper first, cut, ties kept."""
+    vocab = logits.shape[-1]
+    side = (logits.shape[0],) + (1,) * (logits.ndim - 1)
+    k = jnp.where(top_k > 0, top_k, vocab).reshape(side)
+    p = top_p.astype(jnp.float32).reshape(side)
+    desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    desc = jnp.where(jnp.arange(vocab) < k, desc, -jnp.inf)
+    probs = jax.nn.softmax(desc, axis=-1)  # k-masked entries carry 0 mass
+    keep = ((((jnp.cumsum(probs, axis=-1) - probs) < p) | (p >= 1.0))
+            & jnp.isfinite(desc))
+    thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, -jnp.inf, logits)
+
+
+def _gumbel_argmax_lanes(logits: jnp.ndarray, temperature: jnp.ndarray,
+                         key: jax.Array, top_k: jnp.ndarray,
+                         top_p: jnp.ndarray) -> jnp.ndarray:
+    """:func:`_gumbel_argmax` with per-lane traced (temperature, top_k,
+    top_p) [lanes] vectors — the batched decode step samples every lane's
+    row under its own request's knobs in one compilation.  Lane
+    temperature 0 stays exact greedy for that lane."""
+    logits = logits.astype(jnp.float32)
+    side = (logits.shape[0],) + (1,) * (logits.ndim - 1)
+    t = temperature.astype(jnp.float32).reshape(side)
+    u = jax.random.uniform(key, logits.shape, jnp.float32, 1e-9, 1.0)
+    gumbel = -jnp.log(-jnp.log(u))
+    hot = (t > 0).astype(jnp.float32)
+    tempered = logits / jnp.where(t > 0, t, 1.0)
+    # the full-vocab sort only runs when SOME lane actually truncates —
+    # both cond branches live in the one compilation, so the default
+    # operating point (no truncation anywhere) skips the sort at runtime
+    logits = jax.lax.cond(
+        jnp.any((top_k > 0) | (top_p < 1.0)),
+        lambda x: _truncate_logits_lanes(x, top_k, top_p),
+        lambda x: x, tempered)
+    return jnp.argmax(logits + hot * gumbel, axis=-1).astype(jnp.int32)
+
+
 def _fire_first_token(callback, tag, fire: jnp.ndarray, token: jnp.ndarray
                       ) -> None:
     """Host-notify the first sampled token (docs/observability.md "Serving

@@ -152,8 +152,19 @@ def positional_embed(args: Args, dim: str, size: int,
     out = embed(args, [(dim, full)] + list(fdims))
     if sliced:
         ax = out.names.index(dim)
-        out = NT(jax.lax.dynamic_slice_in_dim(out.x, dc.pos, size, ax),
-                 out.names)
+        if jnp.ndim(dc.pos):
+            # per-lane positions (continuous batching, serve/engine.py):
+            # lane b reads its own rows [pos[b], pos[b]+size) — jnp.take
+            # clips out-of-range rows, matching dynamic_slice's clamping.
+            # The gathered table gains the caller's batch axis, which the
+            # NT name-broadcast aligns with the activations downstream.
+            rows = dc.pos[:, None] + jnp.arange(size)
+            lane = args.tensor.names[0]
+            out = NT(jnp.take(out.x, rows, axis=ax),
+                     out.names[:ax] + (lane,) + out.names[ax:])
+        else:
+            out = NT(jax.lax.dynamic_slice_in_dim(out.x, dc.pos, size, ax),
+                     out.names)
     return out
 
 

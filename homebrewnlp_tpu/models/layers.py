@@ -470,35 +470,64 @@ def _cached_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
     cache_id = f"attn{ctx.attention_idx}"
     v_cur = val.transpose_to(order).x.astype(cdtype)   # [b, R, h, dk]
     n_rows = v_cur.shape[1]
+    # ``dc.pos`` is a scalar (one shared position — the serialized samplers
+    # and the engine's prefill) or a [batch] vector (per-lane positions —
+    # the continuous-batching decode step, serve/engine.py, where every
+    # lane sits at its own depth in its own request); vector pos implies
+    # R == 1 (one incremental row per lane per step)
+    lanes = jnp.ndim(dc.pos) > 0
+    if lanes and n_rows != 1:
+        raise ValueError("per-lane decode positions require single-row "
+                         f"steps (got {n_rows} rows)")
     if cache_id in dc.caches:
         cached = dc.caches[cache_id]
     else:  # template-building call: allocate zeroed full-length caches
         shape = (v_cur.shape[0], dc.seq) + v_cur.shape[2:]
         cached = tuple(jnp.zeros(shape, cdtype)
                        for _ in range(2 if has_dot else 1))
+    if lanes:
+        # per-lane scatter: lane b writes its row at absolute dc.pos[b]
+        # (dynamic_update_slice cannot take per-batch starts)
+        row_at = (jnp.arange(dc.seq)[None, :] == dc.pos[:, None])
+        sel = row_at.reshape(row_at.shape + (1,) * (v_cur.ndim - 2))
     if has_dot:
         k_cache, v_cache = cached
         k_cur = key.transpose_to(order).x.astype(cdtype)
-        k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k_cur,
-                                                      dc.pos, 1)
+        k_cache = (jnp.where(sel, k_cur, k_cache) if lanes
+                   else jax.lax.dynamic_update_slice_in_dim(k_cache, k_cur,
+                                                            dc.pos, 1))
     else:
         v_cache, = cached
-    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v_cur, dc.pos, 1)
+    v_cache = (jnp.where(sel, v_cur, v_cache) if lanes
+               else jax.lax.dynamic_update_slice_in_dim(v_cache, v_cur,
+                                                        dc.pos, 1))
     dc.caches[cache_id] = (k_cache, v_cache) if has_dot else (v_cache,)
 
     # per-row causal visibility: query row r (absolute position pos+r) sees
-    # cached positions <= pos+r only
-    q_abs = dc.pos + jnp.arange(n_rows)
-    vis = (jnp.arange(dc.seq)[None, :] <= q_abs[:, None]).astype(cdtype)
+    # cached positions <= pos+r only; with per-lane pos the mask gains the
+    # batch axis and every NT below broadcasts it by name
+    if lanes:
+        q_abs = dc.pos[:, None] + jnp.arange(n_rows)[None, :]
+        vis = (jnp.arange(dc.seq)[None, None, :]
+               <= q_abs[:, :, None]).astype(cdtype)
+        vis_nt = NT(vis, (batch_axis, dim, tmp))
+    else:
+        q_abs = dc.pos + jnp.arange(n_rows)
+        vis = (jnp.arange(dc.seq)[None, :] <= q_abs[:, None]).astype(cdtype)
+        vis_nt = NT(vis, (dim, tmp))
 
     def map_rows(a: Args) -> NT:
         """Rows [pos, pos+R) of the learned per-head seq x seq map, causally
         zeroed when the axis is masked (dense-path ``bias * mask``)."""
         bias = embed(a, [(HEADS, cfg.heads), (dim, dc.seq), (tmp, dc.seq)])
         bx = bias.transpose_to((HEADS, dim, tmp)).x.astype(cdtype)
-        rows = NT(jax.lax.dynamic_slice_in_dim(bx, dc.pos, n_rows, 1),
-                  (HEADS, dim, tmp))
-        return rows * NT(vis, (dim, tmp)) if is_masked(a) else rows
+        if lanes:  # per-lane row gather: [h, B, R, seq]
+            rows = NT(jnp.take(bx, q_abs, axis=1),
+                      (HEADS, batch_axis, dim, tmp))
+        else:
+            rows = NT(jax.lax.dynamic_slice_in_dim(bx, dc.pos, n_rows, 1),
+                      (HEADS, dim, tmp))
+        return rows * vis_nt if is_masked(a) else rows
 
     logit: typing.Optional[NT] = None
     if has_dot:
@@ -509,7 +538,8 @@ def _cached_attention(args: Args, qry: NT, key: NT, val: NT, dim: str) -> NT:
         b = map_rows(args)
         logit = b if logit is None else logit + b
     if logit is not None:
-        logit = logit + NT((1 - vis) * jnp.asarray(-2e38, cdtype), (dim, tmp))
+        logit = logit + NT((1 - vis) * jnp.asarray(-2e38, cdtype),
+                           vis_nt.names)
         logit = logit - nd.stop_gradient(nd.reduce_max(logit, reduced=[tmp]))
         logit = NT(jnp.exp(logit.x), logit.names)
         logit = logit / nd.reduce_sum(logit, reduced=[tmp])

@@ -1,6 +1,8 @@
 """Serving layer: completion engine, async wrapper, REPL, REST API, sample
 renderers, similarity debug (JAX re-design of /root/reference/src/
 interface.py + src/rest_api.py)."""
+from .engine import (BatchEngine, BatchInterface,  # noqa: F401
+                     use_batch_engine)
 from .interface import (ByteTokenizer, CompletionEngine,  # noqa: F401
                         InterfaceWrapper, QueueDeadlineExceeded,
                         tokenizer_for)

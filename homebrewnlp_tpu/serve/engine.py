@@ -1,0 +1,646 @@
+"""Continuous-batching inference engine (docs/observability.md
+"Continuous batching").
+
+The reference serves one request at a time behind a single lock (its
+Manager-queue bridge, /root/reference/src/rest_api.py), and our port kept
+that shape: ``serve/interface.py::InterfaceWrapper`` serializes every
+sampler call — the cost the serving-SLO layer's
+``serialization_overhead_s`` was built to expose.  This module replaces it
+with a real scheduler over the KV-cache sampler (Orca-style continuous /
+in-flight batching, Yu et al. 2022; block-allocated KV accounting after
+vLLM's PagedAttention, Kwon et al. 2023):
+
+* one persistent DECODE loop over a fixed pool of ``serve_max_batch``
+  lanes, each lane a row of the pooled per-layer KV caches
+  (``infer/kv_cache.py``'s per-lane-position decode step);
+* new requests are admitted BETWEEN decode steps — a finishing request's
+  lane is re-prefilled while decode continues on the others;
+* two separately compiled executables: ``prefill`` (one full-length
+  forward writes a prompt's K/V into its lane) and ``decode`` (one
+  incremental row per active lane, per-lane traced sampling knobs —
+  one compilation serves every request mix);
+* a :class:`~homebrewnlp_tpu.infer.kv_cache.BlockAllocator` prices
+  admission in KV-pool blocks (``serve_kv_blocks`` x
+  ``serve_block_tokens``): a request's whole footprint is taken up front
+  and recycled on completion, a footprint that can NEVER fit is shed
+  immediately (503 + Retry-After, like ``serve_queue_limit``);
+* AOT executable serialization: both executables are compiled
+  ahead-of-time and — when ``serve_aot_cache_dir`` is set — serialized to
+  disk keyed by config hash + mesh + toolchain, so a second server start
+  deserializes in seconds instead of re-paying the compile+warmup
+  (BENCH_r05 measured ~135 s), which is what makes replica autoscaling
+  plausible.
+
+``serve_max_batch=1`` (the default) never constructs this engine: the
+REST layer keeps the serialized ``InterfaceWrapper`` path byte-identical
+to the pre-engine behavior (parity-tested).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import queue
+import threading
+import time
+import typing
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.feed import TEXT_AXES
+from ..infer import kv_cache as kvc
+from ..infer.sampler import _fire_first_token, _gumbel_argmax_lanes
+from . import slo
+from .interface import (QueueDeadlineExceeded, effective_truncation,
+                        tokenizer_for)
+
+#: bump when the executable calling convention changes (AOT cache keying)
+AOT_FORMAT = 1
+
+
+def use_batch_engine(cfg: Config) -> bool:
+    """Whether serving should run the continuous-batching scheduler:
+    opted in (``serve_max_batch > 1``) and the config's whole layer stack
+    decodes against a KV cache (``infer/kv_cache.py::cache_eligible``)."""
+    return int(getattr(cfg, "serve_max_batch", 1)) > 1 and kvc.cache_eligible(cfg)
+
+
+def aot_cache_key(cfg: Config, params: dict, n_lanes: int) -> str:
+    """Executable identity for the AOT cache: full derived config hash
+    (train/metrics.py::config_hash) + parameter tree structure + mesh
+    (device platform/kind/count) + toolchain versions + the engine's
+    calling-convention format.  Any drift produces a different key, so a
+    stale cache entry is simply never read — invalidation is by keying,
+    never by mutation."""
+    from ..train.metrics import config_hash
+    leaves = [f"{k}:{tuple(v.shape)}:{jnp.asarray(v).dtype}"
+              for k, v in sorted(params.items())]
+    dev = jax.devices()[0]
+    try:
+        import jaxlib.version
+        jaxlib_v = jaxlib.version.__version__
+    except Exception:  # noqa: BLE001 - toolchain without the module
+        jaxlib_v = ""
+    doc = json.dumps({
+        "config": config_hash(cfg),
+        "params": hashlib.sha256("|".join(leaves).encode()).hexdigest()[:16],
+        "lanes": int(n_lanes),
+        "mesh": [dev.platform, getattr(dev, "device_kind", ""),
+                 jax.device_count()],
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_v,
+        "format": AOT_FORMAT,
+    }, sort_keys=True, default=str)
+    return hashlib.sha256(doc.encode()).hexdigest()[:24]
+
+
+def _aot_save(path: str, compiled) -> bool:
+    """Best-effort serialize of a ``jax.stages.Compiled`` (atomic rename so
+    a torn write is never read back as a cache hit)."""
+    try:
+        from jax.experimental import serialize_executable as se
+        payload, in_tree, out_tree = se.serialize(compiled)
+        blob = pickle.dumps((payload, in_tree, out_tree))
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return True
+    except Exception:  # noqa: BLE001 - AOT persistence is an optimization
+        return False
+
+
+def _aot_load(path: str):
+    """Deserialize a cached executable; None on any failure (the caller
+    falls back to a fresh compile — a corrupt cache entry costs nothing
+    but the compile it failed to save)."""
+    try:
+        from jax.experimental import serialize_executable as se
+        with open(path, "rb") as f:
+            payload, in_tree, out_tree = pickle.loads(f.read())
+        return se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class _BatchRequest:
+    """One admitted-or-queued completion: prompt/knobs, the 1-slot result
+    queue, the ambient SLO record snapshotted at submit, and the
+    cancellation event the queue-deadline protocol honors while the
+    request is still QUEUED (an admitted request always finishes)."""
+
+    __slots__ = ("rid", "prompt", "temperature", "max_tokens", "top_k",
+                 "top_p", "rec", "out", "t_enq", "cancelled", "admitted",
+                 "end", "end_row", "first_gen", "prompt_rows", "tag")
+
+    def __init__(self, rid: int, prompt, temperature, max_tokens,
+                 top_k, top_p, rec):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.temperature = temperature
+        self.max_tokens = max_tokens
+        self.top_k = top_k
+        self.top_p = top_p
+        self.rec = rec
+        self.out: "queue.Queue[tuple]" = queue.Queue(1)
+        self.t_enq = time.monotonic()
+        self.cancelled = threading.Event()
+        self.admitted = threading.Event()
+
+
+class BatchEngine:
+    """The scheduler: owns the pooled device state (per-layer KV caches
+    ``[serve_max_batch, seq_rows, ...]``, the token pool, per-lane
+    positions), the two AOT executables, and one worker thread running
+    admit -> decode-step -> complete forever.
+
+    ``first_token_callback`` is the serving TTFT hook (host
+    ``(tag, token)``): the decode step fires it per lane at that lane's
+    first generated row, carrying the request id its SLO record supplied —
+    the traced-tag design (serve/slo.py) already supports many in-flight
+    requests on one compilation."""
+
+    def __init__(self, cfg: Config, params: dict,
+                 first_token_callback: typing.Optional[
+                     typing.Callable] = None):
+        if not kvc.cache_eligible(cfg):
+            raise ValueError(
+                "continuous batching needs a KV-cache-eligible config "
+                "(every sequence mixer an attention layer); this one keeps "
+                "the serialized rebuild path")
+        from ..models import pipeline_params_stacked, unstack_pipeline_params
+        if pipeline_params_stacked(cfg, params):
+            params = unstack_pipeline_params(cfg, params)
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer_for(cfg)
+        self._first_token_cb = first_token_callback
+        # TTFT source: the in-graph tagged callback serves the default
+        # path, but a host callback is a PyCapsule the AOT pickler cannot
+        # serialize — with ``serve_aot_cache_dir`` set the decode
+        # executable is built callback-free and TTFT is stamped HOST-side
+        # at the step boundary instead (the loop syncs every step, so the
+        # stamp is one decode step coarse; docs/observability.md
+        # "Continuous batching")
+        self._graph_ttft = (first_token_callback is not None
+                            and not getattr(cfg, "serve_aot_cache_dir", ""))
+        self.patch = cfg.token_patch_size
+        self.rows = cfg.sequence_length // self.patch
+        self.n_lanes = int(cfg.serve_max_batch)
+        self.allocator = kvc.BlockAllocator(
+            kvc.pool_blocks(cfg), kvc.block_rows(cfg) * self.patch)
+        # cold-start accounting (bench.py serving row: cold_start_s =
+        # compile_s OR aot_reload_s + warmup)
+        self.compile_s: typing.Optional[float] = None
+        self.aot_reload_s: typing.Optional[float] = None
+        self.aot_cache_hit: typing.Optional[bool] = None
+        self._build_executables()
+        # device state (pooled): lanes hold stale data between occupants by
+        # design — decode rewrites each row before any query can see it
+        # causally, so recycling never needs a zeroing pass (pinned by the
+        # slot-reuse parity test)
+        self._caches = kvc.init_caches(cfg, params, self.n_lanes, self.rows)
+        self._toks = jnp.zeros((self.n_lanes, self.rows, self.patch),
+                               jnp.int32)
+        self._pos = jnp.zeros((self.n_lanes,), jnp.int32)
+        self._rng = jax.random.key(cfg.data_seed)
+        # host mirrors (the scheduler thread is the only writer)
+        self._pos_h = np.zeros(self.n_lanes, np.int32)
+        self._end_row = np.zeros(self.n_lanes, np.int32)
+        self._first_gen = np.zeros(self.n_lanes, np.int32)
+        self._temps = np.zeros(self.n_lanes, np.float32)
+        self._ks = np.zeros(self.n_lanes, np.int32)
+        self._ps = np.ones(self.n_lanes, np.float32)
+        self._tags = np.zeros(self.n_lanes, np.int32)
+        self._logits = None  # last decode step's logits (tests/debug)
+        self._lane_req: typing.List[typing.Optional[_BatchRequest]] = (
+            [None] * self.n_lanes)
+        # scheduler plumbing
+        self._cv = threading.Condition()
+        self._queue: typing.List[_BatchRequest] = []
+        self._pending = 0  # submitted, not yet admitted (queue_depth)
+        self._closed = False
+        self._batch_observer: typing.Optional[typing.Callable] = None
+        self._rid = 0
+        self._pad_rng = np.random.default_rng(cfg.data_seed)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- executables ---------------------------------------------------------
+    def _decode_body(self, params, caches, toks, pos, active, end_row,
+                     first_gen, temps, ks, ps, rng, tags):
+        """One continuous-batching decode step: every ACTIVE lane decodes
+        the row at its own position, samples under its own traced knobs,
+        and writes the sampled row at position+1; inactive lanes carry
+        through untouched.  Mirrors the serialized cached sampler's body
+        (infer/kv_cache.py) with per-lane positions."""
+        cfg = self.cfg
+        rows = self.rows
+        rng, sub = jax.random.split(rng)
+        row = jnp.take_along_axis(toks, pos[:, None, None], axis=1)
+        logits, caches = kvc._decode_logits(cfg, params, row, pos, caches,
+                                            rows, TEXT_AXES)
+        sampled = _gumbel_argmax_lanes(logits, temps, sub, ks, ps)
+        nxt = pos + 1
+        write = active & (nxt < end_row) & (nxt < rows)
+        tgt = jnp.minimum(nxt, rows - 1)
+        cur = jnp.take_along_axis(toks, tgt[:, None, None], axis=1)
+        new_row = jnp.where(write[:, None, None],
+                            sampled.astype(toks.dtype), cur)
+        row_at = (jnp.arange(rows)[None, :] == tgt[:, None])[:, :, None]
+        toks = jnp.where(row_at, new_row, toks)
+        if self._graph_ttft:
+            # per-lane TTFT: n_lanes is static, so this unrolls to one
+            # gated callback per lane — each fires at most once per
+            # request (its first generated row), tagged with that lane's
+            # request id
+            for b in range(self.n_lanes):
+                _fire_first_token(self._first_token_cb, tags[b],
+                                  write[b] & (nxt[b] == first_gen[b]),
+                                  new_row[b])
+        pos = jnp.where(active, nxt, pos)
+        return caches, toks, pos, rng, logits
+
+    def _prefill_body(self, params, caches, toks, prompt, lane, prompt_rows):
+        """Prefill one request into lane ``lane``: a single full-length
+        forward writes every prompt position's K/V at once (batch of 1,
+        scalar position 0 — the serialized sampler's prefill), then the
+        lane rows of every pooled cache and the token pool are overwritten.
+        An empty prompt skips the forward; its lane decodes from scratch."""
+        cfg = self.cfg
+        rows = self.rows
+        lane0 = {k: tuple(jnp.zeros((1,) + v.shape[1:], v.dtype) for v in kv)
+                 for k, kv in caches.items()}
+        filled = jax.lax.cond(
+            prompt_rows > 0,
+            lambda c: kvc._decode_logits(cfg, params, prompt, jnp.int32(0),
+                                         c, rows, TEXT_AXES)[1],
+            lambda c: c, lane0)
+        out = {}
+        for name, kv in caches.items():
+            out[name] = tuple(
+                jax.lax.dynamic_update_slice(
+                    pool, jnp.asarray(one, pool.dtype),
+                    (lane,) + (0,) * (pool.ndim - 1))
+                for pool, one in zip(kv, filled[name]))
+        toks = jax.lax.dynamic_update_slice(toks, prompt, (lane, 0, 0))
+        return out, toks
+
+    def _abstract_args(self):
+        s = jax.ShapeDtypeStruct
+        tree = jax.tree_util.tree_map(
+            lambda a: s(jnp.shape(a), jnp.asarray(a).dtype), self.params)
+        caches = kvc.pool_shapes(self.cfg, tree, self.rows)
+        lanes = (self.n_lanes,)
+        common = (tree, caches,
+                  s((self.n_lanes, self.rows, self.patch), jnp.int32))
+        rng = jax.eval_shape(lambda: jax.random.key(0))
+        decode = common + (s(lanes, jnp.int32), s(lanes, jnp.bool_),
+                           s(lanes, jnp.int32), s(lanes, jnp.int32),
+                           s(lanes, jnp.float32), s(lanes, jnp.int32),
+                           s(lanes, jnp.float32), rng, s(lanes, jnp.int32))
+        prefill = common + (s((1, self.rows, self.patch), jnp.int32),
+                            s((), jnp.int32), s((), jnp.int32))
+        return decode, prefill
+
+    def _build_executables(self) -> None:
+        """AOT-compile (or AOT-deserialize) the prefill + decode
+        executables.  The cache key covers config + params structure +
+        mesh + toolchain (``aot_cache_key``); a miss compiles and then
+        best-effort persists both."""
+        cfg = self.cfg
+        decode_abs, prefill_abs = self._abstract_args()
+        cache_dir = getattr(cfg, "serve_aot_cache_dir", "")
+        dec_path = pre_path = None
+        if cache_dir:
+            key = aot_cache_key(cfg, self.params, self.n_lanes)
+            os.makedirs(cache_dir, exist_ok=True)
+            dec_path = os.path.join(cache_dir, f"decode-{key}.jaxexec")
+            pre_path = os.path.join(cache_dir, f"prefill-{key}.jaxexec")
+            t0 = time.perf_counter()
+            dec = _aot_load(dec_path)
+            pre = _aot_load(pre_path) if dec is not None else None
+            if dec is not None and pre is not None:
+                self._decode, self._prefill = dec, pre
+                self.aot_reload_s = time.perf_counter() - t0
+                self.aot_cache_hit = True
+                return
+            self.aot_cache_hit = False
+        t0 = time.perf_counter()
+        self._decode = jax.jit(self._decode_body).lower(*decode_abs).compile()
+        self._prefill = jax.jit(self._prefill_body).lower(
+            *prefill_abs).compile()
+        self.compile_s = time.perf_counter() - t0
+        if dec_path is not None:
+            _aot_save(dec_path, self._decode)
+            _aot_save(pre_path, self._prefill)
+
+    # -- submission (any thread) ---------------------------------------------
+    def queue_depth(self) -> int:
+        with self._cv:
+            return self._pending
+
+    def kv_blocks_free(self) -> int:
+        return self.allocator.free_blocks
+
+    def active_lanes(self) -> int:
+        return sum(1 for r in self._lane_req if r is not None)
+
+    def set_batch_observer(self, fn: typing.Optional[typing.Callable]
+                           ) -> None:
+        """Per-decode-step occupancy sink (``ServeSLO.observe_batch``):
+        called with the number of active lanes after each step."""
+        self._batch_observer = fn
+
+    def submit(self, prompt: typing.Sequence[int], temperature: float,
+               max_tokens: typing.Optional[int],
+               top_k: typing.Optional[int],
+               top_p: typing.Optional[float]) -> _BatchRequest:
+        """Queue a completion; sheds immediately (503 semantics) when the
+        backlog exceeds ``serve_queue_limit`` or the request's whole KV
+        footprint can never fit the pool."""
+        cfg = self.cfg
+        prompt = list(prompt)[:self.rows * self.patch]
+        depth = self.queue_depth()
+        limit = int(getattr(cfg, "serve_queue_limit", 0))
+        if limit and depth >= limit:
+            raise QueueDeadlineExceeded(
+                0.0, float(getattr(cfg, "serve_queue_deadline_s", 0.0)),
+                depth, shed=True)
+        end = (self.rows * self.patch if max_tokens is None
+               else min(self.rows * self.patch, len(prompt) + max_tokens))
+        if not self.allocator.fits(end):
+            raise QueueDeadlineExceeded(
+                0.0, float(getattr(cfg, "serve_queue_deadline_s", 0.0)),
+                depth, shed=True)
+        rec = slo.current()
+        if rec is not None:
+            rec.mark_enqueued(queue_depth=depth)
+        k, p = effective_truncation(cfg, top_k, top_p)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("engine is closed")
+            self._rid += 1
+            req = _BatchRequest(self._rid, prompt, float(temperature),
+                                max_tokens, int(k), float(p), rec)
+            req.end = end
+            self._queue.append(req)
+            self._pending += 1
+            self._cv.notify_all()
+        return req
+
+    def complete_tokens(self, prompt: typing.Sequence[int],
+                        temperature: typing.Optional[float] = None,
+                        max_tokens: typing.Optional[int] = None,
+                        top_k: typing.Optional[int] = None,
+                        top_p: typing.Optional[float] = None) -> np.ndarray:
+        """Blocking convenience with the CompletionEngine signature."""
+        cfg = self.cfg
+        req = self.submit(prompt,
+                          cfg.sampling_temperature if temperature is None
+                          else temperature, max_tokens, top_k, top_p)
+        return self.fetch(req)
+
+    def fetch(self, req: _BatchRequest,
+              deadline_s: typing.Optional[float] = None) -> np.ndarray:
+        """Block for ``req``'s result; a still-QUEUED request past the
+        deadline is cancelled and raises :class:`QueueDeadlineExceeded`
+        (an admitted one always finishes — its lane is already decoding)."""
+        deadline = (float(getattr(self.cfg, "serve_queue_deadline_s", 0.0))
+                    if deadline_s is None else deadline_s)
+        poll = max(0.01, float(self.cfg.default_sleep_duration))
+        while True:
+            try:
+                status, value = req.out.get(timeout=poll)
+                break
+            except queue.Empty:
+                waited = time.monotonic() - req.t_enq
+                if (deadline and waited > deadline
+                        and not req.admitted.is_set()):
+                    req.cancelled.set()
+                    raise QueueDeadlineExceeded(waited, deadline,
+                                                self.queue_depth())
+        if status == "err":
+            raise value
+        return value
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=30.0)
+
+    # -- scheduler thread ----------------------------------------------------
+    def _pad_prompt(self, req: _BatchRequest) -> np.ndarray:
+        """Prompt laid out row-major over the lane's full context, padded
+        with random tokens the decode loop overwrites (the serialized
+        engine's padding contract; only an empty prompt's row 0 ever
+        influences sampling, as its seed row)."""
+        flat = self._pad_rng.integers(
+            0, self.cfg.vocab_size, size=self.rows * self.patch,
+            dtype=np.int64).astype(np.int32)
+        flat[:len(req.prompt)] = np.asarray(req.prompt, np.int32)
+        return flat.reshape(1, self.rows, self.patch)
+
+    def _admit(self) -> None:
+        """Fill free lanes from the queue between decode steps: allocate
+        the KV-block footprint, prefill the lane, arm the mirrors.  Stops
+        at the first request the pool cannot hold RIGHT NOW (FIFO — a
+        small request never starves a big one already at the head)."""
+        while True:
+            with self._cv:
+                live = [r for r in self._queue if not r.cancelled.is_set()]
+                dropped = len(self._queue) - len(live)
+                if dropped:
+                    self._queue[:] = live
+                    self._pending -= dropped
+                if not self._queue:
+                    return
+                try:
+                    lane = self._lane_req.index(None)
+                except ValueError:
+                    return
+                req = self._queue[0]
+                if self.allocator.alloc(req.rid, req.end) is None:
+                    return
+                self._queue.pop(0)
+                self._pending -= 1
+            self._start_request(req, lane)
+
+    def _start_request(self, req: _BatchRequest, lane: int) -> None:
+        cfg = self.cfg
+        rec = req.rec
+        req.admitted.set()
+        prompt_rows = len(req.prompt) // self.patch
+        req.prompt_rows = prompt_rows
+        req.end_row = (self.rows if req.max_tokens is None
+                       else min(self.rows,
+                                -(-(len(req.prompt) + req.max_tokens)
+                                  // self.patch)))
+        req.first_gen = max(prompt_rows, 1)
+        req.tag = rec.rid if rec is not None and self._graph_ttft else 0
+        if rec is not None:
+            rec.mark_started()
+            rec.tokens_generated = max(0, req.end - len(req.prompt))
+        if req.tag:
+            slo.register_first_token(req.tag, rec.mark_first_token)
+        try:
+            self._caches, self._toks = self._prefill(
+                self.params, self._caches, self._toks, self._pad_prompt(req),
+                np.int32(lane), np.int32(prompt_rows))
+        except Exception as e:  # noqa: BLE001 - fail THIS request, keep serving
+            # the request is already admitted (deadline-cancel disabled) and
+            # holds blocks — an unhandled prefill error would leak both and
+            # leave its fetch() blocking forever
+            self.allocator.free(req.rid)
+            if req.tag:
+                slo.unregister_first_token(req.tag)
+            if rec is not None:
+                rec.mark_engine_done()
+            req.out.put(("err", e))
+            return
+        self._lane_req[lane] = req
+        self._pos_h[lane] = max(prompt_rows - 1, 0)
+        self._end_row[lane] = req.end_row
+        self._first_gen[lane] = req.first_gen
+        self._temps[lane] = req.temperature
+        self._ks[lane] = req.top_k
+        self._ps[lane] = req.top_p
+        self._tags[lane] = req.tag
+        self._pos = jnp.asarray(self._pos_h)
+        if self._pos_h[lane] >= req.end_row - 1:
+            # nothing to generate (full prompt / zero budget): complete
+            # straight off the prefill, the lane never joins the loop
+            self._finish_lane(lane)
+
+    def _step(self) -> None:
+        """One decode step over every active lane, then completion checks.
+        The host mirrors advance deterministically (pos += active), and
+        reading the returned positions back is the loop's pacing sync —
+        one tiny D2H per step keeps the host from racing ahead of the
+        device."""
+        active = (np.array([r is not None for r in self._lane_req])
+                  & (self._pos_h < self._end_row - 1))
+        self._caches, self._toks, self._pos, self._rng, self._logits = (
+            self._decode(self.params, self._caches, self._toks, self._pos,
+                         active, self._end_row, self._first_gen, self._temps,
+                         self._ks, self._ps, self._rng, self._tags))
+        # blocks until the step lands (the loop's pacing sync); copy — the
+        # zero-copy view over the device buffer is read-only, and admission
+        # writes lanes into this mirror
+        self._pos_h = np.array(self._pos, np.int32)
+        n_active = int(active.sum())
+        obs = self._batch_observer
+        if obs is not None:
+            try:
+                obs(n_active)
+            except Exception:  # noqa: BLE001 - metrics must not kill serving
+                pass
+        for lane, req in enumerate(self._lane_req):
+            if req is None:
+                continue
+            if (not self._graph_ttft and req.rec is not None
+                    and self._pos_h[lane] == self._first_gen[lane]):
+                # host-side TTFT (AOT-cached executables carry no host
+                # callback): the lane's first generated row landed in the
+                # step that just synced — mark_first_token keeps the
+                # first stamp, so a repeated hit is a no-op
+                req.rec.mark_first_token()
+            if self._pos_h[lane] >= self._end_row[lane] - 1:
+                self._finish_lane(lane)
+
+    def _finish_lane(self, lane: int) -> None:
+        req = self._lane_req[lane]
+        out = np.asarray(self._toks[lane]).reshape(-1)[:req.end]
+        rec = req.rec
+        if req.tag:
+            try:  # flush the in-flight TTFT callback before unrouting
+                jax.effects_barrier()
+            except Exception:  # noqa: BLE001 - older toolchains
+                pass
+            slo.unregister_first_token(req.tag)
+        # engine-done BEFORE publishing: the waiting handler's finish()
+        # runs the instant fetch() wakes (serve/interface.py contract)
+        if rec is not None:
+            rec.mark_engine_done()
+        self._lane_req[lane] = None
+        self._end_row[lane] = 0
+        self._tags[lane] = 0
+        self.allocator.free(req.rid)
+        req.out.put(("ok", out))
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while (not self._queue and self.active_lanes() == 0
+                       and not self._closed):
+                    self._cv.wait(timeout=0.5)
+                if self._closed and self.active_lanes() == 0 and not self._queue:
+                    return
+            try:
+                self._admit()
+                if self.active_lanes():
+                    self._step()
+            except Exception as e:  # noqa: BLE001 - fail every in-flight req
+                self._fail_all(e)
+
+    def _fail_all(self, e: BaseException) -> None:
+        for lane, req in enumerate(self._lane_req):
+            if req is not None:
+                self._lane_req[lane] = None
+                self._end_row[lane] = 0
+                self.allocator.free(req.rid)
+                if req.tag:
+                    slo.unregister_first_token(req.tag)
+                if req.rec is not None:
+                    # stamp engine-done even on failure: an unstamped
+                    # record silently drops its engine/decode observations
+                    # (serve/interface.py contract) — exactly during the
+                    # failures the histograms should show
+                    req.rec.mark_engine_done()
+                req.out.put(("err", e))
+        with self._cv:
+            pending, self._queue = self._queue, []
+            self._pending = 0
+        for req in pending:
+            req.out.put(("err", e))
+
+
+class BatchInterface:
+    """``InterfaceWrapper``-shaped facade over :class:`BatchEngine` so the
+    REST layer (and bench/tests) swap engines by config: ``complete(...,
+    asynchronous=True)`` returns a ``fetch`` callable, ``queue_depth`` /
+    ``kv_blocks_free`` feed the SLO gauges, ``close`` drains the
+    scheduler.  There are no worker threads to serialize behind — the
+    queue here is the ADMISSION queue, drained between decode steps."""
+
+    def __init__(self, engine: BatchEngine):
+        self.engine = engine
+
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth()
+
+    def kv_blocks_free(self) -> int:
+        return self.engine.kv_blocks_free()
+
+    def set_batch_observer(self, fn) -> None:
+        self.engine.set_batch_observer(fn)
+
+    def complete(self, prompt: typing.Sequence[int], temperature: float = 0.0,
+                 response_len: int = 64, asynchronous: bool = False,
+                 top_k: typing.Optional[int] = None,
+                 top_p: typing.Optional[float] = None):
+        req = self.engine.submit(prompt, temperature, response_len,
+                                 top_k, top_p)
+
+        def fetch():
+            return self.engine.fetch(req)
+
+        return fetch if asynchronous else fetch()
+
+    def close(self) -> None:
+        self.engine.close()

@@ -287,7 +287,11 @@ class _Job:
 
 
 class InterfaceWrapper:
-    """Async facade over the engine (reference interface.py:231-280):
+    """Serialized async facade over the engine — the reference's shape,
+    and the default serving path; ``serve_max_batch > 1`` swaps it for
+    the continuous-batching scheduler (serve/engine.py), which replaces
+    the worker-thread queue below with lane admission between decode
+    steps.  (Reference interface.py:231-280):
     ``complete(..., asynchronous=True)`` returns a handle whose ``fetch()``
     blocks for the result.  ``workers`` (cfg.web_workers, reference
     rest_api.py:86) sets the number of worker threads; ``fetch`` polls its

@@ -55,10 +55,27 @@ class RestAPI:
         self.cfg = cfg
         # the engine's samplers carry the TTFT hook: the graph notifies the
         # host at the first sampled token, tagged with the request id the
-        # ambient SLO record supplies (docs/observability.md "Serving SLOs")
-        self.engine = CompletionEngine(
-            cfg, params, first_token_callback=slo_mod.dispatch_first_token)
-        self.wrapper = InterfaceWrapper(self.engine)
+        # ambient SLO record supplies (docs/observability.md "Serving SLOs").
+        # serve_max_batch > 1 (on a KV-cache-eligible config) swaps the
+        # serialized InterfaceWrapper for the continuous-batching scheduler
+        # (serve/engine.py); the default keeps the serialized path
+        # bit-identical to the pre-engine behavior
+        from .engine import BatchEngine, BatchInterface, use_batch_engine
+        if use_batch_engine(cfg):
+            self.engine = BatchEngine(
+                cfg, params,
+                first_token_callback=slo_mod.dispatch_first_token)
+            self.wrapper = BatchInterface(self.engine)
+        else:
+            if int(getattr(cfg, "serve_max_batch", 1)) > 1:
+                LOG.warning(
+                    "serve_max_batch=%d requested but the config is not "
+                    "KV-cache eligible; serving stays serialized",
+                    cfg.serve_max_batch)
+            self.engine = CompletionEngine(
+                cfg, params,
+                first_token_callback=slo_mod.dispatch_first_token)
+            self.wrapper = InterfaceWrapper(self.engine)
 
     # -- endpoints -----------------------------------------------------------
     def encode(self, body: dict) -> dict:
@@ -118,6 +135,8 @@ class _ApiServer(ThreadingHTTPServer):
 
     _obs_server = None
     _slo_probe = None
+    _kv_probe = None
+    _batch_wrapper = None
 
     def shutdown(self):
         super().shutdown()
@@ -134,6 +153,15 @@ class _ApiServer(ThreadingHTTPServer):
         probe, self._slo_probe = self._slo_probe, None
         if probe is not None:
             self.slo.clear_queue_probe(probe)
+        kv, self._kv_probe = self._kv_probe, None
+        if kv is not None:
+            self.slo.clear_kv_blocks_probe(kv)
+        w, self._batch_wrapper = self._batch_wrapper, None
+        if w is not None:
+            try:  # detach the occupancy sink: registry outlives the server
+                w.set_batch_observer(None)
+            except Exception:  # noqa: BLE001
+                pass
 
 
 def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
@@ -165,6 +193,17 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                  else None)
     if slo_probe is not None:
         serve_slo.set_queue_probe(slo_probe)
+    # continuous-batching hooks: the engine samples lane occupancy into
+    # hbnlp_serve_batch_size each decode step and exposes the KV pool's
+    # free-block level; both detach with the server (probe pinning hazard,
+    # see _ApiServer)
+    kv_probe = (wrapper.kv_blocks_free
+                if wrapper is not None and hasattr(wrapper, "kv_blocks_free")
+                else None)
+    if kv_probe is not None:
+        serve_slo.set_kv_blocks_probe(kv_probe)
+    if wrapper is not None and hasattr(wrapper, "set_batch_observer"):
+        wrapper.set_batch_observer(serve_slo.observe_batch)
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
@@ -230,6 +269,10 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
     server = _ApiServer((host, port), Handler)
     server.slo = serve_slo  # tests/bench read summaries off the live server
     server._slo_probe = slo_probe
+    server._kv_probe = kv_probe
+    server._batch_wrapper = (wrapper if wrapper is not None
+                             and hasattr(wrapper, "set_batch_observer")
+                             else None)
     eff_obs = (obs_port if obs_port is not None
                else (getattr(cfg, "obs_port", 0) if cfg is not None else 0))
     if obs_port is not None or eff_obs:

@@ -46,6 +46,13 @@ from ..obs.registry import (DEFAULT_BUCKETS, REGISTRY, Histogram,
 DECODE_RATE_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                        500.0, 1000.0, 2500.0, 5000.0, 10000.0)
 
+#: decode-step occupancy buckets (lanes sharing one step) — the
+#: continuous-batching engine samples ``hbnlp_serve_batch_size`` here every
+#: decode step; a serialized engine never observes it (p50 pinned at
+#: "absent", the batching smoke asserts p50 > 1)
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0,
+                      48.0, 64.0)
+
 #: latency buckets for every serving SLO histogram: DEFAULT_BUCKETS
 #: resolution below 60 s plus a tail out to 600 s — a serialized engine on
 #: a slow host (the committed CPU bench operating point sits past 60 s)
@@ -233,6 +240,21 @@ class ServeSLO:
         reg.gauge("hbnlp_serve_queue_depth",
                   "completion requests waiting on the engine queue",
                   fn=self.queue_depth)
+        # continuous-batching observability (docs/observability.md
+        # "Continuous batching"): per-decode-step lane occupancy + the KV
+        # pool's free-block level — BOTH absent-but-registered under the
+        # serialized engine (histogram empty, gauge at the -1 "no pool"
+        # sentinel), so scrapers see a stable series set either way
+        self.batch_size = reg.histogram(
+            "hbnlp_serve_batch_size",
+            "active decode lanes per engine step (continuous batching)",
+            buckets=BATCH_SIZE_BUCKETS)
+        self._kv_blocks_probe: typing.Optional[
+            typing.Callable[[], int]] = None
+        reg.gauge("hbnlp_serve_kv_blocks_free",
+                  "free blocks in the serving KV pool (-1 = no "
+                  "block-allocated pool: serialized engine)",
+                  fn=self.kv_blocks_free)
 
     def inflight(self) -> int:
         with self._lock:
@@ -261,6 +283,30 @@ class ServeSLO:
             return int(probe())
         except Exception:  # noqa: BLE001 - a dying queue must not kill /metrics
             return 0
+
+    # -- continuous-batching hooks (serve/engine.py) -------------------------
+    def observe_batch(self, n_active: int) -> None:
+        """Engine hook: one observation per decode step with the number of
+        lanes that shared it."""
+        self.batch_size.observe(float(n_active))
+
+    def set_kv_blocks_probe(self, fn: typing.Callable[[], int]) -> None:
+        self._kv_blocks_probe = fn
+
+    def clear_kv_blocks_probe(self, fn: typing.Callable[[], int]) -> None:
+        """Detach ``fn`` if still installed (server teardown — same
+        pinning hazard as :meth:`clear_queue_probe`)."""
+        if self._kv_blocks_probe is fn:
+            self._kv_blocks_probe = None
+
+    def kv_blocks_free(self) -> int:
+        probe = self._kv_blocks_probe
+        if probe is None:
+            return -1
+        try:
+            return int(probe())
+        except Exception:  # noqa: BLE001 - a dying pool must not kill /metrics
+            return -1
 
     def retry_after_s(self, deadline_s: float = 0.0) -> int:
         """Whole-second Retry-After hint for a shed/timed-out request: the
@@ -381,4 +427,11 @@ class ServeSLO:
             "queue_wait_s": self._pcts(self.queue_wait),
             "engine_s": self._pcts(self.engine),
             "decode_tokens_per_sec": self._pcts(self.decode_rate),
+            # None until a batching engine serves its first step; the
+            # serialized path never populates it (parity contract)
+            "batch_size": (self._pcts(self.batch_size)
+                           if self.batch_size.count() else None),
+            "kv_blocks_free": (self.kv_blocks_free()
+                               if self._kv_blocks_probe is not None
+                               else None),
         }

@@ -62,13 +62,17 @@ from homebrewnlp_tpu.obs.registry import (bucket_quantile,  # noqa: E402
 #: client-side percentile keys every report section carries
 QUANTILES = ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))
 
-#: server histogram series -> report keys (serve/slo.py owns the series)
+#: server histogram series -> report keys (serve/slo.py owns the series);
+#: batch_size is per-DECODE-STEP lane occupancy (continuous batching) —
+#: absent under the serialized engine, p50 > 1 when requests actually
+#: share decode steps
 SERVER_SERIES = (("e2e_s", "hbnlp_serve_request_seconds"),
                  ("ttft_s", "hbnlp_serve_ttft_seconds"),
                  ("queue_wait_s", "hbnlp_serve_queue_wait_seconds"),
                  ("engine_s", "hbnlp_serve_engine_seconds"),
                  ("decode_tokens_per_sec",
-                  "hbnlp_serve_decode_tokens_per_sec"))
+                  "hbnlp_serve_decode_tokens_per_sec"),
+                 ("batch_size", "hbnlp_serve_batch_size"))
 
 
 def make_corpus(seed: int, n: int, vocab: int = 256, min_len: int = 4,
@@ -323,7 +327,8 @@ def server_report(metrics_text: str) -> dict:
         row["mean"] = round(snap["sum"] / snap["count"], 6)
         row["count"] = snap["count"]
         out[key] = row
-    for gauge in ("hbnlp_serve_inflight", "hbnlp_serve_queue_depth"):
+    for gauge in ("hbnlp_serve_inflight", "hbnlp_serve_queue_depth",
+                  "hbnlp_serve_kv_blocks_free"):
         for _, value in metrics.get(gauge, []):
             out[gauge.replace("hbnlp_serve_", "")] = value
     return out
