@@ -108,6 +108,14 @@ Latency/goodput drift is gated by the committed per-device-kind
 ``bench_serve_baseline.json`` (self-records on first contact, like the
 compile budget): p50 e2e growing past 1.5x, or goodput dropping below
 2/3x, fails the row's ``baseline`` and the top-level ``serve_ok``.
+The token-level observability PR (ISSUE 14) extends the row with
+``itl_p50/p95`` + ``decode_step_p50/p95`` (the server's per-token
+histograms), ``prefill_stall_fraction`` (decode wall stalled on admission
+prefill / total loop wall — the number the prefill-off-critical-path work
+must shrink), and a contained streaming probe recording ``stream_ttft_s``
+(client-measured first-SSE-chunk latency) plus the client-vs-server ITL
+reconciliation; all three self-record into the baseline and ratchet in
+``evaluate_serve_baseline``.
 
 Env knobs (development / partial runs): ``HBNLP_BENCH_WORKLOADS`` is a
 comma list or ``all`` (default); ``HBNLP_BENCH_GUARD_STEPS`` overrides the
@@ -769,6 +777,45 @@ def _quant_probe(name: str, trainer, state, batch, flops_algo: float,
     return row
 
 
+def _stream_delta_reconcile(client: dict, pre_text: str,
+                            post_text: str) -> dict:
+    """Reconcile the streaming probe's CLIENT percentiles against the
+    server histograms' pre/post scrape DELTA — exactly the probe's own
+    requests, even when the cumulative series is dominated by the main
+    (queued, non-streamed) drive.  Same per-series tolerance as graftload:
+    ``bucket_width_at(p50) + max(0.05, 0.25 * p50)``."""
+    import math
+
+    import graftload
+
+    from homebrewnlp_tpu.obs.registry import bucket_quantile, bucket_width_at
+    pre = graftload.parse_prom(pre_text)
+    post = graftload.parse_prom(post_text)
+    arms: dict = {}
+    for key, series in (("itl", "hbnlp_serve_itl_seconds"),
+                        ("ttft", "hbnlp_serve_ttft_seconds")):
+        cp = (client.get(f"{key}_s") or {}).get("p50")
+        snap_post = graftload.histogram_snapshot(post, series)
+        if cp is None or snap_post is None:
+            continue
+        snap_pre = graftload.histogram_snapshot(pre, series)
+        counts = list(snap_post["counts"])
+        if (snap_pre is not None
+                and snap_pre["buckets"] == snap_post["buckets"]):
+            counts = [b - a for a, b in zip(snap_pre["counts"], counts)]
+        sp = bucket_quantile(snap_post["buckets"], counts, 0.5)
+        if sp is None:
+            continue
+        width = bucket_width_at(snap_post["buckets"], sp)
+        tol = (width if width != math.inf else 0.0) + max(0.05, 0.25 * sp)
+        arms[key] = {"client_p50_s": round(cp, 6),
+                     "server_p50_s": round(sp, 6),
+                     "abs_diff_s": round(abs(cp - sp), 6),
+                     "tolerance_s": round(tol, 6),
+                     "within_tolerance": bool(abs(cp - sp) <= tol)}
+    return arms
+
+
 def bench_serving() -> dict:
     """The ``serving`` workload row (docs/observability.md "Serving SLOs"):
     bring the REST server up in-process on live fresh-init params, drive it
@@ -840,6 +887,37 @@ def _bench_serving_inner(aot_dir: str, t0: float) -> dict:
             concurrency=SERVE_CONCURRENCY, vocab=cfg.vocab_size,
             min_prompt=4, max_prompt=max_prompt,
             response_len=SERVE_RESPONSE_LEN, seed=2)
+        # streaming probe (contained): a short --stream pass measuring
+        # client-side TTFT-to-first-SSE-chunk and reconciling client ITL
+        # against the server histogram — runs AFTER the main drive so the
+        # main report's scrape holds exactly the gated load.  The probe's
+        # reconcile arms use a pre/post scrape DELTA: the cumulative
+        # histograms are dominated by the main drive's queued load, and
+        # comparing the idle probe's client clocks against those would
+        # flag two healthy clocks
+        stream_probe: dict = {}
+        try:
+            pre_text = graftload.fetch_metrics(murl)
+            sreport = graftload.drive(
+                url, n_requests=4, concurrency=2,
+                vocab=cfg.vocab_size, min_prompt=4, max_prompt=max_prompt,
+                response_len=SERVE_RESPONSE_LEN, seed=7, stream=True)
+            post_text = graftload.fetch_metrics(murl)
+            sc = sreport["client"]
+            if sc.get("error_rate"):
+                stream_probe["stream_probe_error"] = (
+                    f"error_rate={sc['error_rate']}")
+            else:
+                stream_probe["stream_ttft_s"] = (sc.get("ttft_s")
+                                                 or {}).get("p50")
+                stream_probe["stream_itl_p50"] = (sc.get("itl_s")
+                                                  or {}).get("p50")
+                arms = _stream_delta_reconcile(sc, pre_text, post_text)
+                if arms:
+                    stream_probe["stream_reconcile"] = arms
+        except Exception as e:  # noqa: BLE001 - probe failure, row survives
+            stream_probe["stream_probe_error"] = (
+                f"{type(e).__name__}: {e}"[:200])
     finally:
         server.shutdown()
         server.server_close()
@@ -888,16 +966,21 @@ def _bench_serving_inner(aot_dir: str, t0: float) -> dict:
         "compile_and_warmup_s": round(compile_and_warmup_s, 1),
     }
     row.update(cold)
+    row.update(stream_probe)
     srv = report.get("server") or {}
     if isinstance(srv, dict) and "error" not in srv:
         for key, out_key in (("ttft_s", "ttft"), ("queue_wait_s",
                                                   "queue_wait"),
                              ("engine_s", "engine"),
                              ("decode_tokens_per_sec", "decode_rate"),
-                             ("batch_size", "batch_size")):
+                             ("batch_size", "batch_size"),
+                             ("itl_s", "itl"),
+                             ("decode_step_s", "decode_step")):
             if isinstance(srv.get(key), dict):
                 row[f"{out_key}_p50"] = srv[key].get("p50")
                 row[f"{out_key}_p95"] = srv[key].get("p95")
+        if srv.get("prefill_stall_fraction") is not None:
+            row["prefill_stall_fraction"] = srv["prefill_stall_fraction"]
     if "server" in report:
         row["server"] = srv
     if "reconcile" in report:
@@ -943,6 +1026,28 @@ def evaluate_serve_baseline(row: dict, baseline: dict,
         passed = bool(ratio <= max_latency_ratio)
         out["cold_start"] = {"baseline_s": base_cold,
                              "ratio": round(ratio, 3), "pass": passed}
+        ok = ok and passed
+    # token-level ratchets (streaming/ITL PR): per-token latency and the
+    # streamed first-chunk latency gate like e2e; the prefill-stall
+    # fraction gets an absolute 0.05 slack on top of the ratio — at tiny
+    # stall fractions a pure ratio would flag scheduler noise
+    for key, base_key in (("itl_p50", "itl_p50"),
+                          ("stream_ttft_s", "stream_ttft_s")):
+        v, b = row.get(key), baseline.get(base_key)
+        if isinstance(v, (int, float)) and b:
+            ratio = v / b
+            passed = bool(ratio <= max_latency_ratio)
+            out[key] = {"baseline_s": b, "ratio": round(ratio, 3),
+                        "pass": passed}
+            ok = ok and passed
+    frac = row.get("prefill_stall_fraction")
+    base_frac = baseline.get("prefill_stall_fraction")
+    if isinstance(frac, (int, float)) and isinstance(base_frac, (int, float)):
+        limit = base_frac * max_latency_ratio + 0.05
+        passed = bool(frac <= limit)
+        out["prefill_stall_fraction"] = {
+            "baseline": base_frac, "value": frac,
+            "limit": round(limit, 4), "pass": passed}
         ok = ok and passed
     return (out or None), ok
 
@@ -1131,6 +1236,12 @@ def main() -> None:
                     "compile_s": srow.get("compile_s"),
                     "aot_reload_s": srow.get("aot_reload_s"),
                     "serve_max_batch": srow.get("serve_max_batch"),
+                    # token-level figures (streaming/ITL PR) self-record
+                    # so the NEXT round ratchets them
+                    "itl_p50": srow.get("itl_p50"),
+                    "prefill_stall_fraction": srow.get(
+                        "prefill_stall_fraction"),
+                    "stream_ttft_s": srow.get("stream_ttft_s"),
                     "shape": shape,
                     "recorded": time.time()})
                 with open(SERVE_BASELINE_FILE, "w") as f:

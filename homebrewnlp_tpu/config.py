@@ -397,6 +397,16 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # server start deserializes instead of re-compiling (cold start in
     # seconds, not minutes); "" = AOT executable serialization off
     serve_aot_cache_dir="",
+    # serve_stream: honor `stream: true` on the completion endpoints (SSE
+    # token streaming, docs/observability.md "Streaming and inter-token
+    # latency"); requests without the flag are byte-identical either way.
+    # False keeps the serialized samplers' graphs free of the per-row
+    # token callback and buffers every response.
+    serve_stream=True,
+    # serve_trace_path: Chrome-trace JSON of the serving engine's decode
+    # loop (per-phase spans + per-lane occupancy tracks + request phase
+    # trails), exported when the engine closes; "" = serving trace off
+    serve_trace_path="",
     equal_debugging_items_per_check=16,
     debug_sample=False,
     default_sleep_duration=0.1,
@@ -522,6 +532,8 @@ class Config:
                     f"{self.serve_block_tokens or self.sequence_length} "
                     "tokens); raise serve_kv_blocks or serve_block_tokens")
         self.serve_aot_cache_dir = str(self.serve_aot_cache_dir or "")
+        self.serve_stream = bool(self.serve_stream)
+        self.serve_trace_path = str(self.serve_trace_path or "")
         if self.watchdog_factor < 0:
             raise ValueError("watchdog_factor must be >= 0 "
                              "(0 = watchdog disabled)")

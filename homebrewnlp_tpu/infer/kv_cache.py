@@ -251,21 +251,26 @@ class BlockAllocator:
 
 def make_cached_text_sampler(cfg: Config, params: dict,
                              first_token_callback: typing.Optional[
+                                 typing.Callable] = None,
+                             token_callback: typing.Optional[
                                  typing.Callable] = None):
     """Jitted KV-cached sampler with the same signature as
     ``make_text_sampler``: (token_x NT, initial_pos, temperature, rng,
-    end_iterations[, first_token_tag]) -> int32 tokens.
+    end_iterations[, first_token_tag[, stream]]) -> int32 tokens.
 
     ``first_token_callback``: the serving-SLO TTFT hook (host
     ``(tag, token)``), fired exactly once — on the FIRST generated
     position, i.e. after the one-shot prompt prefill above has run — so
     TTFT measured here covers prefill + first incremental step, matching
-    the rebuild sampler's semantics."""
+    the rebuild sampler's semantics.  ``token_callback`` (host
+    ``(tag, pos, row)``): the per-row streaming hook, fired on every
+    written row when the traced ``stream`` flag is set (same traced-tag
+    design — one compilation serves streaming and buffered requests)."""
     if not cache_eligible(cfg):
         raise ValueError("config is not KV-cache eligible; use make_text_sampler")
 
     def fn(params, token_x: NT, initial_pos, temperature, rng,
-           end_iterations=None, first_token_tag=0):
+           end_iterations=None, first_token_tag=0, stream=0):
         names = token_x.names
         toks = token_x.x.astype(jnp.int32)
         seq_axis = names.index(SEQUENCE)
@@ -314,6 +319,12 @@ def make_cached_text_sampler(cfg: Config, params: dict,
                     first_token_callback, first_token_tag,
                     write & (nxt == jnp.maximum(jnp.int32(initial_pos), 1)),
                     new_row)
+            if token_callback is not None:
+                from .sampler import _fire_token_row
+                _fire_token_row(
+                    token_callback, first_token_tag,
+                    write & (jnp.asarray(stream, jnp.int32) != 0),
+                    nxt, new_row)
             return nxt, toks, caches, key
 
         def cond(carry):

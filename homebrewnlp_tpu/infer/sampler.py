@@ -133,6 +133,26 @@ def _gumbel_argmax_lanes(logits: jnp.ndarray, temperature: jnp.ndarray,
     return jnp.argmax(logits + hot * gumbel, axis=-1).astype(jnp.int32)
 
 
+def _fire_token_row(callback, tag, fire: jnp.ndarray, pos, row: jnp.ndarray
+                    ) -> None:
+    """Host-notify one generated token row (docs/observability.md
+    "Streaming and inter-token latency"): the streaming twin of
+    :func:`_fire_first_token`, fired on EVERY written row instead of just
+    the first.  The callback is UNORDERED — XLA may deliver rows out of
+    sequence — so the payload carries the row position and the host sink
+    reorders (``serve/interface.py::_RowStream``).  ``fire`` is a traced
+    gate (the request's stream flag AND the row-write predicate): a
+    non-streaming request pays one skipped cond per row, never a host
+    round-trip, and ``callback=None`` at trace time keeps the graph
+    byte-identical to the pre-streaming one."""
+    jax.lax.cond(
+        fire,
+        lambda operands: jax.debug.callback(
+            callback, jnp.asarray(tag, jnp.int32), operands[0], operands[1]),
+        lambda operands: None,
+        (jnp.asarray(pos, jnp.int32), row.reshape(-1)))
+
+
 def _fire_first_token(callback, tag, fire: jnp.ndarray, token: jnp.ndarray
                       ) -> None:
     """Host-notify the first sampled token (docs/observability.md "Serving
@@ -157,7 +177,10 @@ def autoregressive_text(cfg: Config, params: dict, token_x: NT,
                         rng: typing.Optional[jax.Array] = None,
                         first_token_callback: typing.Optional[
                             typing.Callable] = None,
-                        first_token_tag=0) -> jnp.ndarray:
+                        first_token_tag=0,
+                        token_callback: typing.Optional[
+                            typing.Callable] = None,
+                        stream=0) -> jnp.ndarray:
     """Fill ``token_x`` from ``initial_pos`` to ``end_iterations``.
 
     ``token_x``: int NT [batch, sequence, token_patch].  Returns the filled
@@ -166,7 +189,13 @@ def autoregressive_text(cfg: Config, params: dict, token_x: NT,
     on the FIRST generated position — so serving can measure TTFT; with a
     full prompt (nothing to generate) it never fires.  None (the default,
     and every training/analysis path) keeps the pre-callback graph
-    byte-identical — census goldens see no new equations."""
+    byte-identical — census goldens see no new equations.
+
+    ``token_callback`` (host ``(tag, pos, row)``) is the streaming twin:
+    every written row is host-notified while the loop still runs, gated by
+    the TRACED ``stream`` flag — one compilation serves streaming and
+    buffered requests alike, and requests with ``stream=0`` never pay a
+    host round-trip."""
     temperature = (cfg.sampling_temperature if temperature is None
                    else temperature)
     end = cfg.sequence_length if end_iterations is None else end_iterations
@@ -203,6 +232,12 @@ def autoregressive_text(cfg: Config, params: dict, token_x: NT,
             # the prompt "prefill", so TTFT covers it
             _fire_first_token(
                 first_token_callback, first_token_tag, pos == pos0,
+                jax.lax.dynamic_slice_in_dim(new_toks, pos, 1, seq_axis))
+        if token_callback is not None:
+            # every iteration writes row `pos`; streaming requests emit it
+            _fire_token_row(
+                token_callback, first_token_tag,
+                jnp.asarray(stream, jnp.int32) != 0, pos,
                 jax.lax.dynamic_slice_in_dim(new_toks, pos, 1, seq_axis))
         return pos + 1, new_toks, key
 
@@ -305,26 +340,35 @@ def make_single_forward(cfg: Config, params: dict):
 
 def make_text_sampler(cfg: Config, params: dict,
                       first_token_callback: typing.Optional[
+                          typing.Callable] = None,
+                      token_callback: typing.Optional[
                           typing.Callable] = None):
     """Jitted sampler: (token_x NT, initial_pos, temperature, rng,
-    end_iterations[, first_token_tag]) -> int32 tokens.  initial_pos /
-    temperature / end_iterations are traced so one compilation serves every
-    prompt and response length (the reference feeds them via infeed
-    placeholders, src/run/dataloader_placement.py:234-271).  ``params`` are
-    a jit argument, not closed-over constants (see make_single_forward).
+    end_iterations[, first_token_tag[, stream]]) -> int32 tokens.
+    initial_pos / temperature / end_iterations are traced so one
+    compilation serves every prompt and response length (the reference
+    feeds them via infeed placeholders,
+    src/run/dataloader_placement.py:234-271).  ``params`` are a jit
+    argument, not closed-over constants (see make_single_forward).
 
     ``first_token_callback`` (host ``(tag, token)``) arms the serving-SLO
     TTFT hook: the graph notifies the host once, at the first generated
     position, carrying the TRACED ``first_token_tag`` request id — one
-    compilation serves every request (docs/observability.md)."""
+    compilation serves every request (docs/observability.md).
+    ``token_callback`` (host ``(tag, pos, row)``) arms per-row streaming
+    the same way, runtime-gated by the traced ``stream`` flag — requests
+    with ``stream=0`` share the compilation but never pay a host
+    round-trip."""
 
     def fn(params, token_x: NT, initial_pos, temperature, rng,
-           end_iterations=None, first_token_tag=0):
+           end_iterations=None, first_token_tag=0, stream=0):
         end = (jnp.int32(cfg.sequence_length) if end_iterations is None
                else end_iterations)
         return autoregressive_text(cfg, params, token_x, initial_pos,
                                    temperature, end_iterations=end, rng=rng,
                                    first_token_callback=first_token_callback,
-                                   first_token_tag=first_token_tag)
+                                   first_token_tag=first_token_tag,
+                                   token_callback=token_callback,
+                                   stream=stream)
 
     return jit_bound(fn, params)

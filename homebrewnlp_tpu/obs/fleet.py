@@ -253,6 +253,18 @@ def parse_prom_text(text: str) -> typing.Dict[str, Family]:
 FLEET_RANK_LABEL = "rank"
 FLEET_AGG_VALUE = "fleet"  # the rank label value aggregate series carry
 
+#: gauges whose listed value is a DOCUMENTED "not applicable" sentinel, not
+#: a measurement (serve/slo.py: -1 = no KV pool / no lane scheduler, i.e. a
+#: serialized engine).  Sentinels are excluded from the fleet min/mean/max —
+#: a mixed fleet (some ranks batching, some serialized) would otherwise
+#: report fleet-min -1 and drag the mean below every real pool level.  A
+#: fleet that is ALL sentinel keeps the sentinel as its aggregate (the
+#: series stays present and honest).
+GAUGE_SENTINELS = {
+    "hbnlp_serve_kv_blocks_free": -1.0,
+    "hbnlp_serve_lane_occupancy": -1.0,
+}
+
 
 def _group_key(labels: dict) -> tuple:
     return tuple(sorted((k, v) for k, v in labels.items()
@@ -317,6 +329,10 @@ def federate(rank_texts: typing.Dict[int, str],
                     lines.append(f"{name}{_label_str(base)} "
                                  f"{_fmt(sum(values))}")
                 else:
+                    sentinel = GAUGE_SENTINELS.get(name)
+                    if sentinel is not None:
+                        real = [v for v in values if v != sentinel]
+                        values = real or values  # all-sentinel: keep as-is
                     for agg, v in (("min", min(values)),
                                    ("mean", sum(values) / len(values)),
                                    ("max", max(values))):

@@ -31,6 +31,15 @@ _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+# fine-resolution latency buckets for PER-TOKEN quantities (inter-token
+# latency, decode-step wall): a TPU decode step sits in the hundreds of
+# microseconds, below DEFAULT_BUCKETS' first edge — every percentile would
+# interpolate inside one bucket and the reconciliation tolerance
+# (``bucket_width_at``) would be the whole measurement
+FINE_LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                        0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                        10.0, 30.0, 60.0)
+
 
 def bucket_quantile(buckets: typing.Sequence[float],
                     counts: typing.Sequence[float],

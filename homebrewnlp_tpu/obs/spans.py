@@ -90,6 +90,10 @@ class SpanTracer:
         self._recorded = 0
         self._totals: typing.Dict[str, float] = {}
         self._thread_names: typing.Dict[int, str] = {}
+        # virtual tracks (serving lane timelines): negative synthetic tids,
+        # allocated per track name, so they can never collide with a real
+        # thread ident and sort ahead of the thread tracks in the viewer
+        self._track_ids: typing.Dict[str, int] = {}
         self._epoch = time.perf_counter()
         self._wall_epoch = time.time()
         self._pid = os.getpid()
@@ -118,23 +122,39 @@ class SpanTracer:
             return wrapped
         return deco
 
-    def add(self, name: str, t0: float, t1: float, **args) -> None:
+    def add(self, name: str, t0: float, t1: float,
+            track: typing.Optional[str] = None, **args) -> None:
         """Record an already-measured span from explicit ``perf_counter``
         timestamps.  A request's phase trail (serve/slo.py) is stamped
         across three threads — handler, queue worker, JAX callback — and
         only assembled once the request finishes; this records each phase
         retroactively on the calling thread's track, which a live context
-        manager cannot do."""
+        manager cannot do.
+
+        ``track`` places the span on a named VIRTUAL track instead of the
+        calling thread's — the serving engine's per-lane occupancy
+        timelines (docs/observability.md "Streaming and inter-token
+        latency") are not threads, but each lane still deserves its own
+        swimlane in the exported Chrome trace."""
         if t1 < t0:
             t0, t1 = t1, t0
-        self._record(name, t0, t1, args)
+        self._record(name, t0, t1, args, track=track)
 
-    def _record(self, name: str, t0: float, t1: float, args: dict) -> None:
-        th = threading.current_thread()
+    def _record(self, name: str, t0: float, t1: float, args: dict,
+                track: typing.Optional[str] = None) -> None:
         with self._lock:
-            self._thread_names[th.ident] = th.name
+            if track is not None:
+                tid = self._track_ids.get(track)
+                if tid is None:
+                    tid = -(len(self._track_ids) + 1)
+                    self._track_ids[track] = tid
+                    self._thread_names[tid] = track
+            else:
+                th = threading.current_thread()
+                tid = th.ident
+                self._thread_names[tid] = th.name
             self._events.append((name, t0 - self._epoch, t1 - self._epoch,
-                                 th.ident, args))
+                                 tid, args))
             self._recorded += 1
             self._totals[name] = self._totals.get(name, 0.0) + (t1 - t0)
 
@@ -214,11 +234,12 @@ def span(name: str, **args):
     return t.span(name, **args)
 
 
-def add(name: str, t0: float, t1: float, **args) -> None:
+def add(name: str, t0: float, t1: float,
+        track: typing.Optional[str] = None, **args) -> None:
     """Retroactive span on the ambient tracer; no-op when tracing is off."""
     t = _TRACER
     if t is not None:
-        t.add(name, t0, t1, **args)
+        t.add(name, t0, t1, track=track, **args)
 
 
 def traced(name: str):

@@ -55,8 +55,8 @@ from ..data.feed import TEXT_AXES
 from ..infer import kv_cache as kvc
 from ..infer.sampler import _fire_first_token, _gumbel_argmax_lanes
 from . import slo
-from .interface import (QueueDeadlineExceeded, effective_truncation,
-                        tokenizer_for)
+from .interface import (QueueDeadlineExceeded, _RowStream,
+                        effective_truncation, tokenizer_for)
 
 #: bump when the executable calling convention changes (AOT cache keying)
 AOT_FORMAT = 1
@@ -129,16 +129,19 @@ def _aot_load(path: str):
 
 class _BatchRequest:
     """One admitted-or-queued completion: prompt/knobs, the 1-slot result
-    queue, the ambient SLO record snapshotted at submit, and the
-    cancellation event the queue-deadline protocol honors while the
-    request is still QUEUED (an admitted request always finishes)."""
+    queue, the ambient SLO record snapshotted at submit, the optional
+    streaming ``sink`` (token chunks + ``None`` sentinel, delivered while
+    the lane decodes), and the cancellation event the queue-deadline
+    protocol honors while the request is still QUEUED (an admitted request
+    always finishes)."""
 
     __slots__ = ("rid", "prompt", "temperature", "max_tokens", "top_k",
                  "top_p", "rec", "out", "t_enq", "cancelled", "admitted",
-                 "end", "end_row", "first_gen", "prompt_rows", "tag")
+                 "end", "end_row", "first_gen", "prompt_rows", "tag",
+                 "sink", "rstream", "t_admitted")
 
     def __init__(self, rid: int, prompt, temperature, max_tokens,
-                 top_k, top_p, rec):
+                 top_k, top_p, rec, sink=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.temperature = temperature
@@ -150,6 +153,9 @@ class _BatchRequest:
         self.t_enq = time.monotonic()
         self.cancelled = threading.Event()
         self.admitted = threading.Event()
+        self.sink = sink
+        self.rstream: typing.Optional[_RowStream] = None
+        self.t_admitted: typing.Optional[float] = None
 
 
 class BatchEngine:
@@ -225,9 +231,21 @@ class BatchEngine:
         self._pending = 0  # submitted, not yet admitted (queue_depth)
         self._closed = False
         self._batch_observer: typing.Optional[typing.Callable] = None
+        self._step_observer: typing.Optional[typing.Callable] = None
+        # serving trace (docs/observability.md "Streaming and inter-token
+        # latency"): decode-loop phase spans on the scheduler thread's
+        # track plus one virtual track per lane (prefilling/occupied with
+        # request ids — idle shows as gaps), exported Chrome-trace JSON at
+        # close(), alongside the training trace's format
+        self.tracer = None
+        self._trace_path = str(getattr(cfg, "serve_trace_path", "") or "")
+        if self._trace_path:
+            from ..obs.spans import SpanTracer
+            self.tracer = SpanTracer()
         self._rid = 0
         self._pad_rng = np.random.default_rng(cfg.data_seed)
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="batch-engine")
         self._thread.start()
 
     # -- executables ---------------------------------------------------------
@@ -356,13 +374,28 @@ class BatchEngine:
         called with the number of active lanes after each step."""
         self._batch_observer = fn
 
+    def set_step_observer(self, fn: typing.Optional[typing.Callable]
+                          ) -> None:
+        """Per-iteration phase sink (``ServeSLO.observe_step``): called
+        with ``(wall_s, phases, n_active, prefill_stall_s, stepped)`` after
+        every scheduler-loop iteration that did work.  The phase dict's
+        values are contiguous host segments of the iteration, so they sum
+        to ``wall_s`` (docs/observability.md "Streaming and inter-token
+        latency")."""
+        self._step_observer = fn
+
     def submit(self, prompt: typing.Sequence[int], temperature: float,
                max_tokens: typing.Optional[int],
                top_k: typing.Optional[int],
-               top_p: typing.Optional[float]) -> _BatchRequest:
+               top_p: typing.Optional[float],
+               token_sink: typing.Optional["queue.Queue"] = None
+               ) -> _BatchRequest:
         """Queue a completion; sheds immediately (503 semantics) when the
         backlog exceeds ``serve_queue_limit`` or the request's whole KV
-        footprint can never fit the pool."""
+        footprint can never fit the pool.  ``token_sink`` (streaming):
+        completion-token chunks are pushed in generation order while the
+        lane decodes, then a ``None`` sentinel — always delivered, success
+        or failure."""
         cfg = self.cfg
         prompt = list(prompt)[:self.rows * self.patch]
         depth = self.queue_depth()
@@ -386,7 +419,8 @@ class BatchEngine:
                 raise RuntimeError("engine is closed")
             self._rid += 1
             req = _BatchRequest(self._rid, prompt, float(temperature),
-                                max_tokens, int(k), float(p), rec)
+                                max_tokens, int(k), float(p), rec,
+                                sink=token_sink)
             req.end = end
             self._queue.append(req)
             self._pending += 1
@@ -397,12 +431,15 @@ class BatchEngine:
                         temperature: typing.Optional[float] = None,
                         max_tokens: typing.Optional[int] = None,
                         top_k: typing.Optional[int] = None,
-                        top_p: typing.Optional[float] = None) -> np.ndarray:
+                        top_p: typing.Optional[float] = None,
+                        token_sink: typing.Optional[
+                            "queue.Queue"] = None) -> np.ndarray:
         """Blocking convenience with the CompletionEngine signature."""
         cfg = self.cfg
         req = self.submit(prompt,
                           cfg.sampling_temperature if temperature is None
-                          else temperature, max_tokens, top_k, top_p)
+                          else temperature, max_tokens, top_k, top_p,
+                          token_sink=token_sink)
         return self.fetch(req)
 
     def fetch(self, req: _BatchRequest,
@@ -433,6 +470,19 @@ class BatchEngine:
             self._closed = True
             self._cv.notify_all()
         self._thread.join(timeout=30.0)
+        self.export_trace()
+
+    def export_trace(self) -> typing.Optional[str]:
+        """Write the serving Chrome trace (``serve_trace_path``): decode
+        phase spans + per-lane occupancy tracks; None when tracing is
+        off.  Safe to call repeatedly (close() calls it; a test may call
+        earlier for a mid-flight snapshot)."""
+        if self.tracer is None or not self._trace_path:
+            return None
+        try:
+            return self.tracer.export(self._trace_path)
+        except OSError:
+            return None
 
     # -- scheduler thread ----------------------------------------------------
     def _pad_prompt(self, req: _BatchRequest) -> np.ndarray:
@@ -446,18 +496,29 @@ class BatchEngine:
         flat[:len(req.prompt)] = np.asarray(req.prompt, np.int32)
         return flat.reshape(1, self.rows, self.patch)
 
-    def _admit(self) -> None:
+    def _admit(self, prefill_segs: typing.List[tuple],
+               stall: typing.List[float]) -> None:
         """Fill free lanes from the queue between decode steps: allocate
         the KV-block footprint, prefill the lane, arm the mirrors.  Stops
         at the first request the pool cannot hold RIGHT NOW (FIFO — a
-        small request never starves a big one already at the head)."""
+        small request never starves a big one already at the head).
+
+        ``prefill_segs`` collects each prefill's ``(t0, t1, lane, rid)``
+        host segment; ``stall[0]`` accumulates the slice of that wall spent
+        while OTHER lanes held active requests — decode blocked on
+        admission prefill, the direct cost of running prefill on the
+        scheduler thread (docs/observability.md)."""
         while True:
             with self._cv:
                 live = [r for r in self._queue if not r.cancelled.is_set()]
-                dropped = len(self._queue) - len(live)
+                dropped = [r for r in self._queue if r.cancelled.is_set()]
                 if dropped:
                     self._queue[:] = live
-                    self._pending -= dropped
+                    self._pending -= len(dropped)
+            for r in dropped:
+                if r.sink is not None:  # cancelled before admission: the
+                    r.sink.put(None)    # stream ends with just the sentinel
+            with self._cv:
                 if not self._queue:
                     return
                 try:
@@ -469,9 +530,11 @@ class BatchEngine:
                     return
                 self._queue.pop(0)
                 self._pending -= 1
-            self._start_request(req, lane)
+            self._start_request(req, lane, prefill_segs, stall)
 
-    def _start_request(self, req: _BatchRequest, lane: int) -> None:
+    def _start_request(self, req: _BatchRequest, lane: int,
+                       prefill_segs: typing.List[tuple],
+                       stall: typing.List[float]) -> None:
         cfg = self.cfg
         rec = req.rec
         req.admitted.set()
@@ -488,10 +551,26 @@ class BatchEngine:
             rec.tokens_generated = max(0, req.end - len(req.prompt))
         if req.tag:
             slo.register_first_token(req.tag, rec.mark_first_token)
+        padded = self._pad_prompt(req)
+        if req.sink is not None:
+            # streaming: chunks concatenate to exactly the completion; the
+            # host-built padded layout covers positions decode never
+            # rewrites (the seed row of an empty prompt)
+            req.rstream = _RowStream(req.sink, len(req.prompt), req.end,
+                                     self.patch, req.first_gen,
+                                     initial_tokens=padded.reshape(-1),
+                                     rec=rec)
+        # prefill is timed INCLUDING the device wall (block_until_ready):
+        # the scheduler thread would pay it at the next step's sync anyway,
+        # and attributing it here is the whole point — this segment, while
+        # other lanes sit active, is hbnlp_serve_prefill_stall_seconds
+        others_active = self.active_lanes() > 0
+        t_p0 = time.perf_counter()
         try:
             self._caches, self._toks = self._prefill(
-                self.params, self._caches, self._toks, self._pad_prompt(req),
+                self.params, self._caches, self._toks, padded,
                 np.int32(lane), np.int32(prompt_rows))
+            jax.block_until_ready(self._toks)
         except Exception as e:  # noqa: BLE001 - fail THIS request, keep serving
             # the request is already admitted (deadline-cancel disabled) and
             # holds blocks — an unhandled prefill error would leak both and
@@ -501,8 +580,15 @@ class BatchEngine:
                 slo.unregister_first_token(req.tag)
             if rec is not None:
                 rec.mark_engine_done()
+            if req.rstream is not None:
+                req.rstream.close()
             req.out.put(("err", e))
             return
+        t_p1 = time.perf_counter()
+        prefill_segs.append((t_p0, t_p1, lane, req.rid))
+        if others_active:
+            stall[0] += t_p1 - t_p0
+        req.t_admitted = t_p1
         self._lane_req[lane] = req
         self._pos_h[lane] = max(prompt_rows - 1, 0)
         self._end_row[lane] = req.end_row
@@ -517,45 +603,88 @@ class BatchEngine:
             # straight off the prefill, the lane never joins the loop
             self._finish_lane(lane)
 
-    def _step(self) -> None:
-        """One decode step over every active lane, then completion checks.
-        The host mirrors advance deterministically (pos += active), and
-        reading the returned positions back is the loop's pacing sync —
-        one tiny D2H per step keeps the host from racing ahead of the
-        device."""
+    def _step(self, segs: typing.List[tuple], t_start: float) -> int:
+        """One decode step over every active lane, then completion checks,
+        attributed into contiguous host segments appended to ``segs``:
+
+        - **dispatch** — building the active mask + the async decode call;
+        - **sync** — blocking on the returned positions (the loop's pacing
+          D2H; the device's decode wall lands here);
+        - **sample** — materializing sampled rows on host: streamed lanes'
+          new rows, finished lanes' outputs;
+        - **emit** — observer callbacks, TTFT/ITL stamps, sink pushes,
+          lane completion bookkeeping.
+
+        Returns the number of lanes that shared the step."""
+        prev_pos = self._pos_h.copy()
         active = (np.array([r is not None for r in self._lane_req])
                   & (self._pos_h < self._end_row - 1))
         self._caches, self._toks, self._pos, self._rng, self._logits = (
             self._decode(self.params, self._caches, self._toks, self._pos,
                          active, self._end_row, self._first_gen, self._temps,
                          self._ks, self._ps, self._rng, self._tags))
+        t_dispatch = time.perf_counter()
+        segs.append(("dispatch", t_start, t_dispatch))
         # blocks until the step lands (the loop's pacing sync); copy — the
         # zero-copy view over the device buffer is read-only, and admission
         # writes lanes into this mirror
         self._pos_h = np.array(self._pos, np.int32)
+        t_sync = time.perf_counter()
+        segs.append(("sync", t_dispatch, t_sync))
         n_active = int(active.sum())
+        # sample pass: pull every token this step made visible — streamed
+        # lanes' new rows, finished lanes' full outputs — so the emit pass
+        # below never blocks on the device
+        emissions: typing.List[tuple] = []
+        finished: typing.List[tuple] = []
+        for lane, req in enumerate(self._lane_req):
+            if req is None or not active[lane]:
+                continue
+            new_pos = int(self._pos_h[lane])
+            written = (new_pos > int(prev_pos[lane])
+                       and new_pos < int(self._end_row[lane])
+                       and new_pos < self.rows)
+            if written:
+                row = (np.asarray(self._toks[lane, new_pos]).reshape(-1)
+                       if req.rstream is not None else None)
+                emissions.append((lane, req, new_pos, row))
+            if new_pos >= int(self._end_row[lane]) - 1:
+                finished.append(
+                    (lane,
+                     np.asarray(self._toks[lane]).reshape(-1)[:req.end]))
+        t_sample = time.perf_counter()
+        segs.append(("sample", t_sync, t_sample))
         obs = self._batch_observer
         if obs is not None:
             try:
                 obs(n_active)
             except Exception:  # noqa: BLE001 - metrics must not kill serving
                 pass
-        for lane, req in enumerate(self._lane_req):
-            if req is None:
-                continue
+        for lane, req, new_pos, row in emissions:
             if (not self._graph_ttft and req.rec is not None
-                    and self._pos_h[lane] == self._first_gen[lane]):
+                    and new_pos == int(self._first_gen[lane])):
                 # host-side TTFT (AOT-cached executables carry no host
                 # callback): the lane's first generated row landed in the
                 # step that just synced — mark_first_token keeps the
                 # first stamp, so a repeated hit is a no-op
                 req.rec.mark_first_token()
-            if self._pos_h[lane] >= self._end_row[lane] - 1:
-                self._finish_lane(lane)
+            if req.rstream is not None:
+                req.rstream.on_row(new_pos, row)  # stamps mark_token
+            elif req.rec is not None:
+                # no sink: stamp the emission instant anyway — ITL is the
+                # engine's token cadence, what a streaming client of this
+                # request WOULD have seen
+                req.rec.mark_token()
+        for lane, out in finished:
+            self._finish_lane(lane, out=out)
+        segs.append(("emit", t_sample, time.perf_counter()))
+        return n_active
 
-    def _finish_lane(self, lane: int) -> None:
+    def _finish_lane(self, lane: int,
+                     out: typing.Optional[np.ndarray] = None) -> None:
         req = self._lane_req[lane]
-        out = np.asarray(self._toks[lane]).reshape(-1)[:req.end]
+        if out is None:
+            out = np.asarray(self._toks[lane]).reshape(-1)[:req.end]
         rec = req.rec
         if req.tag:
             try:  # flush the in-flight TTFT callback before unrouting
@@ -563,10 +692,19 @@ class BatchEngine:
             except Exception:  # noqa: BLE001 - older toolchains
                 pass
             slo.unregister_first_token(req.tag)
+        if req.rstream is not None:
+            req.rstream.flush_final(out)
+            req.rstream.close()
         # engine-done BEFORE publishing: the waiting handler's finish()
         # runs the instant fetch() wakes (serve/interface.py contract)
         if rec is not None:
             rec.mark_engine_done()
+        if self.tracer is not None and req.t_admitted is not None:
+            args = {"rid": req.rid}
+            if rec is not None:
+                args["request"] = rec.rid
+            self.tracer.add("occupied", req.t_admitted, time.perf_counter(),
+                            track=f"lane{lane}", **args)
         self._lane_req[lane] = None
         self._end_row[lane] = 0
         self._tags[lane] = 0
@@ -581,12 +719,58 @@ class BatchEngine:
                     self._cv.wait(timeout=0.5)
                 if self._closed and self.active_lanes() == 0 and not self._queue:
                     return
+            t0 = time.perf_counter()
+            segs: typing.List[tuple] = []  # contiguous (name, t0, t1)
+            prefill_segs: typing.List[tuple] = []
+            stall = [0.0]
+            stepped = False
+            n_active = 0
             try:
-                self._admit()
+                self._admit(prefill_segs, stall)
+                t_admit = time.perf_counter()
+                segs.append(("admit", t0, t_admit))
                 if self.active_lanes():
-                    self._step()
+                    n_active = self._step(segs, t_admit)
+                    stepped = True
             except Exception as e:  # noqa: BLE001 - fail every in-flight req
                 self._fail_all(e)
+                continue
+            self._report_iteration(t0, segs, prefill_segs, stall[0],
+                                   n_active, stepped)
+
+    def _report_iteration(self, t0: float, segs: typing.List[tuple],
+                          prefill_segs: typing.List[tuple],
+                          stall_s: float, n_active: int,
+                          stepped: bool) -> None:
+        """Close the books on one scheduler iteration: derive the phase
+        decomposition (contiguous segments, prefill carved out of admit —
+        the sum equals the iteration wall by construction), feed the step
+        observer, and record the spans/lane tracks on the serving trace."""
+        t_end = segs[-1][2] if segs else t0
+        wall = t_end - t0
+        if wall <= 0 or not segs:
+            return
+        prefill_s = sum(t1 - t0_ for t0_, t1, _, _ in prefill_segs)
+        phases = {name: 0.0 for name in slo.STEP_PHASES}
+        for name, s0, s1 in segs:
+            phases[name] = phases.get(name, 0.0) + (s1 - s0)
+        phases["admit"] = max(0.0, phases["admit"] - prefill_s)
+        phases["prefill"] = prefill_s
+        observer = self._step_observer
+        if observer is not None:
+            try:
+                observer(wall, phases, n_active, stall_s, stepped)
+            except Exception:  # noqa: BLE001 - metrics must not kill serving
+                pass
+        tracer = self.tracer
+        if tracer is not None:
+            tracer.add("engine/step", t0, t_end, active=n_active)
+            for name, s0, s1 in segs:
+                tracer.add(f"engine/{name}", s0, s1)
+            for s0, s1, lane, rid in prefill_segs:
+                tracer.add("engine/prefill", s0, s1, rid=rid)
+                tracer.add("prefilling", s0, s1, track=f"lane{lane}",
+                           rid=rid)
 
     def _fail_all(self, e: BaseException) -> None:
         for lane, req in enumerate(self._lane_req):
@@ -596,6 +780,8 @@ class BatchEngine:
                 self.allocator.free(req.rid)
                 if req.tag:
                     slo.unregister_first_token(req.tag)
+                if req.rstream is not None:
+                    req.rstream.close()
                 if req.rec is not None:
                     # stamp engine-done even on failure: an unstamped
                     # record silently drops its engine/decode observations
@@ -607,6 +793,8 @@ class BatchEngine:
             pending, self._queue = self._queue, []
             self._pending = 0
         for req in pending:
+            if req.sink is not None:
+                req.sink.put(None)
             req.out.put(("err", e))
 
 
@@ -630,12 +818,24 @@ class BatchInterface:
     def set_batch_observer(self, fn) -> None:
         self.engine.set_batch_observer(fn)
 
+    def set_step_observer(self, fn) -> None:
+        self.engine.set_step_observer(fn)
+
+    def lane_count(self) -> int:
+        """Concurrent drain width (serve_max_batch) — Retry-After pricing
+        divides the backlog by it (``ServeSLO.set_lane_count``)."""
+        return self.engine.n_lanes
+
+    def active_lanes(self) -> int:
+        return self.engine.active_lanes()
+
     def complete(self, prompt: typing.Sequence[int], temperature: float = 0.0,
                  response_len: int = 64, asynchronous: bool = False,
                  top_k: typing.Optional[int] = None,
-                 top_p: typing.Optional[float] = None):
+                 top_p: typing.Optional[float] = None,
+                 token_sink: typing.Optional["queue.Queue"] = None):
         req = self.engine.submit(prompt, temperature, response_len,
-                                 top_k, top_p)
+                                 top_k, top_p, token_sink=token_sink)
 
         def fetch():
             return self.engine.fetch(req)

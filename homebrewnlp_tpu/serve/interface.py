@@ -135,6 +135,83 @@ def effective_truncation(cfg: Config, top_k, top_p) -> typing.Tuple[int, float]:
     return k, p
 
 
+class _RowStream:
+    """In-order visible-token emission from per-row callbacks
+    (docs/observability.md "Streaming and inter-token latency").
+
+    The samplers' row callback is UNORDERED (``_fire_token_row``), so rows
+    are buffered and released in sequence; each release pushes the slice of
+    the row that belongs to the COMPLETION — clipped against the prompt
+    tail on the left (a partial prompt row is regenerated but its prompt
+    tokens are not new output) and ``end`` on the right — into ``sink`` and
+    stamps the ambient request record (``RequestRecord.mark_token``), so
+    the concatenated stream is byte-identical to the buffered response.
+
+    ``initial_tokens`` (the host-built padded layout) covers positions in
+    rows the decode loop never rewrites — e.g. the seed row of an empty
+    prompt under the KV sampler — which are emitted up front, unstamped
+    (they carry no decode-cadence information).  ``flush_final`` emits any
+    remainder from the final materialized output; ``close`` always delivers
+    the ``None`` sentinel, success or not."""
+
+    def __init__(self, sink, prompt_len: int, end: int, patch: int,
+                 first_row: int, initial_tokens=None, rec=None):
+        self.sink = sink
+        self.rec = rec
+        self.patch = int(patch)
+        self.end = int(end)
+        self.emitted = min(int(prompt_len), self.end)
+        self.next_row = int(first_row)
+        self.buf: typing.Dict[int, typing.List[int]] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        if initial_tokens is not None:
+            gap_hi = min(self.next_row * self.patch, self.end)
+            if gap_hi > self.emitted:
+                self._push(
+                    [int(t) for t in initial_tokens[self.emitted:gap_hi]],
+                    stamp=False)
+                self.emitted = gap_hi
+
+    def _push(self, toks: typing.List[int], stamp: bool = True) -> None:
+        if not toks:
+            return
+        if stamp and self.rec is not None:
+            self.rec.mark_token()
+        if self.sink is not None:
+            self.sink.put(list(toks))
+
+    def on_row(self, pos: int, row_tokens: typing.Sequence[int]) -> None:
+        """Callback sink: buffer row ``pos``, release everything in order."""
+        with self._lock:
+            self.buf[int(pos)] = [int(t) for t in row_tokens]
+            while self.next_row in self.buf:
+                row = self.buf.pop(self.next_row)
+                lo = max(self.emitted, self.next_row * self.patch)
+                hi = min((self.next_row + 1) * self.patch, self.end)
+                if hi > lo:
+                    off = lo - self.next_row * self.patch
+                    self._push(row[off:off + (hi - lo)])
+                    self.emitted = hi
+                self.next_row += 1
+
+    def flush_final(self, out_tokens: typing.Sequence[int]) -> None:
+        """Emit whatever the row callbacks did not cover, from the final
+        output — makes the stream complete regardless of which rows fired
+        (callbacks are best-effort by contract)."""
+        with self._lock:
+            if self.emitted < self.end:
+                self._push([int(t)
+                            for t in out_tokens[self.emitted:self.end]])
+                self.emitted = self.end
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed and self.sink is not None:
+                self._closed = True
+                self.sink.put(None)
+
+
 class CompletionEngine:
     """Jit-compiled prompt completion (the reference's query loop,
     interface.py:177-220, with the padding behavior of ``complete``:
@@ -144,6 +221,8 @@ class CompletionEngine:
     def __init__(self, cfg: Config, params: dict,
                  force_rebuild: bool = False,
                  first_token_callback: typing.Optional[
+                     typing.Callable] = None,
+                 token_callback: typing.Optional[
                      typing.Callable] = None):
         """``force_rebuild`` pins the rebuild-everything sampler even for
         KV-cache-eligible configs (the similarity debug mode exercises the
@@ -152,11 +231,15 @@ class CompletionEngine:
         ``first_token_callback`` (host ``(tag, token)``) arms the serving
         TTFT hook in every sampler this engine compiles: the graph notifies
         the host at the first generated position, carrying the request id
-        the ambient :mod:`slo` record supplied.  None (the default, and
-        every non-serving caller) keeps the sampler graphs byte-identical
-        to the pre-hook ones."""
+        the ambient :mod:`slo` record supplied.  ``token_callback`` (host
+        ``(tag, pos, row)``) arms the per-row streaming hook the same way
+        (runtime-gated per request by the traced stream flag, so only
+        ``complete_tokens(..., token_sink=...)`` calls ever fire it).
+        None (the default, and every non-serving caller) keeps the sampler
+        graphs byte-identical to the pre-hook ones."""
         self.cfg = cfg
         self._first_token_cb = first_token_callback
+        self._token_cb = token_callback
         from ..models import pipeline_params_stacked, unstack_pipeline_params
         if pipeline_params_stacked(cfg, params):
             # pipeline-trained checkpoints store body params stage-stacked;
@@ -178,9 +261,11 @@ class CompletionEngine:
         from ..infer.kv_cache import cache_eligible, make_cached_text_sampler
         if cache_eligible(cfg) and not self._force_rebuild:
             return make_cached_text_sampler(
-                cfg, self.params, first_token_callback=self._first_token_cb)
+                cfg, self.params, first_token_callback=self._first_token_cb,
+                token_callback=self._token_cb)
         return make_text_sampler(cfg, self.params,
-                                 first_token_callback=self._first_token_cb)
+                                 first_token_callback=self._first_token_cb,
+                                 token_callback=self._token_cb)
 
     def _sampler_for(self, top_k, top_p):
         """Per-request truncation: the knobs are compile-time static, so
@@ -208,11 +293,19 @@ class CompletionEngine:
                         temperature: typing.Optional[float] = None,
                         max_tokens: typing.Optional[int] = None,
                         top_k: typing.Optional[int] = None,
-                        top_p: typing.Optional[float] = None) -> np.ndarray:
+                        top_p: typing.Optional[float] = None,
+                        token_sink: typing.Optional[
+                            "queue.Queue"] = None) -> np.ndarray:
         """Returns the flat token stream (prompt + completion), truncated to
         ``len(prompt) + max_tokens`` tokens.  The sampler works in rows of
         ``token_patch_size`` tokens; the prompt is laid out row-major and the
-        loop stops at the last row needed."""
+        loop stops at the last row needed.
+
+        ``token_sink`` (streaming, needs the engine's ``token_callback``
+        armed): completion tokens are pushed into the queue in generation
+        order WHILE the sampler runs — row-callback chunks, then a final
+        remainder, then a ``None`` sentinel (always delivered, success or
+        error); the concatenated chunks equal the returned completion."""
         cfg = self.cfg
         patch = cfg.token_patch_size
         rows = cfg.sequence_length // patch
@@ -234,19 +327,42 @@ class CompletionEngine:
         # the tag is a TRACED argument, so every request shares one
         # compilation.  Tag 0 = no request / hook unarmed (never dispatched).
         rec = slo.current()
-        tag = (rec.rid if rec is not None and self._first_token_cb is not None
-               else 0)
+        streaming = token_sink is not None and self._token_cb is not None
+        tag = (rec.rid if rec is not None
+               and (self._first_token_cb is not None or streaming)
+               else (slo.allocate_tag() if streaming else 0))
         if rec is not None:
             rec.tokens_generated = max(0, end - len(prompt))
-        if tag:
+        if tag and self._first_token_cb is not None and rec is not None:
             slo.register_first_token(tag, rec.mark_first_token)
+        rstream = None
+        if streaming:
+            from ..infer.kv_cache import cache_eligible
+            # the KV sampler's loop never rewrites rows before
+            # max(initial_pos, 1) (row 0 of an empty prompt is the seed
+            # row); the rebuild sampler fires from initial_pos itself
+            first_row = (max(prompt_rows, 1)
+                         if cache_eligible(cfg) and not self._force_rebuild
+                         else prompt_rows)
+            rstream = _RowStream(token_sink, len(prompt), end, patch,
+                                 first_row,
+                                 initial_tokens=np.asarray(flat), rec=rec)
+            slo.register_token_sink(tag, rstream.on_row)
+        elif token_sink is not None:
+            # streaming requested but the engine's token hook is unarmed:
+            # degrade to one final chunk (the sentinel contract holds)
+            rstream = _RowStream(token_sink, len(prompt), end, patch,
+                                 end_row, rec=rec)
         try:
             out = self._sampler_for(top_k, top_p)(
                 NT(toks, TEXT_AXES), np.int32(prompt_rows),
                 np.float32(cfg.sampling_temperature if temperature is None
                            else temperature),
-                sample_key, np.int32(end_row), np.int32(tag))
+                sample_key, np.int32(end_row), np.int32(tag),
+                np.int32(1 if streaming else 0))
             out = np.asarray(out).reshape(-1)
+            if rstream is not None:
+                rstream.flush_final(out[:end])
         finally:
             if tag:
                 try:  # flush any in-flight debug callback before unrouting
@@ -254,6 +370,10 @@ class CompletionEngine:
                 except Exception:  # noqa: BLE001 - older toolchains
                     pass
                 slo.unregister_first_token(tag)
+                if streaming:
+                    slo.unregister_token_sink(tag)
+            if rstream is not None:
+                rstream.close()
         return out[:end]
 
     def complete_text(self, prompt: str, temperature=None, max_tokens=None,
@@ -378,7 +498,8 @@ class InterfaceWrapper:
     def complete(self, prompt: typing.Sequence[int], temperature: float = 0.0,
                  response_len: int = 64, asynchronous: bool = False,
                  top_k: typing.Optional[int] = None,
-                 top_p: typing.Optional[float] = None):
+                 top_p: typing.Optional[float] = None,
+                 token_sink: typing.Optional["queue.Queue"] = None):
         depth = self.queue_depth()
         if self.queue_limit and depth >= self.queue_limit:
             raise QueueDeadlineExceeded(0.0, self.queue_deadline_s, depth,
@@ -386,8 +507,13 @@ class InterfaceWrapper:
         rec = slo.current()
         if rec is not None:
             rec.mark_enqueued(queue_depth=depth)
-        job = _Job(self.engine.complete_tokens,
-                   (prompt, temperature, response_len, top_k, top_p), rec)
+        args = (prompt, temperature, response_len, top_k, top_p)
+        if token_sink is not None:
+            # streamed completions ride the same worker queue; the engine
+            # delivers chunks + the None sentinel through the sink while
+            # the job runs (complete_tokens' sentinel contract)
+            args = args + (token_sink,)
+        job = _Job(self.engine.complete_tokens, args, rec)
         with self._pending_lock:
             self._pending += 1
         self._q.put(job)

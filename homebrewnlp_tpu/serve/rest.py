@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import queue
 import threading
 import time
 import typing
@@ -61,6 +62,13 @@ class RestAPI:
         # (serve/engine.py); the default keeps the serialized path
         # bit-identical to the pre-engine behavior
         from .engine import BatchEngine, BatchInterface, use_batch_engine
+        # streaming (serve_stream, default on): the batch engine pushes
+        # token chunks from its host loop; the serialized samplers arm the
+        # per-row token callback (traced stream flag — a buffered request
+        # never pays a host round-trip).  serve_stream=False keeps the
+        # samplers callback-free and every stream=true request buffered.
+        streaming = bool(getattr(cfg, "serve_stream", True))
+        token_cb = slo_mod.dispatch_token_row if streaming else None
         if use_batch_engine(cfg):
             self.engine = BatchEngine(
                 cfg, params,
@@ -74,8 +82,10 @@ class RestAPI:
                     cfg.serve_max_batch)
             self.engine = CompletionEngine(
                 cfg, params,
-                first_token_callback=slo_mod.dispatch_first_token)
+                first_token_callback=slo_mod.dispatch_first_token,
+                token_callback=token_cb)
             self.wrapper = InterfaceWrapper(self.engine)
+        self.streaming = streaming
 
     # -- endpoints -----------------------------------------------------------
     def encode(self, body: dict) -> dict:
@@ -121,8 +131,87 @@ class RestAPI:
         return dict({"completion": self.engine.tokenizer.decode(
             np.asarray(out)[len(ids):])}, **echo)
 
+    # -- streaming (docs/observability.md "Streaming and inter-token
+    # latency"): ``stream: true`` on a completion endpoint answers SSE —
+    # one ``data:`` event per token chunk as the engine emits it, then a
+    # final event carrying the exact buffered-response payload + ``done``.
+    # The generator is primed BEFORE headers go out, so admission shedding
+    # still maps to a clean 503.
+    def _stream(self, toks: typing.List[int], body: dict,
+                decode_text: bool, prompt_len: int):
+        cfg = self.cfg
+        kwargs, echo = self._truncation(body)
+        sink: "queue.Queue" = queue.Queue()
+        fetch = self.wrapper.complete(
+            toks, float(body.get("temperature", cfg.sampling_temperature)),
+            int(body.get("response_len", 64)), asynchronous=True,
+            token_sink=sink, **kwargs)
+        poll = max(0.01, float(cfg.default_sleep_duration))
+        deadline = float(getattr(cfg, "serve_queue_deadline_s", 0.0))
+        t0 = time.monotonic()
+        state: dict = {"done": False, "result": None, "error": None,
+                       "thread": None}
+
+        def do_fetch():
+            try:
+                state["result"] = fetch()
+            except BaseException as e:  # noqa: BLE001 - re-raised in gen
+                state["error"] = e
+            state["done"] = True
+
+        def gen():
+            # the deadline-cancel protocol lives in fetch(), but fetch()
+            # BLOCKS until completion once the request is admitted — run
+            # it on a side thread so a still-QUEUED request past the
+            # deadline is cancelled (the error surfaces on the next poll)
+            # while an admitted request's chunks keep streaming instead
+            # of bursting at the end
+            while True:
+                try:
+                    item = sink.get(timeout=poll)
+                except queue.Empty:
+                    if state["error"] is not None:
+                        raise state["error"]
+                    if (deadline and state["thread"] is None
+                            and not state["done"]
+                            and time.monotonic() - t0 > deadline):
+                        t = threading.Thread(target=do_fetch, daemon=True)
+                        state["thread"] = t
+                        t.start()
+                    continue
+                if item is None:
+                    break
+                yield ({"text": self.engine.tokenizer.decode(item)}
+                       if decode_text else {"tokens": list(item)})
+            # sentinel delivered: the result lands immediately after
+            if state["thread"] is not None:
+                state["thread"].join()
+            elif not state["done"]:
+                do_fetch()
+            if state["error"] is not None:
+                raise state["error"]
+            out = np.asarray(state["result"])
+            final = ({"completion": self.engine.tokenizer.decode(
+                          out[prompt_len:])} if decode_text
+                     else {"completion": out.tolist()})
+            yield dict(final, done=True, **echo)
+        return gen()
+
+    def token_completion_stream(self, body: dict):
+        toks = _sanitize_tokens(body.get("prompt", body.get("tokens", [])),
+                                self.cfg.vocab_size)
+        return self._stream(toks, body, decode_text=False,
+                            prompt_len=len(toks))
+
+    def completion_stream(self, body: dict):
+        ids = self.engine.tokenizer.encode(body["prompt"])
+        return self._stream(ids, body, decode_text=True,
+                            prompt_len=len(ids))
+
     ENDPOINTS = ("encode", "decode", "check_tokens", "token_completion",
                  "completion")
+    #: endpoints honoring ``stream: true`` (SSE) when serve_stream is on
+    STREAM_ENDPOINTS = ("token_completion", "completion")
 
 
 class _ApiServer(ThreadingHTTPServer):
@@ -136,6 +225,7 @@ class _ApiServer(ThreadingHTTPServer):
     _obs_server = None
     _slo_probe = None
     _kv_probe = None
+    _lane_probe = None
     _batch_wrapper = None
 
     def shutdown(self):
@@ -156,10 +246,15 @@ class _ApiServer(ThreadingHTTPServer):
         kv, self._kv_probe = self._kv_probe, None
         if kv is not None:
             self.slo.clear_kv_blocks_probe(kv)
+        lane, self._lane_probe = self._lane_probe, None
+        if lane is not None:
+            self.slo.clear_lane_probe(lane)
         w, self._batch_wrapper = self._batch_wrapper, None
         if w is not None:
-            try:  # detach the occupancy sink: registry outlives the server
+            try:  # detach the occupancy sinks: registry outlives the server
                 w.set_batch_observer(None)
+                if hasattr(w, "set_step_observer"):
+                    w.set_step_observer(None)
             except Exception:  # noqa: BLE001
                 pass
 
@@ -204,6 +299,24 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
         serve_slo.set_kv_blocks_probe(kv_probe)
     if wrapper is not None and hasattr(wrapper, "set_batch_observer"):
         wrapper.set_batch_observer(serve_slo.observe_batch)
+    # token-level hooks (docs/observability.md "Streaming and inter-token
+    # latency"): the engine's per-iteration phase decomposition, the live
+    # lane-occupancy gauge, the Retry-After lane divisor, and — when a
+    # serving trace is configured — the request span trails routed onto
+    # the engine's tracer so one Chrome trace holds request anatomy,
+    # decode phases, and lane timelines
+    lane_probe = (wrapper.active_lanes
+                  if wrapper is not None and hasattr(wrapper, "active_lanes")
+                  else None)
+    if lane_probe is not None:
+        serve_slo.set_lane_probe(lane_probe)
+    if wrapper is not None and hasattr(wrapper, "set_step_observer"):
+        wrapper.set_step_observer(serve_slo.observe_step)
+    if wrapper is not None and hasattr(wrapper, "lane_count"):
+        serve_slo.set_lane_count(wrapper.lane_count())
+    engine_tracer = getattr(getattr(api, "engine", None), "tracer", None)
+    if engine_tracer is not None:
+        serve_slo.tracer = engine_tracer
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
@@ -222,6 +335,16 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length) or b"{}")
                     rec.mark_parsed()
+                    stream_fn = (
+                        getattr(api, name + "_stream", None)
+                        if body.get("stream")
+                        and name in getattr(api, "STREAM_ENDPOINTS", ())
+                        and getattr(api, "streaming", True) else None)
+                    if stream_fn is not None:
+                        # SSE: the buffered path below stays byte-identical
+                        # — this branch only exists when the client asked
+                        status = self._stream_sse(stream_fn, body, name)
+                        return
                     with spans.span(f"serve/{name}"):
                         result = getattr(api, name)(body)
                     payload = json.dumps(result).encode()
@@ -261,6 +384,43 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                 LOG.debug("request id=%d method=POST path=%s status=%d "
                           "latency_ms=%.1f", rec.rid, label, status, dt * 1e3)
 
+        def _stream_sse(self, stream_fn, body: dict, name: str) -> int:
+            """Drain a streaming endpoint as Server-Sent Events.  The
+            generator is PRIMED before any header goes out (admission
+            shedding / queue-deadline still answer a clean 503 via the
+            caller's except); after the first chunk the response is
+            committed — a mid-stream engine failure is delivered as a
+            final ``error`` event on the open stream, and a client
+            disconnect (the routine SSE ending) is absorbed here: headers
+            are already on the wire, so letting it escape would make
+            do_POST stack a 500 status line onto a committed 200."""
+            with spans.span(f"serve/{name}", stream=True):
+                gen = stream_fn(body)
+                first = next(gen)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                try:
+                    self._sse_event(first)
+                    for event in gen:
+                        self._sse_event(event)
+                except OSError as e:  # client went away mid-stream
+                    LOG.debug("SSE client disconnected: %s", e)
+                except Exception as e:  # noqa: BLE001 - headers are out
+                    try:
+                        self._sse_event(
+                            {"error": f"{type(e).__name__}: {e}"[:200]})
+                    except OSError:  # disconnected while failing: give up
+                        LOG.debug("SSE client gone before error event")
+            return 200
+
+        def _sse_event(self, event: dict) -> None:
+            self.wfile.write(b"data: " + json.dumps(event).encode()
+                             + b"\n\n")
+            self.wfile.flush()
+
         def log_message(self, fmt, *args):
             # per-request records go through the registry metrics; raw
             # http.server chatter stays at debug level, off stdout
@@ -270,6 +430,7 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
     server.slo = serve_slo  # tests/bench read summaries off the live server
     server._slo_probe = slo_probe
     server._kv_probe = kv_probe
+    server._lane_probe = lane_probe
     server._batch_wrapper = (wrapper if wrapper is not None
                              and hasattr(wrapper, "set_batch_observer")
                              else None)

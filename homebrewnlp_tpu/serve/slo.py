@@ -39,8 +39,8 @@ import time
 import typing
 
 from ..obs import spans
-from ..obs.registry import (DEFAULT_BUCKETS, REGISTRY, Histogram,
-                            MetricsRegistry, bucket_quantile)
+from ..obs.registry import (DEFAULT_BUCKETS, FINE_LATENCY_BUCKETS, REGISTRY,
+                            Histogram, MetricsRegistry, bucket_quantile)
 
 #: decode-rate buckets (tokens/second) — latency buckets make no sense here
 DECODE_RATE_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
@@ -62,8 +62,27 @@ BATCH_SIZE_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0,
 #: both registration sites must agree.
 SERVE_LATENCY_BUCKETS = DEFAULT_BUCKETS + (120.0, 300.0, 600.0)
 
+#: per-token latency buckets (ITL + decode-step wall): the fine-resolution
+#: set — a decode step is orders of magnitude below the request-level
+#: buckets, and the streaming reconciliation tolerance is one bucket width
+ITL_BUCKETS = FINE_LATENCY_BUCKETS
+
+#: the decode-loop phase names the engine attributes each scheduler
+#: iteration into (docs/observability.md "Streaming and inter-token
+#: latency"); contiguous host segments, so their per-step sum equals the
+#: decode-loop wall by construction
+STEP_PHASES = ("admit", "prefill", "dispatch", "sync", "sample", "emit")
+
 _REQUEST_IDS = itertools.count(1)
 _CURRENT = threading.local()
+
+
+def allocate_tag() -> int:
+    """A fresh callback-routing tag off the request-id sequence, for
+    streaming callers with no ambient :class:`RequestRecord` (direct engine
+    use).  Shares the sequence so a synthetic tag can never collide with a
+    live request id."""
+    return next(_REQUEST_IDS)
 
 
 class RequestRecord:
@@ -74,7 +93,7 @@ class RequestRecord:
 
     __slots__ = ("rid", "path", "t_arrival", "t_parsed", "t_enqueued",
                  "t_started", "t_first_token", "t_engine_done", "t_finished",
-                 "queue_depth", "tokens_generated", "status")
+                 "queue_depth", "tokens_generated", "status", "token_times")
 
     def __init__(self, rid: int, path: str = ""):
         self.rid = rid
@@ -89,6 +108,12 @@ class RequestRecord:
         self.queue_depth: typing.Optional[int] = None
         self.tokens_generated: typing.Optional[int] = None
         self.status: typing.Optional[int] = None
+        #: emission instants — one per token-row the engine made visible
+        #: (the batch engine stamps every decode step that generated for
+        #: this request; a streaming serialized sampler stamps per row; a
+        #: non-streaming serialized request records none — its tokens only
+        #: became visible at completion)
+        self.token_times: typing.List[float] = []
 
     # -- stamps (one writer each) -------------------------------------------
     def mark_parsed(self) -> None:
@@ -106,6 +131,23 @@ class RequestRecord:
         # engine dispatcher can hand the callback straight through
         if self.t_first_token is None:
             self.t_first_token = time.perf_counter()
+
+    def mark_token(self, t: typing.Optional[float] = None) -> None:
+        """Stamp one token-row emission (the engine's writer thread is the
+        only caller).  The first stamp doubles as a first-token stamp for
+        engines without the in-graph TTFT callback."""
+        now = time.perf_counter() if t is None else t
+        self.token_times.append(now)
+        if self.t_first_token is None:
+            self.t_first_token = now
+
+    def itl_gaps(self) -> typing.List[float]:
+        """Client-visible inter-token gaps: the deltas between consecutive
+        emission stamps.  One emission (or none) yields no gaps — a
+        serialized non-streaming completion has no token-level cadence to
+        report."""
+        ts = self.token_times
+        return [max(0.0, ts[i] - ts[i - 1]) for i in range(1, len(ts))]
 
     def mark_engine_done(self) -> None:
         self.t_engine_done = time.perf_counter()
@@ -183,6 +225,41 @@ def dispatch_first_token(tag, token) -> None:
         sink(int(token))
 
 
+# -- per-row token dispatcher (streaming on the serialized samplers) ----------
+#
+# Same traced-tag design as TTFT, firing on EVERY generated row instead of
+# just the first (``infer/sampler.py::_fire_token_row``).  The callback is
+# UNORDERED — rows may land out of order — so the payload carries the row
+# position and the sink (``interface._RowStream``) reorders.
+
+_TOKEN_SINKS: typing.Dict[int, typing.Callable] = {}
+
+
+def register_token_sink(tag: int, sink: typing.Callable) -> None:
+    """Route per-row token callbacks carrying ``tag`` to
+    ``sink(pos, tokens)`` until unregistered.  Tag 0 is never dispatched
+    (the samplers' "no request" default)."""
+    with _TTFT_LOCK:
+        _TOKEN_SINKS[int(tag)] = sink
+
+
+def unregister_token_sink(tag: int) -> None:
+    with _TTFT_LOCK:
+        _TOKEN_SINKS.pop(int(tag), None)
+
+
+def dispatch_token_row(tag, pos, row) -> None:
+    """Host side of ``_fire_token_row``: resolve the traced tag and hand
+    the sink the row index + its token ids.  Unknown tags are no-ops
+    (request finished, or a caller that never registered a sink — the
+    stream flag is also traced, so un-streamed requests never fire)."""
+    with _TTFT_LOCK:
+        sink = _TOKEN_SINKS.get(int(tag))
+    if sink is not None:
+        import numpy as np
+        sink(int(pos), [int(t) for t in np.asarray(row).reshape(-1)])
+
+
 # -- ambient current record (handler thread -> endpoint -> wrapper) ----------
 
 def set_current(rec: typing.Optional[RequestRecord]
@@ -255,6 +332,44 @@ class ServeSLO:
                   "free blocks in the serving KV pool (-1 = no "
                   "block-allocated pool: serialized engine)",
                   fn=self.kv_blocks_free)
+        # token-level serving observability (docs/observability.md
+        # "Streaming and inter-token latency"): per-token cadence + the
+        # decode-loop phase decomposition the batch engine reports each
+        # scheduler iteration.  All registered up front so scrapers see a
+        # stable series set under either engine.
+        self.itl = reg.histogram(
+            "hbnlp_serve_itl_seconds",
+            "client-visible inter-token latency: gap between consecutive "
+            "token-row emissions of one request", buckets=ITL_BUCKETS)
+        self.decode_step = reg.histogram(
+            "hbnlp_serve_decode_step_seconds",
+            "wall time of one continuous-batching scheduler iteration "
+            "(admit + prefill + dispatch + sync + sample + emit)",
+            buckets=ITL_BUCKETS)
+        self.step_phase = reg.counter(
+            "hbnlp_serve_step_phase_seconds",
+            "decode-loop wall attributed per scheduler phase; the phases "
+            "sum to hbnlp_serve_decode_loop_seconds", labelnames=("phase",))
+        self.decode_loop = reg.counter(
+            "hbnlp_serve_decode_loop_seconds",
+            "total wall spent inside decode-loop iterations (excludes idle "
+            "waits between requests)")
+        self.prefill_stall = reg.counter(
+            "hbnlp_serve_prefill_stall_seconds",
+            "decode wall spent blocked on admission prefill while other "
+            "lanes held active requests (the cost of running prefill on "
+            "the decode critical path)")
+        self._lane_probe: typing.Optional[typing.Callable[[], int]] = None
+        reg.gauge("hbnlp_serve_lane_occupancy",
+                  "decode lanes currently holding a request (-1 = no "
+                  "lane scheduler: serialized engine)",
+                  fn=self.lane_occupancy)
+        #: concurrent drain width for Retry-After pricing: the batch
+        #: engine's lane count (serve_max_batch), 1 on the serialized path
+        self._lane_count = 1
+        #: optional explicit tracer for request span trails (the serving
+        #: trace, serve_trace_path); None falls back to the ambient tracer
+        self.tracer: typing.Optional[spans.SpanTracer] = None
 
     def inflight(self) -> int:
         with self._lock:
@@ -308,6 +423,50 @@ class ServeSLO:
         except Exception:  # noqa: BLE001 - a dying pool must not kill /metrics
             return -1
 
+    # -- token-level hooks (docs/observability.md "Streaming and
+    # inter-token latency") ---------------------------------------------------
+    def observe_step(self, wall_s: float,
+                     phases: typing.Optional[typing.Dict[str, float]] = None,
+                     n_active: int = 0, prefill_stall_s: float = 0.0,
+                     stepped: bool = True) -> None:
+        """Engine hook, once per scheduler-loop iteration: the iteration's
+        wall, its phase decomposition (contiguous host segments — they sum
+        to ``wall_s``), and the slice of prefill wall that stalled active
+        decode lanes.  ``stepped=False`` (an iteration that only admitted,
+        never decoded) still feeds the counters but not the per-step
+        histogram."""
+        if stepped:
+            self.decode_step.observe(float(wall_s))
+        self.decode_loop.inc(max(0.0, float(wall_s)))
+        for phase, dt in (phases or {}).items():
+            if dt > 0:
+                self.step_phase.labels(phase=phase).inc(float(dt))
+        if prefill_stall_s > 0:
+            self.prefill_stall.inc(float(prefill_stall_s))
+
+    def set_lane_probe(self, fn: typing.Callable[[], int]) -> None:
+        self._lane_probe = fn
+
+    def clear_lane_probe(self, fn: typing.Callable[[], int]) -> None:
+        """Detach ``fn`` if still installed (server teardown — same
+        pinning hazard as :meth:`clear_queue_probe`)."""
+        if self._lane_probe is fn:
+            self._lane_probe = None
+
+    def lane_occupancy(self) -> int:
+        probe = self._lane_probe
+        if probe is None:
+            return -1
+        try:
+            return int(probe())
+        except Exception:  # noqa: BLE001 - a dying engine must not kill /metrics
+            return -1
+
+    def set_lane_count(self, n: int) -> None:
+        """Concurrent drain width for :meth:`retry_after_s` (the batch
+        engine's ``serve_max_batch``; the serialized engine stays 1)."""
+        self._lane_count = max(1, int(n))
+
     def retry_after_s(self, deadline_s: float = 0.0) -> int:
         """Whole-second Retry-After hint for a shed/timed-out request: the
         current backlog priced at the engine's median busy time (the
@@ -319,11 +478,18 @@ class ServeSLO:
         in fetch), so adding them would double-count and tell clients to
         back off ~2x longer than the drain actually takes.  inflight − 1
         excludes the rejected request asking for the hint; queue depth
-        alone misses the request the engine is executing."""
+        alone misses the request the engine is executing.
+
+        The backlog drains ``lane_count`` requests at a time (the batch
+        engine's ``serve_max_batch`` lanes decode concurrently, set via
+        :meth:`set_lane_count`), so the hint divides by it — a batched
+        server would otherwise overstate Retry-After by ~the batch
+        factor."""
         p50 = self.engine.quantile(0.5)
         backlog = max(self.queue_depth(), self.inflight() - 1, 1)
         if p50 is not None and p50 > 0:
-            return max(1, int(math.ceil(p50 * backlog)))
+            return max(1, int(math.ceil(
+                p50 * backlog / max(1, self._lane_count))))
         return max(1, int(math.ceil(deadline_s))) if deadline_s else 1
 
     def begin(self, path: str = "") -> RequestRecord:
@@ -352,6 +518,8 @@ class ServeSLO:
                           (self.decode_rate, rec.decode_tokens_per_sec())):
             if val is not None:
                 hist.observe(val)
+        for gap in rec.itl_gaps():
+            self.itl.observe(gap)
         self._emit_spans(rec)
         return rec
 
@@ -366,9 +534,13 @@ class ServeSLO:
                   ("serve/prefill", rec.t_started, rec.t_first_token),
                   ("serve/decode", rec.t_first_token, rec.t_engine_done),
                   ("serve/respond", rec.t_engine_done, rec.t_finished))
+        tracer = self.tracer
         for name, t0, t1 in phases:
             if t0 is not None and t1 is not None:
-                spans.add(name, t0, t1, **tag)
+                if tracer is not None:
+                    tracer.add(name, t0, t1, **tag)
+                else:
+                    spans.add(name, t0, t1, **tag)
 
     # -- /healthz summary ----------------------------------------------------
     #: e2e percentiles in the slo block cover only these path children —
@@ -418,6 +590,8 @@ class ServeSLO:
                     errors += n
             except (IndexError, ValueError):
                 pass
+        loop_s = self.decode_loop.value()
+        stall_s = self.prefill_stall.value()
         return {
             "requests_total": int(total),
             "error_rate": round(errors / total, 6) if total else None,
@@ -427,6 +601,14 @@ class ServeSLO:
             "queue_wait_s": self._pcts(self.queue_wait),
             "engine_s": self._pcts(self.engine),
             "decode_tokens_per_sec": self._pcts(self.decode_rate),
+            # token-level block: None until the first emission/step — the
+            # serialized non-streaming path never populates either
+            # (parity contract, like batch_size below)
+            "itl_s": self._pcts(self.itl) if self.itl.count() else None,
+            "decode_step_s": (self._pcts(self.decode_step)
+                              if self.decode_step.count() else None),
+            "prefill_stall_fraction": (round(stall_s / loop_s, 6)
+                                       if loop_s > 0 else None),
             # None until a batching engine serves its first step; the
             # serialized path never populates it (parity contract)
             "batch_size": (self._pcts(self.batch_size)
@@ -434,4 +616,6 @@ class ServeSLO:
             "kv_blocks_free": (self.kv_blocks_free()
                                if self._kv_blocks_probe is not None
                                else None),
+            "lane_occupancy": (self.lane_occupancy()
+                               if self._lane_probe is not None else None),
         }

@@ -193,6 +193,34 @@ def test_federate_rejects_mismatched_bucket_edges_loudly():
     assert "hbnlp_fleet_merge_errors 1" in out
 
 
+def test_federate_excludes_serve_gauge_sentinels_from_aggregates():
+    """ISSUE-14 satellite: -1 on hbnlp_serve_kv_blocks_free (and the new
+    lane-occupancy gauge) is a documented "no pool / no scheduler"
+    sentinel, not a measurement — a mixed fleet (one serialized rank, one
+    batching) must not report fleet-min -1 or a mean dragged below every
+    real pool level."""
+    mixed = {0: ("# TYPE hbnlp_serve_kv_blocks_free gauge\n"
+                 "hbnlp_serve_kv_blocks_free -1\n"),
+             1: ("# TYPE hbnlp_serve_kv_blocks_free gauge\n"
+                 "hbnlp_serve_kv_blocks_free 6\n"),
+             2: ("# TYPE hbnlp_serve_kv_blocks_free gauge\n"
+                 "hbnlp_serve_kv_blocks_free 4\n")}
+    out = fleet.federate(mixed)
+    # per-rank samples keep the sentinel (the serialized rank is visible)
+    assert 'hbnlp_serve_kv_blocks_free{rank="0"} -1' in out
+    assert ('hbnlp_serve_kv_blocks_free{agg="min",rank="fleet"} 4'
+            in out), out
+    assert ('hbnlp_serve_kv_blocks_free{agg="mean",rank="fleet"} 5'
+            in out), out
+    assert ('hbnlp_serve_kv_blocks_free{agg="max",rank="fleet"} 6'
+            in out), out
+    # an all-sentinel fleet keeps the sentinel as its honest aggregate
+    all_sent = {r: ("# TYPE hbnlp_serve_lane_occupancy gauge\n"
+                    "hbnlp_serve_lane_occupancy -1\n") for r in (0, 1)}
+    out = fleet.federate(all_sent)
+    assert 'hbnlp_serve_lane_occupancy{agg="min",rank="fleet"} -1' in out
+
+
 def test_federate_merge_errors_gauge_always_present():
     """Code-review regression: the merge-error figure is recomputed per
     render, so it must be a gauge and present even at 0 — a vanishing

@@ -65,14 +65,17 @@ QUANTILES = ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))
 #: server histogram series -> report keys (serve/slo.py owns the series);
 #: batch_size is per-DECODE-STEP lane occupancy (continuous batching) —
 #: absent under the serialized engine, p50 > 1 when requests actually
-#: share decode steps
+#: share decode steps; itl_s/decode_step_s are the token-level series
+#: (absent until an engine emits token-by-token)
 SERVER_SERIES = (("e2e_s", "hbnlp_serve_request_seconds"),
                  ("ttft_s", "hbnlp_serve_ttft_seconds"),
                  ("queue_wait_s", "hbnlp_serve_queue_wait_seconds"),
                  ("engine_s", "hbnlp_serve_engine_seconds"),
                  ("decode_tokens_per_sec",
                   "hbnlp_serve_decode_tokens_per_sec"),
-                 ("batch_size", "hbnlp_serve_batch_size"))
+                 ("batch_size", "hbnlp_serve_batch_size"),
+                 ("itl_s", "hbnlp_serve_itl_seconds"),
+                 ("decode_step_s", "hbnlp_serve_decode_step_seconds"))
 
 
 def make_corpus(seed: int, n: int, vocab: int = 256, min_len: int = 4,
@@ -96,11 +99,54 @@ def _post(url: str, body: dict, timeout_s: float) -> typing.Tuple[int, dict]:
         return r.status, json.loads(r.read() or b"{}")
 
 
+def read_sse(fp) -> typing.Iterator[typing.Tuple[float, dict]]:
+    """Yield ``(arrival perf_counter, event)`` per SSE ``data:`` line from
+    a binary file-like (the serving layer frames one JSON document per
+    event, serve/rest.py).  Factored so tests can drive it with a
+    BytesIO."""
+    for line in fp:
+        if line.startswith(b"data: "):
+            yield time.perf_counter(), json.loads(line[6:])
+
+
+def _post_stream(url: str, body: dict, timeout_s: float
+                 ) -> typing.Tuple[int, dict, typing.List[float]]:
+    """POST with ``stream: true`` and drain the SSE response.  Returns
+    ``(status, final event, chunk arrival times)`` — the final event
+    carries the buffered-equivalent ``completion``; the arrival times
+    (token-chunk events only, the final event excluded) are the client
+    arm of the ITL reconciliation."""
+    data = json.dumps(dict(body, stream=True)).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    final: dict = {}
+    times: typing.List[float] = []
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        status = r.status
+        ctype = r.headers.get("Content-Type", "")
+        if not ctype.startswith("text/event-stream"):
+            # a serve_stream=false (or pre-streaming) server answers
+            # buffered JSON; treating that as an empty stream would let
+            # --stream --check pass while measuring nothing
+            raise RuntimeError(
+                f"server did not stream (Content-Type {ctype!r}); "
+                "is serve_stream enabled?")
+        for t, event in read_sse(r):
+            if event.get("done"):
+                final = event
+            elif "error" in event:
+                raise RuntimeError(f"mid-stream error: {event['error']}")
+            else:
+                times.append(t)
+    return status, final, times
+
+
 def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
              n_requests: int, concurrency: int = 4, mode: str = "closed",
              rate: typing.Optional[float] = None, ramp_s: float = 0.0,
              response_len: int = 16, temperature: float = 1.0,
-             timeout_s: float = 300.0, trace_interval_s: float = 0.05
+             timeout_s: float = 300.0, trace_interval_s: float = 0.05,
+             stream: bool = False
              ) -> typing.Tuple[typing.List[dict], typing.List[list], float,
                                bool]:
     """Fire ``n_requests`` at ``url``/token_completion; returns
@@ -110,7 +156,12 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
     ``trace_interval_s``.  ``truncated`` is True when a worker outlived
     the join budget (per-worker request share x ``timeout_s``) — the
     records then cover only part of the run and must not be treated as a
-    complete measurement (drive/check/bench all refuse to)."""
+    complete measurement (drive/check/bench all refuse to).
+
+    ``stream=True`` sends ``stream: true`` and drains each response as
+    SSE: records gain ``ttft_s`` (first chunk arrival, the client's own
+    clock) and ``itl_gaps`` (deltas between consecutive chunk arrivals) —
+    the client arm of the token-level reconciliation."""
     endpoint = url.rstrip("/") + "/token_completion"
     lock = threading.Lock()
     records: typing.List[dict] = []
@@ -134,9 +185,18 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
             inflight[0] += 1
         t0 = time.perf_counter()
         try:
-            status, out = _post(endpoint,
-                                {"prompt": prompt, "temperature": temperature,
-                                 "response_len": response_len}, timeout_s)
+            body = {"prompt": prompt, "temperature": temperature,
+                    "response_len": response_len}
+            if stream:
+                status, out, chunk_ts = _post_stream(endpoint, body,
+                                                     timeout_s)
+                if chunk_ts:
+                    rec["ttft_s"] = round(chunk_ts[0] - t0, 6)
+                    rec["itl_gaps"] = [
+                        round(chunk_ts[i] - chunk_ts[i - 1], 6)
+                        for i in range(1, len(chunk_ts))]
+            else:
+                status, out = _post(endpoint, body, timeout_s)
             rec["status"] = status
             comp = out.get("completion")
             if isinstance(comp, list):
@@ -227,7 +287,15 @@ def client_report(records: typing.Sequence[dict],
     tokens = sum(int(r.get("tokens_generated") or 0) for r in ok)
     n = len(records)
     thin = max(1, len(trace) // 200)  # bound the trace the report embeds
+    ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
+    gaps = [g for r in ok for g in (r.get("itl_gaps") or ())]
+    stream_extra = {}
+    if ttfts:
+        stream_extra["ttft_s"] = _pcts(ttfts)
+    if gaps:
+        stream_extra["itl_s"] = _pcts(gaps)
     return {
+        **stream_extra,
         "truncated": bool(truncated),
         "n_requests": n,
         "n_ok": len(ok),
@@ -328,9 +396,20 @@ def server_report(metrics_text: str) -> dict:
         row["count"] = snap["count"]
         out[key] = row
     for gauge in ("hbnlp_serve_inflight", "hbnlp_serve_queue_depth",
-                  "hbnlp_serve_kv_blocks_free"):
+                  "hbnlp_serve_kv_blocks_free", "hbnlp_serve_lane_occupancy"):
         for _, value in metrics.get(gauge, []):
             out[gauge.replace("hbnlp_serve_", "")] = value
+    # decode-loop attribution counters (batch engine only): total loop
+    # wall, the slice stalled on admission prefill, and their ratio — the
+    # number that justifies lifting prefill off the decode critical path
+    loop = sum(v for _, v in metrics.get("hbnlp_serve_decode_loop_seconds",
+                                         []))
+    stall = sum(v for _, v in metrics.get(
+        "hbnlp_serve_prefill_stall_seconds", []))
+    if loop > 0:
+        out["decode_loop_s"] = round(loop, 6)
+        out["prefill_stall_s"] = round(stall, 6)
+        out["prefill_stall_fraction"] = round(stall / loop, 6)
     return out
 
 
@@ -370,6 +449,24 @@ def reconcile_report(client: dict, metrics_text: str) -> dict:
         e50 = bucket_quantile(eng["buckets"], eng["counts"], 0.5)
         out["server_p50_engine_s"] = round(e50, 6)
         out["serialization_overhead_s"] = round(max(0.0, c - e50), 6)
+    # token-level arms (a --stream run): the client's own chunk-arrival
+    # percentiles against the server's ITL/TTFT histograms, same tolerance
+    # formula per series — one bucket width (the estimator's resolution
+    # floor) + the 25% client-stack margin
+    for key, series in (("itl", "hbnlp_serve_itl_seconds"),
+                        ("ttft", "hbnlp_serve_ttft_seconds")):
+        cp = (client.get(f"{key}_s") or {}).get("p50")
+        snap = histogram_snapshot(metrics, series)
+        if cp is None or snap is None:
+            continue
+        sp = bucket_quantile(snap["buckets"], snap["counts"], 0.5)
+        width = bucket_width_at(snap["buckets"], sp)
+        ktol = (width if width != math.inf else 0.0) + max(0.05, 0.25 * sp)
+        out[key] = {"client_p50_s": round(cp, 6),
+                    "server_p50_s": round(sp, 6),
+                    "abs_diff_s": round(abs(cp - sp), 6),
+                    "tolerance_s": round(ktol, 6),
+                    "within_tolerance": bool(abs(cp - sp) <= ktol)}
     return out
 
 
@@ -388,12 +485,17 @@ def check_ok(report: dict, max_error_rate: float = 0.0) -> bool:
     err_ok = err is not None and err <= max_error_rate
     rec_ok = (rec.get("within_tolerance", False)
               or ("skipped" in rec and bool(err)))
+    # token-level arms (streaming runs): when present they must agree too
+    for key in ("itl", "ttft"):
+        sub = rec.get(key)
+        if isinstance(sub, dict):
+            rec_ok = rec_ok and sub.get("within_tolerance", False)
     return err_ok and rec_ok
 
 
 # -- per-request log ----------------------------------------------------------
 
-LOG_FIELDS = ("id", "t_send_s", "e2e_s", "status", "prompt_len",
+LOG_FIELDS = ("id", "t_send_s", "e2e_s", "ttft_s", "status", "prompt_len",
               "tokens_generated", "retry_after_s", "error")
 
 
@@ -430,7 +532,8 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
           max_prompt: int = 24, response_len: int = 16,
           temperature: float = 1.0, timeout_s: float = 300.0,
           log_path: typing.Optional[str] = None,
-          log_format: typing.Optional[str] = None) -> dict:
+          log_format: typing.Optional[str] = None,
+          stream: bool = False) -> dict:
     """One full run: corpus -> load -> client report -> server scrape ->
     reconciliation.  The importable entry bench.py and the tests share."""
     corpus = make_corpus(seed, max(8, n_requests), vocab, min_prompt,
@@ -438,9 +541,10 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
     records, trace, duration, truncated = run_load(
         url, corpus, n_requests, concurrency=concurrency, mode=mode,
         rate=rate, ramp_s=ramp_s, response_len=response_len,
-        temperature=temperature, timeout_s=timeout_s)
+        temperature=temperature, timeout_s=timeout_s, stream=stream)
     report = {"url": url, "mode": mode, "concurrency": concurrency,
               "rate": rate, "seed": seed, "response_len": response_len,
+              "stream": bool(stream),
               "client": client_report(records, trace, duration,
                                       truncated=truncated)}
     if log_path:
@@ -478,6 +582,10 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     ap.add_argument("--response-len", type=int, default=16)
     ap.add_argument("--temperature", type=float, default=1.0)
     ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--stream", action="store_true",
+                    help="drive SSE streaming requests and measure "
+                         "client-side TTFT + inter-token latency (adds the "
+                         "itl/ttft reconciliation arms)")
     ap.add_argument("--log", default="", help="per-request log (.jsonl/.csv)")
     ap.add_argument("--json", action="store_true",
                     help="print the full report as one JSON document")
@@ -495,7 +603,8 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                        max_prompt=args.max_prompt,
                        response_len=args.response_len,
                        temperature=args.temperature,
-                       timeout_s=args.timeout_s, log_path=args.log or None)
+                       timeout_s=args.timeout_s, log_path=args.log or None,
+                       stream=args.stream)
     except (OSError, ValueError) as e:
         print(f"graftload: {e}", file=sys.stderr)
         return 2
@@ -508,7 +617,10 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
               f"{c['goodput_tok_s']} tok/s goodput")
         if c.get("e2e_s"):
             print("client e2e_s: " + json.dumps(c["e2e_s"]))
-        for key in ("ttft_s", "queue_wait_s", "engine_s", "e2e_s"):
+        for key in ("ttft_s", "itl_s"):
+            if c.get(key):
+                print(f"client {key}: " + json.dumps(c[key]))
+        for key in ("ttft_s", "itl_s", "queue_wait_s", "engine_s", "e2e_s"):
             row = report.get("server", {}).get(key)
             if row:
                 print(f"server {key}: " + json.dumps(row))
