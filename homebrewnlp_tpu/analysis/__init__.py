@@ -12,7 +12,13 @@ them BEFORE compilation, on CPU, in seconds:
   no FLOPs, no XLA compile) and exposes the jaxprs plus donation metadata.
 - :mod:`~homebrewnlp_tpu.analysis.graph_rules` runs rule passes over those
   jaxprs: collective census vs golden budgets, dtype-promotion audit,
-  donation audit, sharding-spec validation, constant-bloat check.
+  donation audit (train state AND the batch engine's pooled serving
+  state), sharding-spec validation, constant-bloat check.
+- :mod:`~homebrewnlp_tpu.analysis.spmd` propagates PartitionSpecs through
+  the traced jaxprs to census the IMPLICIT collectives GSPMD inserts
+  (ratcheted per-config goldens, conflicting-sharding lint, and an HLO
+  cross-validation mode that pins the prediction against the actually
+  compiled partitioned module).
 - :mod:`~homebrewnlp_tpu.analysis.ast_rules` lints the source tree for the
   ``NT`` named-axis discipline: axis literals against the nd registry,
   ``.x`` escape ratchet, Python-side RNG/time in traced code,
@@ -33,7 +39,7 @@ from .ast_rules import run_ast_rules  # noqa: F401
 
 GRAPH_RULES = ("collective-census", "dtype-promotion", "quant-dtype",
                "donation", "sharding-spec", "constant-bloat",
-               "resource-budget", "mesh-rank")
+               "resource-budget", "implicit-collective", "mesh-rank")
 # "dtype-promotion" appears in both: the AST pass carries its static twin
 AST_RULES = ("axis-literal", "x-escape", "traced-rng", "partitionspec-axis",
              "dtype-promotion", "host-sync", "obs-in-trace", "bare-io")

@@ -19,7 +19,10 @@ Per config x step (train / decode / prefill):
   collective is *sized* (operand bytes, scan bodies multiplied by trip
   count) and attributed to the mesh axes it crosses, then priced with an
   alpha-beta estimate from the per-topology constants table
-  (``homebrewnlp_tpu/devices.py``).
+  (``homebrewnlp_tpu/devices.py``).  The IMPLICIT collectives GSPMD will
+  insert — invisible in the traced jaxpr — are predicted by the sharding
+  propagation pass (analysis/spmd.py) and priced identically
+  (``StepResources.total_comm``).
 - **roofline verdict**: ``mxu`` / ``hbm`` / ``ici`` from the static matmul
   flop count (``train/flops.py::jaxpr_flops``), an HBM-traffic proxy
   (2 x every value produced, sharded), and the alpha-beta ICI time.
@@ -157,7 +160,10 @@ def _walk_comm_and_traffic(jaxpr, cfg, imesh, mult: int = 1,
 @dataclasses.dataclass
 class StepResources:
     """The prediction for one traced step (all byte figures per device on
-    the intended mesh; ``scaled`` components power the graftcost sweep)."""
+    the intended mesh; ``scaled`` components power the graftcost sweep).
+    ``comm`` holds the walked MANUAL collectives; ``implicit_comm`` the
+    GSPMD-inserted ones the sharding propagation predicts
+    (analysis/spmd.py) — :meth:`total_comm` is what pricing consumes."""
     hbm: typing.Dict[str, int]
     comm: CommModel
     flops_per_device: float
@@ -165,12 +171,34 @@ class StepResources:
     verdict: str
     verdict_device: str
     scaled: typing.Dict[str, typing.List[ScaledBytes]]
+    implicit_comm: CommModel = dataclasses.field(
+        default_factory=lambda: CommModel({}, {}))
+    #: propagation failure captured for sheet/debug consumers; the
+    #: implicit-collective rule reports the SAME failure as an error from
+    #: its own (memoized, shared-cache) propagate() call — this field does
+    #: not gate anything itself
+    spmd_error: str = ""
+
+    def total_comm(self) -> CommModel:
+        """Manual + implicit collectives merged per mesh axis — the ONE
+        communication total the roofline verdict, graftprof's
+        reconciliation and the mesh-search objective all price."""
+        merged = CommModel(dict(self.comm.bytes_per_axis),
+                           dict(self.comm.count_per_axis))
+        for ax, b in self.implicit_comm.bytes_per_axis.items():
+            merged.bytes_per_axis[ax] = merged.bytes_per_axis.get(ax, 0) + b
+        for ax, n in self.implicit_comm.count_per_axis.items():
+            merged.count_per_axis[ax] = merged.count_per_axis.get(ax, 0) + n
+        return merged
 
     def as_golden(self) -> dict:
         return {
             "hbm": {k: int(v) for k, v in sorted(self.hbm.items())},
             "collective_bytes_per_axis": {
                 k: int(v) for k, v in sorted(self.comm.bytes_per_axis.items())},
+            "implicit_collective_bytes_per_axis": {
+                k: int(v) for k, v in
+                sorted(self.implicit_comm.bytes_per_axis.items())},
             "flops_per_device": float(self.flops_per_device),
             "verdict": self.verdict,
         }
@@ -314,15 +342,29 @@ def step_resources(traces: ConfigTraces, step: str, st: StepTrace, imesh,
     hbm["peak"] = int(sum(v for k, v in hbm.items() if k != "peak"))
 
     comm, traffic = _walk_comm_and_traffic(st.jaxpr, cfg, imesh)
+    # implicit collectives: what GSPMD will insert for this step under this
+    # mesh (analysis/spmd.py) — priced exactly like the manual ones
+    from .spmd import implicit_comm, propagate
+    spmd_error = ""
+    implicit = CommModel({}, {})
+    try:
+        prop = propagate(st, imesh)
+        spmd_error = prop.error
+        if prop.seeded and not prop.error:
+            implicit = implicit_comm(prop, imesh)
+    except Exception as e:  # surfaced by the implicit-collective rule
+        spmd_error = f"{type(e).__name__}: {e}"
     n_dev = 1
     for v in imesh.shape.values():
         n_dev *= max(1, int(v))
     flops_dev = jaxpr_flops(st.jaxpr) / n_dev
-    verdict, vdev = _roofline(cfg, flops_dev, traffic, comm, imesh,
-                              device_kind)
-    return StepResources(hbm=hbm, comm=comm, flops_per_device=flops_dev,
-                         hbm_traffic_bytes=traffic, verdict=verdict,
-                         verdict_device=vdev, scaled=scaled)
+    res = StepResources(hbm=hbm, comm=comm, flops_per_device=flops_dev,
+                        hbm_traffic_bytes=traffic, verdict="unknown",
+                        verdict_device="", scaled=scaled,
+                        implicit_comm=implicit, spmd_error=spmd_error)
+    res.verdict, res.verdict_device = _roofline(
+        cfg, flops_dev, traffic, res.total_comm(), imesh, device_kind)
+    return res
 
 
 def static_step_times(flops_dev: float, traffic_bytes: float,
@@ -353,9 +395,11 @@ def step_static_times(res: "StepResources",
                       imesh_shape: typing.Dict[str, int],
                       device_kind: str
                       ) -> typing.Optional[typing.Dict[str, typing.Any]]:
-    """:func:`static_step_times` over an already-built prediction."""
+    """:func:`static_step_times` over an already-built prediction — the
+    communication term is :meth:`StepResources.total_comm` (manual PLUS
+    GSPMD-implicit collectives)."""
     return static_step_times(res.flops_per_device, res.hbm_traffic_bytes,
-                             res.comm, imesh_shape, device_kind)
+                             res.total_comm(), imesh_shape, device_kind)
 
 
 def _roofline(cfg, flops_dev: float, traffic: float, comm: CommModel,

@@ -239,26 +239,107 @@ def check_dtype_promotion(traces: ConfigTraces) -> typing.List[Finding]:
 
 
 def check_donation(traces: ConfigTraces) -> typing.List[Finding]:
+    findings: typing.List[Finding] = []
     st = traces.steps.get("train")
-    if st is None or st.state_info is None:
+    if st is not None and st.state_info is not None:
+        import jax
+        leaves = jax.tree_util.tree_leaves_with_path(st.state_info)
+        missing = [jax.tree_util.keystr(path) for path, info in leaves
+                   if not getattr(info, "donated", False)]
+        shown = missing[:10]
+        for name in shown:
+            findings.append(Finding(
+                "donation", "error", _loc(traces, "train"),
+                f"train-state buffer {name} is not donated — the step keeps "
+                f"a second copy live (check donate_argnums on the jitted "
+                f"step, train/state.py)"))
+        if len(missing) > len(shown):
+            findings.append(Finding(
+                "donation", "error", _loc(traces, "train"),
+                f"... and {len(missing) - len(shown)} more non-donated "
+                f"train-state buffers"))
+    findings.extend(_check_serve_donation(traces))
+    return findings
+
+
+def _check_serve_donation(traces: ConfigTraces) -> typing.List[Finding]:
+    """Serving twin of the train-state donation audit: the batch engine's
+    decode/prefill executables carry the pooled KV caches, token pool,
+    per-lane positions and rng as step state — abstractly trace the EXACT
+    jitted functions the engine compiles (serve/engine.py::jit_executables)
+    and require their pooled arguments donated.  Without donation the
+    decode loop copies the whole KV pool every step on device (the
+    ROADMAP continuous-batching residual this rule ratchets)."""
+    from .trace import decode_traceable, trace_compat
+    cfg = traces.cfg
+    if not decode_traceable(cfg) or not traces.param_shapes:
+        return []
+    from ..serve import engine
+    if not engine.use_batch_engine(cfg):
+        # the serialized path allocates per-call caches — there is no pool
+        # to donate; auditing the engine trace here would cost a full
+        # decode-graph trace per config for a code path the config never
+        # runs (the contract itself is pinned by the graftcheck tests on
+        # an engine-enabled config)
         return []
     import jax
     findings: typing.List[Finding] = []
-    leaves = jax.tree_util.tree_leaves_with_path(st.state_info)
-    missing = [jax.tree_util.keystr(path) for path, info in leaves
-               if not getattr(info, "donated", False)]
-    shown = missing[:10]
-    for name in shown:
+    params = traces.param_shapes
+    if cfg.pipeline_parallel > 1:
+        from ..models import pipeline_params_stacked, unstack_pipeline_params
+        if pipeline_params_stacked(cfg, params):
+            params = jax.eval_shape(
+                lambda p: unstack_pipeline_params(cfg, p), params)
+    if getattr(cfg, "serve_aot_cache_dir", ""):
+        # the engine deliberately compiles WITHOUT donation when it
+        # persists AOT executables (serialize_executable cannot round-trip
+        # input-output aliasing on this toolchain — serve/engine.py) —
+        # the audit below checks the donating contract the non-AOT path
+        # uses, so surface the tradeoff instead of green-lighting it
         findings.append(Finding(
-            "donation", "error", _loc(traces, "train"),
-            f"train-state buffer {name} is not donated — the step keeps a "
-            f"second copy live (check donate_argnums on the jitted step, "
-            f"train/state.py)"))
-    if len(missing) > len(shown):
-        findings.append(Finding(
-            "donation", "error", _loc(traces, "train"),
-            f"... and {len(missing) - len(shown)} more non-donated "
-            f"train-state buffers"))
+            "donation", "warning", _loc(traces, "serve"),
+            "serve_aot_cache_dir is set: the batch engine compiles its "
+            "executables WITHOUT pool donation (AOT serialization cannot "
+            "round-trip input-output aliasing) — on device every decode "
+            "step copies the whole KV pool; unset the cache dir on "
+            "memory-bound deployments or re-verify donation once the "
+            "toolchain serializes aliased executables"))
+    rows = max(1, cfg.sequence_length // cfg.token_patch_size)
+    # the pool geometry the engine actually runs (use_batch_engine gated
+    # above, so serve_max_batch > 1 here)
+    n_lanes = int(cfg.serve_max_batch)
+    try:
+        dec_jit, pre_jit = engine.jit_executables(cfg, rows, n_lanes)
+        dec_abs, pre_abs = engine.abstract_exec_args(cfg, params, rows,
+                                                     n_lanes)
+        with trace_compat():
+            audits = (("decode", dec_jit.trace(*dec_abs),
+                       engine.DECODE_DONATE_ARGNUMS,
+                       engine.DECODE_DONATE_ARG_NAMES),
+                      ("prefill", pre_jit.trace(*pre_abs),
+                       engine.PREFILL_DONATE_ARGNUMS,
+                       engine.PREFILL_DONATE_ARG_NAMES))
+    except Exception as e:
+        return findings + [Finding(
+            "donation", "warning", _loc(traces, "serve"),
+            f"serving executables failed to trace for the donation audit: "
+            f"{type(e).__name__}: {e}")]
+    for step, traced, want, arg_names in audits:
+        infos = traced.args_info[0]
+        for idx in want:
+            if idx >= len(infos):
+                continue
+            leaves = jax.tree_util.tree_leaves_with_path(infos[idx])
+            missing = [jax.tree_util.keystr(p) for p, info in leaves
+                       if not getattr(info, "donated", False)]
+            if missing:
+                findings.append(Finding(
+                    "donation", "error", _loc(traces, f"serve_{step}"),
+                    f"batch-engine {step} does not donate its "
+                    f"{arg_names.get(idx, f'arg {idx}')} "
+                    f"({len(missing)} buffer(s), e.g. {missing[0]}) — the "
+                    f"device copies the whole pool every step; check "
+                    f"donate_argnums in serve/engine.py::jit_executables"))
     return findings
 
 
@@ -422,17 +503,20 @@ def _config_tpu_size(name: str) -> typing.Optional[int]:
 def check_golden_coverage(config_names: typing.Sequence[str]
                           ) -> typing.List[Finding]:
     """Tree-wide gate (run under --all-configs): every bundled config must
-    have BOTH a census golden and a resources golden — and, when it
-    declares a multi-device topology (tpu_size > 1), a mesh golden too —
-    and no golden may outlive its config.  Previously a brand-new config
-    silently skipped the census until someone traced it by hand — coverage
-    is now an invariant, not a convention."""
+    have a census golden, a resources golden AND an spmd
+    (implicit-collective) golden — and, when it declares a multi-device
+    topology (tpu_size > 1), a mesh golden too — and no golden may outlive
+    its config.  Previously a brand-new config silently skipped the census
+    until someone traced it by hand — coverage is now an invariant, not a
+    convention."""
     from .cost_model import resources_golden_path
     from .mesh_search import mesh_golden_path
+    from .spmd import spmd_golden_path
     findings: typing.List[Finding] = []
     names = set(config_names)
     for kind, path_fn in (("census", golden_path),
                           ("resources", resources_golden_path),
+                          ("spmd", spmd_golden_path),
                           ("mesh", mesh_golden_path)):
         have = set()
         d = os.path.dirname(path_fn("_"))
@@ -465,6 +549,7 @@ def run_graph_rules(traces: ConfigTraces, update_goldens: bool = False,
                     ) -> typing.List[Finding]:
     from .cost_model import check_resource_budget
     from .mesh_search import check_mesh_rank
+    from .spmd import check_implicit_collectives
     table = {
         "collective-census": lambda t: check_collective_census(t, update_goldens),
         "dtype-promotion": check_dtype_promotion,
@@ -473,6 +558,8 @@ def run_graph_rules(traces: ConfigTraces, update_goldens: bool = False,
         "sharding-spec": check_sharding_specs,
         "constant-bloat": check_constant_bloat,
         "resource-budget": lambda t: check_resource_budget(t, update_goldens),
+        "implicit-collective":
+            lambda t: check_implicit_collectives(t, update_goldens),
         "mesh-rank": lambda t: check_mesh_rank(t, update_goldens),
     }
     findings = check_trace_errors(traces)

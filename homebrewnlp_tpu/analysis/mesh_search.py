@@ -30,15 +30,17 @@ mesh and the whole search costs one abstract trace.  ``free_axes``
 unlocks them: each distinct (seq, pipe) structure is re-traced with an
 overridden config (seconds per structure; requires the raw config dict).
 
-**Implicit data-parallel gradient all-reduce.**  The traced jaxpr only
-contains *manual* collectives (ring ppermutes, pipeline hops, sharding
-constraints); the gradient all-reduce GSPMD inserts for a >1 data axis is
-implicit and would make pure DP look free.  The searcher prices it
-analytically — per-device gradient bytes (~ the sharded param bytes) ring
-all-reduced over the data axis — on top of the walked collectives, for
-every candidate including the hand-written mesh.  Implicit *model-axis*
-activation reductions are still unpriced (a known model gap, recorded in
-docs/static_analysis.md); both sides of the comparison omit them equally.
+**Implicit collectives.**  The traced jaxpr only contains *manual*
+collectives (ring ppermutes, pipeline hops, sharding constraints); the
+collectives GSPMD inserts — the data-axis gradient all-reduce, the
+model-axis activation reductions of tensor-parallel contractions — are
+implicit and would make pure DP (and under-charge TP) look free.  The
+sharding propagation pass (``analysis/spmd.py``) predicts them per
+candidate mesh, and ``StepResources.total_comm`` folds them into the same
+alpha-beta pricing as the walked collectives, for every candidate
+including the hand-written mesh.  One propagation walk serves every
+candidate sharing a >1-axis mask, so the search still costs one abstract
+trace.
 
 Consumers: ``tools/graftmesh.py`` (ranked sheet + ``--check``), the
 ratcheted ``mesh-rank`` graph rule (per-config goldens under
@@ -53,10 +55,9 @@ import os
 import typing
 
 from ..devices import resolve_device
-from ..parallel.mesh import (DATA_AXIS, MESH_AXES, axis_sizes,
-                             mesh_factorizations)
-from .cost_model import (DEFAULT_VERDICT_DEVICE, CommModel, StepResources,
-                         format_bytes, static_step_times, step_resources)
+from ..parallel.mesh import MESH_AXES, axis_sizes, mesh_factorizations
+from .cost_model import (DEFAULT_VERDICT_DEVICE, format_bytes,
+                         static_step_times, step_resources)
 from .findings import Finding
 from .trace import ConfigTraces, trace_config
 
@@ -88,6 +89,12 @@ class MeshCandidate:
     is_hand: bool = False
     rank: int = 0
     error: str = ""
+    #: nonempty when the SPMD propagation could not price this candidate's
+    #: implicit collectives (unseeded trace / propagation failure): the
+    #: ranking then under-charges communication — exactly the pure-DP-
+    #: looks-free bug the propagation exists to prevent — so consumers
+    #: (check_mesh_rank, the graftmesh sheet) must surface it loudly
+    spmd_error: str = ""
 
     @property
     def step_s(self) -> float:
@@ -103,6 +110,8 @@ class MeshCandidate:
         return {"axes": {a: int(v) for a, v in self.key()},
                 "step_time_s": float(f"{self.step_s:.6g}"),
                 "ici_s": float(f"{self.predicted.get('ici_s', 0.0):.6g}"),
+                "implicit_ici_s": float(
+                    f"{self.predicted.get('implicit_ici_s', 0.0):.6g}"),
                 "hbm_peak_bytes": int(self.hbm_peak),
                 "fits": self.fits,
                 "rank": int(self.rank)}
@@ -142,41 +151,28 @@ class MeshSearchResult:
                             for c in self.skipped]}
 
 
-def _with_implicit_grad_allreduce(res: StepResources,
-                                  axes: typing.Dict[str, int]) -> CommModel:
-    """The walked collectives plus the implicit data-axis gradient
-    all-reduce (see module docstring): per-device grad bytes ~ per-device
-    param bytes, ring-reduced (2(n-1)/n chunk factor, one fused launch)."""
-    comm = CommModel(dict(res.comm.bytes_per_axis),
-                     dict(res.comm.count_per_axis))
-    d = int(axes.get(DATA_AXIS, 1))
-    if d > 1 and res.hbm.get("params", 0) > 0:
-        moved = int(res.hbm["params"] * 2.0 * (d - 1) / d)
-        comm.bytes_per_axis[DATA_AXIS] = (
-            comm.bytes_per_axis.get(DATA_AXIS, 0) + moved)
-        comm.count_per_axis[DATA_AXIS] = (
-            comm.count_per_axis.get(DATA_AXIS, 0) + 1)
-    return comm
-
-
 def _price(traces: ConfigTraces, step: str, axes: typing.Dict[str, int],
            device_kind: str, spec) -> MeshCandidate:
     from .graph_rules import _IntendedMesh
     st = traces.steps[step]
     res = step_resources(traces, step, st, _IntendedMesh(dict(axes)),
                          device_kind)
-    comm = _with_implicit_grad_allreduce(res, axes)
+    # manual + GSPMD-implicit collectives, both from the same walk the
+    # roofline verdict uses (StepResources.total_comm); the implicit split
+    # is priced separately too so the golden shows what propagation added
     times = static_step_times(res.flops_per_device, res.hbm_traffic_bytes,
-                              comm, dict(axes), device_kind)
+                              res.total_comm(), dict(axes), device_kind)
     assert times is not None  # device_kind is resolved before pricing
+    implicit = res.implicit_comm.times(dict(axes), spec) if spec else {}
     predicted = {"mxu_s": float(times["mxu"]), "hbm_s": float(times["hbm"]),
                  "ici_s": float(times["ici"]),
+                 "implicit_ici_s": float(sum(implicit.values())),
                  "step_s": float(max(times["mxu"], times["hbm"])
                                  + times["ici"])}
     peak = int(res.hbm["peak"])
     fits = bool(peak <= spec.hbm_bytes) if spec is not None else None
     return MeshCandidate(axes=dict(axes), predicted=predicted, hbm_peak=peak,
-                         fits=fits)
+                         fits=fits, spmd_error=res.spmd_error)
 
 
 def _assign_ranks(cands: typing.List[MeshCandidate]
@@ -365,6 +361,18 @@ def check_mesh_rank(traces: ConfigTraces,
                         f"mesh search failed: {type(e).__name__}: {e}")]
     top_k = int(getattr(cfg, "mesh_search_top_k", 3))
     hand = result.hand
+    unpriced = next((c for c in result.candidates if c.spmd_error), None)
+    if unpriced is not None:
+        # rankings computed without implicit collectives under-charge DP
+        # (the exact blind spot the propagation closed) — never compare
+        # them silently against the golden's fully-priced ranks
+        findings.append(Finding(
+            "mesh-rank", "warning", _loc(traces),
+            f"implicit collectives could not be priced for candidate "
+            f"{{{unpriced.describe()}}} ({unpriced.spmd_error}) — the "
+            f"ranking under-charges communication-heavy layouts; fix the "
+            f"sharding seeds (analysis/spmd.py) before trusting this "
+            f"sheet"))
     if result.hand_rank > top_k:
         findings.append(Finding(
             "mesh-rank", "error", _loc(traces),

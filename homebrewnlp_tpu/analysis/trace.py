@@ -68,6 +68,13 @@ class StepTrace:
     mesh: typing.Any
     args_info: typing.Any = None  # pytree of jax.stages.ArgInfo (train only)
     state_info: typing.Any = None  # the TrainState subtree of args_info
+    #: logical axis names per flattened jaxpr input (the SPMD propagation
+    #: seeds, analysis/spmd.py): one entry per invar — a tuple of axis
+    #: names (possibly empty = replicated) or None (sharding unknown; the
+    #: propagation follows instead of charging).  None entirely when the
+    #: trace path could not build the seed list.
+    in_axes: typing.Optional[typing.List[
+        typing.Optional[typing.Tuple[str, ...]]]] = None
 
 
 @dataclasses.dataclass
@@ -211,6 +218,31 @@ def abstract_params(cfg: Config, batch: typing.Dict[str, NT]
     return params, meta
 
 
+def _dict_axes(d: typing.Dict[str, typing.Any],
+               fn: typing.Callable[[str], typing.Any]) -> typing.List:
+    """Per-leaf seed entries of a flat dict in jax's flatten order (sorted
+    keys) — the building block of a StepTrace's ``in_axes``."""
+    return [fn(k) for k in sorted(d)]
+
+
+def _param_in_axes(params: typing.Dict[str, typing.Any],
+                   axes: typing.Dict[str, typing.Tuple[str, ...]]
+                   ) -> typing.List:
+    """Seed entries for a params dict: known axis metadata, else unknown
+    (e.g. pipeline-unstacked decode params whose names left the metadata)."""
+    return _dict_axes(params, lambda k: tuple(axes[k]) if k in axes else None)
+
+
+def _check_in_axes(jaxpr, entries: typing.List
+                   ) -> typing.Optional[typing.List]:
+    """The seed list is only usable when it aligns 1:1 with the flattened
+    invars; a mismatch (an arg subtree flattened differently than the seed
+    construction assumed) degrades to None — the propagation then skips the
+    step with a finding instead of mis-seeding silently."""
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    return entries if len(entries) == len(inner.invars) else None
+
+
 def _micro_sds(batch: typing.Dict[str, NT], n_micro: int
                ) -> typing.Dict[str, NT]:
     if n_micro <= 1:
@@ -244,12 +276,24 @@ def trace_train(cfg: Config, mesh=None
     # args_info mirrors the call tree: ((state, batch, rng), {}) — the
     # TrainState subtree carries the donation bits the audit needs
     state_info = args_info[0][0]
-    return (StepTrace("train", traced.jaxpr, mesh, args_info, state_info),
-            params, axes, dict(opt_state),
-            trainer.optimizer.slot_axis_names())
+    slot_axes = trainer.optimizer.slot_axis_names()
+    # SPMD seeds, in TrainState's NamedTuple flatten order (params dict,
+    # opt-slot dict-of-dicts, step scalar), then batch NTs, rng, extras
+    in_axes: typing.List = _param_in_axes(params, axes)
+    for name in sorted(opt_state):
+        in_axes += _dict_axes(
+            dict(opt_state[name]),
+            lambda k, n=name: tuple(slot_axes.get(n, {}).get(k, ())))
+    in_axes += [()]  # step counter
+    in_axes += _dict_axes(batch, lambda k: tuple(batch[k].names))
+    in_axes += [None]  # rng key
+    in_axes += [() for _ in trainer.step_extra_args()]
+    return (StepTrace("train", traced.jaxpr, mesh, args_info, state_info,
+                      in_axes=_check_in_axes(traced.jaxpr, in_axes)),
+            params, axes, dict(opt_state), slot_axes)
 
 
-def trace_eval(cfg: Config, params, mesh=None) -> StepTrace:
+def trace_eval(cfg: Config, params, mesh=None, axes=None) -> StepTrace:
     """Trace the forward/eval walk (build -> total loss)."""
     mesh = make_mesh(cfg) if mesh is None else mesh
     batch = abstract_batch(cfg)
@@ -260,7 +304,10 @@ def trace_eval(cfg: Config, params, mesh=None) -> StepTrace:
 
     with trace_compat(), mesh:
         jaxpr = jax.make_jaxpr(eval_fn)(params, batch)
-    return StepTrace("eval", jaxpr, mesh)
+    in_axes = (_param_in_axes(params, axes or {})
+               + _dict_axes(batch, lambda k: tuple(batch[k].names)))
+    return StepTrace("eval", jaxpr, mesh,
+                     in_axes=_check_in_axes(jaxpr, in_axes))
 
 
 def decode_traceable(cfg: Config) -> bool:
@@ -268,7 +315,7 @@ def decode_traceable(cfg: Config) -> bool:
     return bool(cfg.use_language) and not cfg.use_video and cache_eligible(cfg)
 
 
-def trace_prefill(cfg: Config, params, mesh=None) -> StepTrace:
+def trace_prefill(cfg: Config, params, mesh=None, axes=None) -> StepTrace:
     """Trace the decode PREFILL: one full-length forward that writes every
     prompt position's K/V at once (the serving cold path — its activation
     peak, not the per-token step's, is what bounds prompt length)."""
@@ -288,10 +335,12 @@ def trace_prefill(cfg: Config, params, mesh=None) -> StepTrace:
     with trace_compat():
         jaxpr = jax.make_jaxpr(prefill)(
             params, jnp.zeros(toks.shape, toks.dtype))
-    return StepTrace("prefill", jaxpr, mesh)
+    in_axes = _param_in_axes(params, axes or {}) + [tuple(names)]
+    return StepTrace("prefill", jaxpr, mesh,
+                     in_axes=_check_in_axes(jaxpr, in_axes))
 
 
-def trace_decode(cfg: Config, params, mesh=None) -> StepTrace:
+def trace_decode(cfg: Config, params, mesh=None, axes=None) -> StepTrace:
     """Trace ONE incremental KV-cached decode step (the serving hot path)."""
     from ..infer.kv_cache import _decode_logits
     mesh = make_mesh(cfg) if mesh is None else mesh
@@ -315,7 +364,10 @@ def trace_decode(cfg: Config, params, mesh=None) -> StepTrace:
             return _decode_logits(cfg, p, r, jnp.int32(1), c, seq, names)
 
         jaxpr = jax.make_jaxpr(decode_step)(params, row, caches)
-    return StepTrace("decode", jaxpr, mesh)
+    in_axes = (_param_in_axes(params, axes or {}) + [tuple(names)]
+               + [None] * len(jax.tree_util.tree_leaves(caches)))
+    return StepTrace("decode", jaxpr, mesh,
+                     in_axes=_check_in_axes(jaxpr, in_axes))
 
 
 def trace_config(cfg: Config, config_name: str,
@@ -347,17 +399,17 @@ def trace_config(cfg: Config, config_name: str,
             errors.setdefault("params", f"{type(e).__name__}: {e}")
     if "eval" in steps and params:
         try:
-            out["eval"] = trace_eval(cfg, params, mesh)
+            out["eval"] = trace_eval(cfg, params, mesh, axes=axes)
         except Exception as e:
             errors["eval"] = f"{type(e).__name__}: {e}"
     if "decode" in steps and params and decode_traceable(cfg):
         try:
-            out["decode"] = trace_decode(cfg, params, mesh)
+            out["decode"] = trace_decode(cfg, params, mesh, axes=axes)
         except Exception as e:
             errors["decode"] = f"{type(e).__name__}: {e}"
     if "prefill" in steps and params and decode_traceable(cfg):
         try:
-            out["prefill"] = trace_prefill(cfg, params, mesh)
+            out["prefill"] = trace_prefill(cfg, params, mesh, axes=axes)
         except Exception as e:
             errors["prefill"] = f"{type(e).__name__}: {e}"
     if params and not opt_shapes:

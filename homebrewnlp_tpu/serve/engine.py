@@ -58,8 +58,136 @@ from . import slo
 from .interface import (QueueDeadlineExceeded, _RowStream,
                         effective_truncation, tokenizer_for)
 
-#: bump when the executable calling convention changes (AOT cache keying)
+#: bump when the executable calling convention changes (AOT cache keying).
+#: Donation does NOT affect this: AOT-persisted executables are exactly the
+#: ones compiled WITHOUT donation (serialize_executable cannot round-trip
+#: input-output aliasing — see jit_executables), so the serialized calling
+#: convention is unchanged and existing caches stay valid.
 AOT_FORMAT = 1
+
+#: donated argument positions of the jitted executables (relative to the
+#: bound callables :func:`jit_executables` builds).  The pooled KV caches,
+#: token pool, per-lane positions and the rng carry are pure step state:
+#: without donation they round-trip as ordinary jit args and the device
+#: pays a FULL POOL COPY per decode step.  The ``donation`` graph rule
+#: audits these against the abstract serving traces (analysis), so a
+#: dropped donate_argnums fails graftcheck before it doubles serving HBM.
+DECODE_DONATE_ARGNUMS = (1, 2, 3, 10)  # caches, toks, pos, rng
+PREFILL_DONATE_ARGNUMS = (1, 2)  # caches, toks
+#: human names for the donated positions above, keyed per executable so
+#: the donation audit's messages stay in lockstep with the signatures —
+#: update these three tables together when reordering body arguments
+DECODE_DONATE_ARG_NAMES = {1: "pooled KV caches", 2: "token pool",
+                           3: "lane positions", 10: "rng carry"}
+PREFILL_DONATE_ARG_NAMES = {1: "pooled KV caches", 2: "token pool"}
+
+
+def decode_body(cfg: Config, rows: int, n_lanes: int,
+                first_token_cb: typing.Optional[typing.Callable],
+                params, caches, toks, pos, active, end_row,
+                first_gen, temps, ks, ps, rng, tags):
+    """One continuous-batching decode step: every ACTIVE lane decodes the
+    row at its own position, samples under its own traced knobs, and
+    writes the sampled row at position+1; inactive lanes carry through
+    untouched.  Mirrors the serialized cached sampler's body
+    (infer/kv_cache.py) with per-lane positions.  Module-level (bound via
+    ``functools.partial``) so the static donation audit traces the exact
+    function the engine compiles."""
+    rng, sub = jax.random.split(rng)
+    row = jnp.take_along_axis(toks, pos[:, None, None], axis=1)
+    logits, caches = kvc._decode_logits(cfg, params, row, pos, caches,
+                                        rows, TEXT_AXES)
+    sampled = _gumbel_argmax_lanes(logits, temps, sub, ks, ps)
+    nxt = pos + 1
+    write = active & (nxt < end_row) & (nxt < rows)
+    tgt = jnp.minimum(nxt, rows - 1)
+    cur = jnp.take_along_axis(toks, tgt[:, None, None], axis=1)
+    new_row = jnp.where(write[:, None, None],
+                        sampled.astype(toks.dtype), cur)
+    row_at = (jnp.arange(rows)[None, :] == tgt[:, None])[:, :, None]
+    toks = jnp.where(row_at, new_row, toks)
+    if first_token_cb is not None:
+        # per-lane TTFT: n_lanes is static, so this unrolls to one gated
+        # callback per lane — each fires at most once per request (its
+        # first generated row), tagged with that lane's request id
+        for b in range(n_lanes):
+            _fire_first_token(first_token_cb, tags[b],
+                              write[b] & (nxt[b] == first_gen[b]),
+                              new_row[b])
+    pos = jnp.where(active, nxt, pos)
+    return caches, toks, pos, rng, logits
+
+
+def prefill_body(cfg: Config, rows: int,
+                 params, caches, toks, prompt, lane, prompt_rows):
+    """Prefill one request into lane ``lane``: a single full-length
+    forward writes every prompt position's K/V at once (batch of 1,
+    scalar position 0 — the serialized sampler's prefill), then the lane
+    rows of every pooled cache and the token pool are overwritten (both
+    donated — the update happens in the pool's own buffers).  An empty
+    prompt skips the forward; its lane decodes from scratch."""
+    lane0 = {k: tuple(jnp.zeros((1,) + v.shape[1:], v.dtype) for v in kv)
+             for k, kv in caches.items()}
+    filled = jax.lax.cond(
+        prompt_rows > 0,
+        lambda c: kvc._decode_logits(cfg, params, prompt, jnp.int32(0),
+                                     c, rows, TEXT_AXES)[1],
+        lambda c: c, lane0)
+    out = {}
+    for name, kv in caches.items():
+        out[name] = tuple(
+            jax.lax.dynamic_update_slice(
+                pool, jnp.asarray(one, pool.dtype),
+                (lane,) + (0,) * (pool.ndim - 1))
+            for pool, one in zip(kv, filled[name]))
+    toks = jax.lax.dynamic_update_slice(toks, prompt, (lane, 0, 0))
+    return out, toks
+
+
+def jit_executables(cfg: Config, rows: int, n_lanes: int,
+                    first_token_cb: typing.Optional[
+                        typing.Callable] = None,
+                    donate: bool = True):
+    """The engine's two jitted (not yet compiled) step functions with
+    their donation contract applied — shared by :class:`BatchEngine` and
+    the ``donation`` graph rule's abstract serving trace.
+
+    ``donate=False`` is the AOT-cache compromise: this toolchain's
+    ``serialize_executable`` does not round-trip input-output aliasing
+    safely (a deserialized donated executable intermittently corrupts the
+    pool — reproduced on CPU as non-repeatable decode outputs), so
+    engines persisting to ``serve_aot_cache_dir`` compile WITHOUT
+    donation, the same class of tradeoff as their host-side TTFT stamp
+    (docs/observability.md "Continuous batching")."""
+    import functools
+    dec = functools.partial(decode_body, cfg, rows, n_lanes, first_token_cb)
+    pre = functools.partial(prefill_body, cfg, rows)
+    if not donate:
+        return jax.jit(dec), jax.jit(pre)
+    return (jax.jit(dec, donate_argnums=DECODE_DONATE_ARGNUMS),
+            jax.jit(pre, donate_argnums=PREFILL_DONATE_ARGNUMS))
+
+
+def abstract_exec_args(cfg: Config, params_tree, rows: int, n_lanes: int):
+    """Abstract (ShapeDtypeStruct) argument tuples for the decode and
+    prefill executables — ``params_tree`` may already be abstract (the
+    static analysis path passes the traced param shapes)."""
+    s = jax.ShapeDtypeStruct
+    tree = jax.tree_util.tree_map(
+        lambda a: a if isinstance(a, jax.ShapeDtypeStruct)
+        else s(jnp.shape(a), jnp.asarray(a).dtype), params_tree)
+    caches = kvc.cache_shapes(cfg, tree, n_lanes, rows)
+    lanes = (n_lanes,)
+    common = (tree, caches, s((n_lanes, rows, cfg.token_patch_size),
+                              jnp.int32))
+    rng = jax.eval_shape(lambda: jax.random.key(0))
+    decode = common + (s(lanes, jnp.int32), s(lanes, jnp.bool_),
+                       s(lanes, jnp.int32), s(lanes, jnp.int32),
+                       s(lanes, jnp.float32), s(lanes, jnp.int32),
+                       s(lanes, jnp.float32), rng, s(lanes, jnp.int32))
+    prefill = common + (s((1, rows, cfg.token_patch_size), jnp.int32),
+                        s((), jnp.int32), s((), jnp.int32))
+    return decode, prefill
 
 
 def use_batch_engine(cfg: Config) -> bool:
@@ -249,89 +377,18 @@ class BatchEngine:
         self._thread.start()
 
     # -- executables ---------------------------------------------------------
-    def _decode_body(self, params, caches, toks, pos, active, end_row,
-                     first_gen, temps, ks, ps, rng, tags):
-        """One continuous-batching decode step: every ACTIVE lane decodes
-        the row at its own position, samples under its own traced knobs,
-        and writes the sampled row at position+1; inactive lanes carry
-        through untouched.  Mirrors the serialized cached sampler's body
-        (infer/kv_cache.py) with per-lane positions."""
-        cfg = self.cfg
-        rows = self.rows
-        rng, sub = jax.random.split(rng)
-        row = jnp.take_along_axis(toks, pos[:, None, None], axis=1)
-        logits, caches = kvc._decode_logits(cfg, params, row, pos, caches,
-                                            rows, TEXT_AXES)
-        sampled = _gumbel_argmax_lanes(logits, temps, sub, ks, ps)
-        nxt = pos + 1
-        write = active & (nxt < end_row) & (nxt < rows)
-        tgt = jnp.minimum(nxt, rows - 1)
-        cur = jnp.take_along_axis(toks, tgt[:, None, None], axis=1)
-        new_row = jnp.where(write[:, None, None],
-                            sampled.astype(toks.dtype), cur)
-        row_at = (jnp.arange(rows)[None, :] == tgt[:, None])[:, :, None]
-        toks = jnp.where(row_at, new_row, toks)
-        if self._graph_ttft:
-            # per-lane TTFT: n_lanes is static, so this unrolls to one
-            # gated callback per lane — each fires at most once per
-            # request (its first generated row), tagged with that lane's
-            # request id
-            for b in range(self.n_lanes):
-                _fire_first_token(self._first_token_cb, tags[b],
-                                  write[b] & (nxt[b] == first_gen[b]),
-                                  new_row[b])
-        pos = jnp.where(active, nxt, pos)
-        return caches, toks, pos, rng, logits
-
-    def _prefill_body(self, params, caches, toks, prompt, lane, prompt_rows):
-        """Prefill one request into lane ``lane``: a single full-length
-        forward writes every prompt position's K/V at once (batch of 1,
-        scalar position 0 — the serialized sampler's prefill), then the
-        lane rows of every pooled cache and the token pool are overwritten.
-        An empty prompt skips the forward; its lane decodes from scratch."""
-        cfg = self.cfg
-        rows = self.rows
-        lane0 = {k: tuple(jnp.zeros((1,) + v.shape[1:], v.dtype) for v in kv)
-                 for k, kv in caches.items()}
-        filled = jax.lax.cond(
-            prompt_rows > 0,
-            lambda c: kvc._decode_logits(cfg, params, prompt, jnp.int32(0),
-                                         c, rows, TEXT_AXES)[1],
-            lambda c: c, lane0)
-        out = {}
-        for name, kv in caches.items():
-            out[name] = tuple(
-                jax.lax.dynamic_update_slice(
-                    pool, jnp.asarray(one, pool.dtype),
-                    (lane,) + (0,) * (pool.ndim - 1))
-                for pool, one in zip(kv, filled[name]))
-        toks = jax.lax.dynamic_update_slice(toks, prompt, (lane, 0, 0))
-        return out, toks
-
-    def _abstract_args(self):
-        s = jax.ShapeDtypeStruct
-        tree = jax.tree_util.tree_map(
-            lambda a: s(jnp.shape(a), jnp.asarray(a).dtype), self.params)
-        caches = kvc.pool_shapes(self.cfg, tree, self.rows)
-        lanes = (self.n_lanes,)
-        common = (tree, caches,
-                  s((self.n_lanes, self.rows, self.patch), jnp.int32))
-        rng = jax.eval_shape(lambda: jax.random.key(0))
-        decode = common + (s(lanes, jnp.int32), s(lanes, jnp.bool_),
-                           s(lanes, jnp.int32), s(lanes, jnp.int32),
-                           s(lanes, jnp.float32), s(lanes, jnp.int32),
-                           s(lanes, jnp.float32), rng, s(lanes, jnp.int32))
-        prefill = common + (s((1, self.rows, self.patch), jnp.int32),
-                            s((), jnp.int32), s((), jnp.int32))
-        return decode, prefill
-
     def _build_executables(self) -> None:
         """AOT-compile (or AOT-deserialize) the prefill + decode
-        executables.  The cache key covers config + params structure +
-        mesh + toolchain (``aot_cache_key``); a miss compiles and then
+        executables — both with the pooled state DONATED
+        (``DECODE_DONATE_ARGNUMS``/``PREFILL_DONATE_ARGNUMS``): the caches,
+        token pool, positions and rng are step-carried state, and without
+        input-output aliasing every decode step pays a full pool copy on
+        device.  The cache key covers config + params structure + mesh +
+        toolchain (``aot_cache_key``); a miss compiles and then
         best-effort persists both."""
         cfg = self.cfg
-        decode_abs, prefill_abs = self._abstract_args()
+        decode_abs, prefill_abs = abstract_exec_args(
+            cfg, self.params, self.rows, self.n_lanes)
         cache_dir = getattr(cfg, "serve_aot_cache_dir", "")
         dec_path = pre_path = None
         if cache_dir:
@@ -348,10 +405,13 @@ class BatchEngine:
                 self.aot_cache_hit = True
                 return
             self.aot_cache_hit = False
+        dec_jit, pre_jit = jit_executables(
+            cfg, self.rows, self.n_lanes,
+            self._first_token_cb if self._graph_ttft else None,
+            donate=not cache_dir)
         t0 = time.perf_counter()
-        self._decode = jax.jit(self._decode_body).lower(*decode_abs).compile()
-        self._prefill = jax.jit(self._prefill_body).lower(
-            *prefill_abs).compile()
+        self._decode = dec_jit.lower(*decode_abs).compile()
+        self._prefill = pre_jit.lower(*prefill_abs).compile()
         self.compile_s = time.perf_counter() - t0
         if dec_path is not None:
             _aot_save(dec_path, self._decode)
@@ -510,8 +570,14 @@ class BatchEngine:
         scheduler thread (docs/observability.md)."""
         while True:
             with self._cv:
-                live = [r for r in self._queue if not r.cancelled.is_set()]
-                dropped = [r for r in self._queue if r.cancelled.is_set()]
+                # snapshot the cancel flags ONCE: a deadline-cancel landing
+                # between two separate is_set() sweeps would put a request
+                # in BOTH lists — kept queued yet counted as dropped, and
+                # decremented again on the next prune (queue_depth
+                # underflow)
+                flags = [(r, r.cancelled.is_set()) for r in self._queue]
+                live = [r for r, c in flags if not c]
+                dropped = [r for r, c in flags if c]
                 if dropped:
                     self._queue[:] = live
                     self._pending -= len(dropped)
@@ -583,6 +649,12 @@ class BatchEngine:
             if req.rstream is not None:
                 req.rstream.close()
             req.out.put(("err", e))
+            if self._pool_deleted():
+                # the prefill DONATES the pool; a failure after dispatch
+                # consumed the buffers, so the other lanes' state is gone
+                # too — escalate to the loop's fail-everything path, which
+                # reinitializes the pool
+                raise
             return
         t_p1 = time.perf_counter()
         prefill_segs.append((t_p0, t_p1, lane, req.rid))
@@ -772,6 +844,30 @@ class BatchEngine:
                 tracer.add("prefilling", s0, s1, track=f"lane{lane}",
                            rid=rid)
 
+    def _pool_deleted(self) -> bool:
+        """Whether a donated call consumed the pooled device state without
+        returning replacements (an exception after dispatch)."""
+        try:
+            leaves = jax.tree_util.tree_leaves(self._caches)
+            leaves += [self._toks, self._pos]
+            return any(getattr(x, "is_deleted", lambda: False)()
+                       for x in leaves)
+        except Exception:  # noqa: BLE001 - conservative: assume dead
+            return True
+
+    def _reset_pool(self) -> None:
+        """Fresh zeroed pool state (caches/toks/pos/rng) after a failure
+        consumed the donated buffers — every lane was already failed, so
+        losing their K/V is the correct outcome, not a data loss."""
+        cfg = self.cfg
+        self._caches = kvc.init_caches(cfg, self.params, self.n_lanes,
+                                       self.rows)
+        self._toks = jnp.zeros((self.n_lanes, self.rows, self.patch),
+                               jnp.int32)
+        self._pos = jnp.zeros((self.n_lanes,), jnp.int32)
+        self._rng = jax.random.key(cfg.data_seed)
+        self._pos_h = np.zeros(self.n_lanes, np.int32)
+
     def _fail_all(self, e: BaseException) -> None:
         for lane, req in enumerate(self._lane_req):
             if req is not None:
@@ -796,6 +892,8 @@ class BatchEngine:
             if req.sink is not None:
                 req.sink.put(None)
             req.out.put(("err", e))
+        if self._pool_deleted():
+            self._reset_pool()
 
 
 class BatchInterface:

@@ -94,7 +94,7 @@ def test_trace_tiny_config_train_and_decode(eight_devices):
     # (golden-backed rules excluded: the ad-hoc "tiny" config has none)
     findings = [f for f in graph_rules.run_graph_rules(traces)
                 if f.rule not in ("collective-census", "resource-budget",
-                                  "mesh-rank")]
+                                  "implicit-collective", "mesh-rank")]
     errors = [f for f in findings if f.severity == "error"]
     assert not errors, [f.render() for f in errors]
 
@@ -177,6 +177,69 @@ def test_dropped_donation_is_caught(eight_devices):
     findings = graph_rules.check_donation(bad)
     assert findings and all(f.severity == "error" for f in findings)
     assert "donate" in findings[0].message
+
+
+def test_serve_donation_audit_passes_on_batch_engine_config(eight_devices):
+    """The donation rule's serving extension: a KV-cache-eligible config
+    running the continuous-batching engine traces the engine's EXACT
+    jitted decode/prefill executables and finds the pooled state donated
+    (the ROADMAP cache-donation residual, now ratcheted)."""
+    from .backend import mixer_config
+    cfg = mixer_config(depth=1, sequence_length=12, heads=2,
+                       features_per_head=16, vocab_size=32,
+                       train_batch_size=1, serve_max_batch=2)
+    traces = atrace.trace_config(cfg, "engine_tiny", steps=("train",))
+    assert graph_rules.check_donation(traces) == []
+
+
+def test_serve_donation_dropped_is_caught(eight_devices, monkeypatch):
+    """Seeded regression: stripping donate_argnums from the engine's
+    executables must fail the donation audit naming the pooled buffers."""
+    from homebrewnlp_tpu.serve import engine
+    from .backend import mixer_config
+    cfg = mixer_config(depth=1, sequence_length=12, heads=2,
+                       features_per_head=16, vocab_size=32,
+                       train_batch_size=1, serve_max_batch=2)
+    traces = atrace.trace_config(cfg, "engine_tiny", steps=("train",))
+    orig = engine.jit_executables
+
+    def undonated(cfg, rows, n_lanes, first_token_cb=None):
+        import functools
+        dec = functools.partial(engine.decode_body, cfg, rows, n_lanes,
+                                first_token_cb)
+        pre = functools.partial(engine.prefill_body, cfg, rows)
+        return jax.jit(dec), jax.jit(pre)
+
+    monkeypatch.setattr(engine, "jit_executables", undonated)
+    findings = graph_rules.check_donation(traces)
+    assert findings and all(f.severity == "error" for f in findings)
+    assert any("pooled KV caches" in f.message for f in findings)
+    assert any("serve_decode" in f.location for f in findings)
+    assert any("serve_prefill" in f.location for f in findings)
+    monkeypatch.setattr(engine, "jit_executables", orig)
+    # serialized-path configs (serve_max_batch=1) skip the engine audit
+    cfg1 = mixer_config(depth=1, sequence_length=12, heads=2,
+                        features_per_head=16, vocab_size=32,
+                        train_batch_size=1, serve_max_batch=1)
+    t1 = atrace.trace_config(cfg1, "serialized_tiny", steps=("train",))
+    assert graph_rules._check_serve_donation(t1) == []
+
+
+def test_serve_donation_warns_on_aot_no_donate_tradeoff(eight_devices,
+                                                       tmp_path):
+    """serve_aot_cache_dir engines compile undonated (the serialization
+    tradeoff) — the audit must surface that as a warning, never a silent
+    green."""
+    from .backend import mixer_config
+    cfg = mixer_config(depth=1, sequence_length=12, heads=2,
+                       features_per_head=16, vocab_size=32,
+                       train_batch_size=1, serve_max_batch=2,
+                       serve_aot_cache_dir=str(tmp_path))
+    traces = atrace.trace_config(cfg, "engine_aot", steps=("train",))
+    findings = graph_rules.check_donation(traces)
+    warns = [f for f in findings if f.severity == "warning"]
+    assert any("WITHOUT pool donation" in f.message for f in warns)
+    assert not [f for f in findings if f.severity == "error"]
 
 
 def test_constant_bloat_detected(eight_devices):
@@ -745,21 +808,25 @@ def test_golden_coverage_gate_detects_missing_and_orphans():
              _glob.glob(os.path.join(REPO, "configs", "*.json"))]
     # the committed tree is fully covered
     assert check_golden_coverage(names) == []
-    # a brand-new config without goldens is an ERROR for census AND resources
+    # a brand-new config without goldens is an ERROR for census, resources
+    # AND the spmd (implicit-collective) census
     findings = check_golden_coverage(names + ["brand_new_config"])
     errs = [f for f in findings if f.severity == "error"]
-    assert len(errs) == 2 and all("brand_new_config" in f.location
+    assert len(errs) == 3 and all("brand_new_config" in f.location
                                   for f in errs)
-    assert {("census" in f.message, "resources" in f.message)
-            for f in errs} == {(True, False), (False, True)}
+    kinds = {("census" in f.message and "spmd" not in f.message,
+              "resources" in f.message, "spmd" in f.message)
+             for f in errs}
+    assert kinds == {(True, False, False), (False, True, False),
+                     (False, False, True)}
     # a golden whose config was deleted is an orphan warning (census +
-    # resources, plus the mesh golden when the dropped config is
+    # resources + spmd, plus the mesh golden when the dropped config is
     # multi-device — mesh goldens exist only for tpu_size > 1)
     findings = check_golden_coverage(names[1:])
     orphans = [f for f in findings if f.severity == "warning"]
     raw = json.load(open(os.path.join(REPO, "configs",
                                       names[0] + ".json")))
-    want = 3 if raw.get("tpu_size", 32) > 1 else 2
+    want = 4 if raw.get("tpu_size", 32) > 1 else 3
     assert len(orphans) == want and all(names[0] in f.location
                                         for f in orphans)
 

@@ -1,6 +1,7 @@
 """graftmesh: factorization enumeration, cost-model monotonicity, search
-determinism, the implicit DP gradient all-reduce, the mesh-rank ratchet,
-mesh-golden coverage, the degraded-resume suggestion, and the CLI.
+determinism, propagation-priced implicit collectives in the objective,
+the mesh-rank ratchet, mesh-golden coverage, the degraded-resume
+suggestion, and the CLI.
 """
 import json
 import os
@@ -97,22 +98,41 @@ def test_static_step_times_monotone_in_inputs():
     assert cost_model.static_step_times(1e12, 1e9, comm, shape, "cpu") is None
 
 
-def test_implicit_dp_grad_allreduce_priced():
-    res = cost_model.StepResources(
-        hbm={"params": 1000, "peak": 1000},
-        comm=cost_model.CommModel({}, {}), flops_per_device=1.0,
-        hbm_traffic_bytes=1.0, verdict="mxu", verdict_device="v4",
-        scaled={})
-    dp = mesh_search._with_implicit_grad_allreduce(
-        res, {DATA_AXIS: 4, MODEL_AXIS: 1})
-    # 2(n-1)/n ring chunk factor over the per-device grad bytes
-    assert dp.bytes_per_axis[DATA_AXIS] == int(1000 * 2 * 3 / 4)
-    assert dp.count_per_axis[DATA_AXIS] == 1
-    nodp = mesh_search._with_implicit_grad_allreduce(
-        res, {DATA_AXIS: 1, MODEL_AXIS: 4})
-    assert DATA_AXIS not in nodp.bytes_per_axis
-    # the original walk model is never mutated
-    assert res.comm.bytes_per_axis == {}
+def test_implicit_dp_grad_allreduce_priced(pod_traces):
+    """The hand-patched analytic DP term is gone: the SPMD propagation
+    (analysis/spmd.py) now supplies the implicit gradient all-reduce —
+    a pure-DP candidate prices a nonzero data-axis ici term, a pure-TP
+    candidate prices none of it, and every candidate's implicit split is
+    recorded in the golden (``implicit_ici_s``)."""
+    cfg, traces = pod_traces
+    assert not hasattr(mesh_search, "_with_implicit_grad_allreduce")
+    result = mesh_search.search(cfg, "tinymesh", traces=traces,
+                                device_kind="v4")
+    by_model = {c.axes[MODEL_AXIS]: c for c in result.candidates}
+    dp = by_model[1]  # data8
+    assert dp.predicted["implicit_ici_s"] > 0
+    assert dp.predicted["ici_s"] >= dp.predicted["implicit_ici_s"]
+    assert all("implicit_ici_s" in c.as_golden() for c in result.candidates)
+    assert all(c.spmd_error == "" for c in result.candidates)
+
+
+def test_unseeded_trace_degrades_mesh_rank_loudly(pod_traces):
+    """A trace whose sharding seeds are gone prices implicit collectives
+    as zero — the search must carry that on every candidate and the
+    mesh-rank rule must WARN instead of silently comparing under-charged
+    ranks against the golden (the pure-DP-looks-free regression guard)."""
+    import dataclasses
+    cfg, traces = pod_traces
+    bad_st = dataclasses.replace(traces.steps["train"], in_axes=None)
+    bad = dataclasses.replace(traces, steps={"train": bad_st})
+    result = mesh_search.search(cfg, "tinymesh", traces=bad,
+                                device_kind="v4")
+    assert all(c.spmd_error for c in result.candidates)
+    assert all(c.predicted["implicit_ici_s"] == 0.0
+               for c in result.candidates)
+    findings = mesh_search.check_mesh_rank(bad)
+    assert any(f.severity == "warning" and "could not be priced"
+               in f.message for f in findings)
 
 
 # -- the search --------------------------------------------------------------

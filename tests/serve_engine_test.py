@@ -320,6 +320,53 @@ def test_kv_pricing_ignores_pool_knobs_on_serialized_path(engine_setup):
     assert kvN == kv1 * 3  # pool priced only for the batch engine
 
 
+def test_engine_executables_donate_pooled_state(engine_setup):
+    """The decode/prefill executables carry the donation contract
+    (pooled caches / token pool / positions / rng) — traced abstractly,
+    the exact check the graftcheck donation rule ratchets.  On-device
+    this is what turns the per-step full-pool copy into an in-place
+    update; CPU ignores donation, so behavior tests stay valid."""
+    import jax
+    from homebrewnlp_tpu.serve import engine
+    cfg, params = engine_setup
+    rows = cfg.sequence_length // cfg.token_patch_size
+    dec_jit, pre_jit = engine.jit_executables(cfg, rows, cfg.serve_max_batch)
+    dec_abs, pre_abs = engine.abstract_exec_args(cfg, params, rows,
+                                                 cfg.serve_max_batch)
+    for jitted, abs_args, want in (
+            (dec_jit, dec_abs, engine.DECODE_DONATE_ARGNUMS),
+            (pre_jit, pre_abs, engine.PREFILL_DONATE_ARGNUMS)):
+        infos = jitted.trace(*abs_args).args_info[0]
+        for i, info in enumerate(infos):
+            leaves = jax.tree_util.tree_leaves(info)
+            donated = [bool(getattr(x, "donated", False)) for x in leaves]
+            if i in want:
+                assert all(donated), (i, donated)
+            else:
+                assert not any(donated), (i, donated)
+
+
+def test_pool_reset_after_donation_consuming_failure(engine_setup):
+    """A failure that consumed the donated pool (buffers deleted) must
+    re-initialize the device state in _fail_all, so the engine keeps
+    serving after failing the in-flight requests."""
+    from homebrewnlp_tpu.serve.engine import BatchEngine
+    cfg, params = engine_setup
+    eng = BatchEngine(cfg, params)
+    try:
+        assert not eng._pool_deleted()
+        next(iter(eng._caches.values()))[0].delete()
+        assert eng._pool_deleted()
+        eng._fail_all(RuntimeError("synthetic donation-consuming failure"))
+        assert not eng._pool_deleted()
+        # still serves after the reset
+        out = eng.complete_tokens([1, 2, 3, 4], temperature=0.0,
+                                  max_tokens=4)
+        assert len(out) > 0
+    finally:
+        eng.close()
+
+
 def test_use_batch_engine_gate():
     from homebrewnlp_tpu.serve.engine import BatchEngine, use_batch_engine
     assert not use_batch_engine(_engine_cfg(serve_max_batch=1))

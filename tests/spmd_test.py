@@ -1,0 +1,457 @@
+"""SPMD sharding propagation (analysis/spmd.py): seeding, per-primitive
+propagation, implicit-collective charging, the lowered/best strategy split,
+the ratcheted implicit-collective rule, committed-golden stability for all
+bundled configs, and the HLO cross-validation honesty check."""
+import dataclasses
+import glob
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(__file__))
+
+from homebrewnlp_tpu.analysis import spmd, trace as atrace  # noqa: E402
+from homebrewnlp_tpu.analysis.graph_rules import (_IntendedMesh,  # noqa: E402
+                                                  intended_mesh)
+from homebrewnlp_tpu.analysis.trace import (StepTrace,  # noqa: E402
+                                            trace_config)
+from homebrewnlp_tpu.config import Config  # noqa: E402
+
+from backend import tiny_config  # noqa: E402
+
+ALL_AXES = _IntendedMesh({"data": 2, "sequence_parallel": 1, "pipeline": 1,
+                          "model": 2})
+DP4 = _IntendedMesh({"data": 4, "sequence_parallel": 1, "pipeline": 1,
+                     "model": 1})
+
+
+def _trace_of(fn, in_axes, *args) -> StepTrace:
+    """Hand-built StepTrace over a tiny function with explicit seeds."""
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    return StepTrace("train", jaxpr, None, in_axes=list(in_axes))
+
+
+def _census(st, imesh, strategy="lowered"):
+    return spmd.census(spmd.propagate(st, imesh), imesh, strategy=strategy)
+
+
+# -- seeding -----------------------------------------------------------------
+
+def test_in_axes_seed_lists_align_with_invars():
+    """Every traced step of a KV-eligible config carries a seed entry per
+    flattened jaxpr input — the alignment the propagation depends on."""
+    from backend import mixer_config
+    cfg = mixer_config(tpu_size=2)
+    traces = trace_config(cfg, "seedcheck",
+                          steps=("train", "eval", "decode", "prefill"),
+                          quiet=True)
+    assert not traces.errors
+    assert set(traces.steps) == {"train", "eval", "decode", "prefill"}
+    for name, st in traces.steps.items():
+        inner = st.jaxpr.jaxpr
+        assert st.in_axes is not None, name
+        assert len(st.in_axes) == len(inner.invars), name
+
+
+def test_unseeded_trace_reports_not_audited():
+    st = _trace_of(lambda x: x * 2.0, [("batch", "heads")],
+                   jnp.zeros((4, 4)))
+    st.in_axes = None
+    r = spmd.propagate(st, ALL_AXES)
+    assert not r.seeded and not r.records
+
+
+def test_rank_drifted_seed_degrades_to_unknown():
+    """Axis metadata whose length disagrees with the value's rank (the
+    stacked-pipeline-vs-unstacked-decode shape) must seed UNKNOWN, never a
+    truncated — wrong — spec."""
+    st = _trace_of(lambda x, w: jnp.einsum("bh,ho->bo", x, w),
+                   [("pipe_stage", "batch", "heads"), ()],
+                   jnp.zeros((4, 4)), jnp.zeros((4, 8)))
+    assert _census(st, ALL_AXES) == {}
+
+
+# -- propagation + charging --------------------------------------------------
+
+def test_sharded_contraction_charges_psum():
+    """dot_general contracting a model-sharded dim leaves partial sums —
+    one implicit all-reduce of the output, per-device payload divided by
+    the output's own sharding."""
+    x = jnp.zeros((8, 4))   # [batch, heads]
+    w = jnp.zeros((4, 16))  # [heads, out]
+    st = _trace_of(lambda x, w: jnp.einsum("bh,ho->bo", x, w),
+                   [("batch", "heads"), ("heads", "_o")], x, w)
+    c = _census(st, ALL_AXES)
+    assert list(c) == ["psum"] and list(c["psum"]) == ["model"]
+    slot = c["psum"]["model"]
+    # output [8, 16] f32 = 512 B, batch dim sharded over data(2) -> 256 B
+    assert slot == {"count": 1, "payload_bytes": 256, "bytes": 256}
+    # the same trace under a pure-DP mask has no sharded contraction
+    assert _census(st, DP4) == {}
+
+
+def test_replicated_contraction_is_free():
+    st = _trace_of(lambda x, w: jnp.einsum("bf,fo->bo", x, w),
+                   [("batch", "_f"), ("_f", "_o")],
+                   jnp.zeros((8, 4)), jnp.zeros((4, 16)))
+    assert _census(st, ALL_AXES) == {}
+
+
+def test_sharded_reduction_charges_psum():
+    """A reduce_sum over the data-sharded batch dim (the loss mean) is an
+    implicit scalar all-reduce."""
+    st = _trace_of(lambda x: jnp.sum(x, axis=0), [("batch", "_f")],
+                   jnp.zeros((8, 4)))
+    c = _census(st, DP4)
+    assert c["psum"]["data"]["count"] == 1
+    assert c["psum"]["data"]["payload_bytes"] == 16  # [4] f32 output
+
+
+def test_scalar_and_broadcast_operands_never_conflict():
+    def fn(x):
+        return jnp.maximum(x * 2.0, 0.0) / jnp.float32(3.0)
+
+    st = _trace_of(fn, [("batch", "_f")], jnp.zeros((8, 4)))
+    r = spmd.propagate(st, DP4)
+    assert r.seeded and not r.conflicts and not r.records
+
+
+def test_conflicting_shardings_lint_and_charge_reshard():
+    """Two operands sharding the same dim over different axes: the lint
+    finding plus an implicit all_gather of the yielding side."""
+    st = _trace_of(lambda a, b: a * b,
+                   [("batch", "_f"), ("heads", "_f")],
+                   jnp.zeros((4, 8)), jnp.zeros((4, 8)))
+    r = spmd.propagate(st, ALL_AXES)
+    assert len(r.conflicts) == 1
+    assert r.conflicts[0].prim == "mul"
+    c = spmd.census(r, ALL_AXES)
+    assert c["all_gather"]["model"]["count"] == 1
+
+
+def test_scan_body_charges_multiply_by_trip_count():
+    w = jnp.zeros((4, 4))
+
+    def fn(w, xs):
+        def body(carry, x):
+            return carry, jnp.einsum("bh,ho->bo", x, w)
+
+        return jax.lax.scan(body, 0.0, xs)
+
+    # xs seed: leading scan dim (anonymous) + [batch, heads]
+    st = _trace_of(fn, [("heads", "_o"), ("_s", "batch", "heads")],
+                   w, jnp.zeros((5, 8, 4)))
+    c = _census(st, ALL_AXES)
+    assert c["psum"]["model"]["count"] == 5
+
+
+def test_cond_branch_with_sharded_contraction_charges():
+    """A lax.cond whose costlier branch contracts a sharded dim: the
+    branch's charges (first-option cost proxy) survive into the census
+    instead of crashing branch selection (seeded regression for the
+    ChargeOption refactor)."""
+    def fn(x, w):
+        return jax.lax.cond(
+            x.sum() > 0,
+            lambda: jnp.einsum("bh,ho->bo", x, w).sum(),
+            lambda: jnp.float32(0.0))
+
+    st = _trace_of(fn, [("batch", "heads"), ("heads", "_o")],
+                   jnp.zeros((8, 4)), jnp.zeros((4, 16)))
+    r = spmd.propagate(st, ALL_AXES)
+    assert r.error == "", r.error
+    c = spmd.census(r, ALL_AXES)
+    assert c["psum"]["model"]["count"] >= 1
+
+
+def test_single_device_mesh_short_circuits():
+    """An all-size-1 mesh can never shard anything: propagation returns an
+    empty, seeded result without walking the jaxpr (the 1-chip configs'
+    audit-cost guard)."""
+    st = _trace_of(lambda x, w: jnp.einsum("bh,ho->bo", x, w),
+                   [("batch", "heads"), ("heads", "_o")],
+                   jnp.zeros((8, 4)), jnp.zeros((4, 16)))
+    one = _IntendedMesh({"data": 1, "sequence_parallel": 1, "pipeline": 1,
+                         "model": 1})
+    r = spmd.propagate(st, one)
+    assert r.seeded and not r.records and not r.conflicts
+    assert not hasattr(st, "_spmd_cache")  # never walked, never cached
+
+
+def test_embedding_gather_carries_index_sharding():
+    """jnp.take from a replicated table with data-sharded indices: the
+    output rides the index sharding, so the downstream weight-grad
+    scatter-add charges the implicit table all-reduce."""
+    table = jnp.zeros((32, 8))
+    idx = jnp.zeros((16, 4), jnp.int32)
+
+    def fwd(table, idx):
+        return jnp.take(table, idx, axis=0).sum()
+
+    def grad_fn(table, idx):
+        return jax.grad(fwd)(table, idx)
+
+    st = _trace_of(grad_fn, [("_v", "_f"), ("batch", "_s")], table, idx)
+    c = _census(st, DP4)
+    # the table gradient (scatter-add of data-sharded updates) all-reduces
+    assert c["psum"]["data"]["count"] >= 1
+    biggest = max(s["payload_bytes"] for s in c["psum"].values())
+    assert biggest >= table.size * 4  # full table grad, unsharded
+
+
+def test_sharding_constraint_pins_named_dims_and_keeps_open_ones():
+    """The trace-time annotation (built on the LOCAL mesh) under-specifies:
+    dims it leaves open must keep the propagated sharding."""
+    from jax.sharding import PartitionSpec
+    from homebrewnlp_tpu.parallel import make_mesh
+    cfg = tiny_config()
+    mesh = make_mesh(cfg, devices=jax.devices()[:1], quiet=True)
+
+    def fn(x):
+        y = jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, PartitionSpec()))
+        return jnp.einsum("bh,bo->ho", y, y)
+
+    st = _trace_of(fn, [("batch", "heads")], jnp.zeros((8, 4)))
+    c = _census(st, ALL_AXES)
+    # batch sharding survives the empty constraint -> grad-style
+    # contraction over batch still charges a data-axis psum
+    assert c["psum"]["data"]["count"] == 1
+
+
+# -- strategy split + pricing ------------------------------------------------
+
+def test_census_strategy_lowered_vs_best():
+    """A giant partial-sum output next to a tiny sharded weight: lowered
+    pins the all-reduce today's partitioner emits; best takes the
+    all-gather-the-weight bound the pricing uses."""
+    x = jnp.zeros((64, 4))     # [batch, heads]
+    w = jnp.zeros((4, 4096))   # [heads, out] - output dwarfs the weight
+    st = _trace_of(lambda x, w: jnp.einsum("bh,ho->bo", x, w),
+                   [("batch", "heads"), ("heads", "_o")], x, w)
+    lowered = _census(st, ALL_AXES, "lowered")
+    best = _census(st, ALL_AXES, "best")
+    assert list(lowered) == ["psum"]
+    assert list(best) == ["all_gather"]
+    assert (best["all_gather"]["model"]["bytes"]
+            < lowered["psum"]["model"]["bytes"])
+    with pytest.raises(ValueError, match="strategy"):
+        _census(st, ALL_AXES, "typo")
+
+
+def test_implicit_comm_fuses_launches_like_the_combiner():
+    """Many tiny same-axis psums price as ONE launch (alpha term), while
+    the census keeps the true per-op count."""
+    def fn(x, w):
+        out = 0.0
+        for _ in range(6):
+            out = out + jnp.einsum("bh,ho->bo", x, w).sum()
+        return out
+
+    st = _trace_of(fn, [("batch", "heads"), ("heads", "_o")],
+                   jnp.zeros((8, 4)), jnp.zeros((4, 16)))
+    r = spmd.propagate(st, ALL_AXES)
+    c = spmd.census(r, ALL_AXES)
+    assert sum(s["count"] for s in c["psum"].values()) >= 6
+    comm = spmd.implicit_comm(r, ALL_AXES)
+    assert comm.count_per_axis["model"] == 1  # combiner-fused
+    assert comm.bytes_per_axis["model"] > 0
+
+
+def test_step_resources_price_implicit_bytes():
+    """cost_model wires the propagation into total_comm: a pure-DP tiny
+    config's train step prices a nonzero data-axis communication term
+    even though its jaxpr contains no manual collective."""
+    from homebrewnlp_tpu.analysis import cost_model
+    cfg = tiny_config(heads=1, features_per_head=64, tpu_size=2)
+    traces = trace_config(cfg, "dp2", steps=("train",), quiet=True)
+    imesh = intended_mesh(cfg)
+    res = cost_model.step_resources(traces, "train",
+                                    traces.steps["train"], imesh)
+    assert res.spmd_error == ""
+    # the only manual entries are the input sharding constraints; the
+    # gradient all-reduce the propagation predicts dwarfs them
+    manual = res.comm.bytes_per_axis.get("data", 0)
+    implicit = res.implicit_comm.bytes_per_axis["data"]
+    assert implicit > 10 * max(manual, 1)
+    total = res.total_comm()
+    assert total.bytes_per_axis["data"] == manual + implicit
+    times = cost_model.step_static_times(res, dict(imesh.shape), "v4")
+    assert times["ici_per_axis"]["data"] > 0
+
+
+# -- the implicit-collective rule --------------------------------------------
+
+@pytest.fixture(scope="module")
+def tp2_traces():
+    cfg = tiny_config(tpu_size=2)
+    return cfg, trace_config(cfg, "tp2", steps=("train", "decode"),
+                             quiet=True)
+
+
+def test_rule_golden_roundtrip_and_drift(tp2_traces, monkeypatch, tmp_path):
+    cfg, traces = tp2_traces
+    monkeypatch.setattr(spmd, "GOLDENS_DIR", str(tmp_path))
+    # missing golden is an error naming the update command
+    missing = spmd.check_implicit_collectives(traces)
+    assert any(f.severity == "error" and "no spmd golden" in f.message
+               for f in missing)
+    # record, then a clean re-check
+    rec = spmd.check_implicit_collectives(traces, update_goldens=True)
+    assert [f.severity for f in rec] == ["info"]
+    assert spmd.check_implicit_collectives(traces) == []
+    # seeded regression: mis-shard ONE weight (its head axis renamed to
+    # batch -> the data axis) via dataclasses.replace — the propagated
+    # census drifts and the ratchet must name it
+    st = traces.steps["train"]
+    idx = next(i for i, names in enumerate(st.in_axes)
+               if names and "heads" in names)
+    bad_axes = list(st.in_axes)
+    bad_axes[idx] = tuple("batch" if n == "heads" else n
+                          for n in bad_axes[idx])
+    bad_st = dataclasses.replace(st, in_axes=bad_axes)
+    bad = dataclasses.replace(traces,
+                              steps=dict(traces.steps, train=bad_st))
+    findings = spmd.check_implicit_collectives(bad)
+    errs = [f for f in findings if f.severity == "error"]
+    assert errs and any("implicit" in f.message for f in errs)
+
+
+def test_rule_conflict_growth_is_an_error(monkeypatch, tmp_path):
+    """A clean golden, then a trace whose operands carry conflicting
+    shardings: the lint warning fires AND the conflict-count ratchet
+    errors."""
+    from homebrewnlp_tpu.analysis.trace import ConfigTraces
+    monkeypatch.setattr(spmd, "GOLDENS_DIR", str(tmp_path))
+    # 4 devices over heads=2 -> intended mesh data2 x model2: BOTH axes
+    # live, so the batch-vs-heads mis-seed below genuinely collides
+    cfg = tiny_config(heads=2, features_per_head=64, tpu_size=4)
+    a, b = jnp.zeros((4, 8)), jnp.zeros((4, 8))
+    clean = _trace_of(lambda a, b: a * b,
+                      [("batch", "_f"), ("batch", "_f")], a, b)
+    wrap = lambda st: ConfigTraces("conflicty", cfg, None, {"train": st},
+                                   {}, {}, {})  # noqa: E731
+    spmd.check_implicit_collectives(wrap(clean), update_goldens=True)
+    assert spmd.check_implicit_collectives(wrap(clean)) == []
+    bad = _trace_of(lambda a, b: a * b,
+                    [("batch", "_f"), ("heads", "_f")], a, b)
+    findings = spmd.check_implicit_collectives(wrap(bad))
+    assert any(f.severity == "warning" and "conflicting" in f.message
+               for f in findings)
+    assert any(f.severity == "error" and "conflicts grew" in f.message
+               for f in findings)
+
+
+def test_committed_spmd_goldens_cover_all_configs():
+    names = {os.path.splitext(os.path.basename(p))[0]
+             for p in glob.glob(os.path.join(REPO, "configs", "*.json"))}
+    have = {os.path.splitext(f)[0]
+            for f in os.listdir(os.path.join(
+                os.path.dirname(spmd.__file__), "goldens", "spmd"))
+            if f.endswith(".json")}
+    assert names == have
+
+
+@pytest.mark.parametrize("path", sorted(glob.glob(
+    os.path.join(REPO, "configs", "*.json"))))
+def test_committed_spmd_golden_byte_stable(path):
+    """Re-deriving each bundled config's implicit census must reproduce
+    the committed golden exactly — the propagation is deterministic and
+    the goldens are in sync with the tree."""
+    name = os.path.splitext(os.path.basename(path))[0]
+    raw = json.load(open(path))
+    raw.pop("_comment", None)
+    cfg = Config(raw)
+    traces = trace_config(cfg, name, steps=("train", "decode"), quiet=True)
+    golden = json.load(open(spmd.spmd_golden_path(name)))
+    imesh = intended_mesh(cfg)
+    for step, st in sorted(traces.steps.items()):
+        r = spmd.propagate(st, imesh)
+        assert spmd._step_golden(r, imesh) == golden["steps"][step], \
+            (name, step)
+
+
+# -- HLO cross-validation ----------------------------------------------------
+
+_HLO_FIXTURE = """
+  %all-reduce.1 = f32[2,16]{1,0} all-reduce(f32[2,16]{1,0} %x), replica_groups={{0,1}}
+  %all-reduce.2 = (f32[4]{0}, f32[8,2]{1,0}) all-reduce(f32[4]{0} %a, f32[8,2]{1,0} %b)
+  %all-gather-start.3 = bf16[32]{0} all-gather-start(bf16[16]{0} %c)
+  %fusion.9 = f32[2,16]{1,0} fusion(f32[2,16]{1,0} %y), kind=kLoop
+"""
+
+
+def test_hlo_collective_parser_counts_and_bytes():
+    got = spmd.hlo_collectives(_HLO_FIXTURE)
+    assert got["all-reduce"]["count"] == 2
+    # 2*16*4 + (4*4 + 8*2*4) = 128 + 80
+    assert got["all-reduce"]["bytes"] == 208
+    assert got["all-gather"] == {"count": 1, "bytes": 64}
+    assert "fusion" not in got
+
+
+def test_compare_hlo_tolerance_edges():
+    pred = {"psum": {"model": {"count": 10, "payload_bytes": 1 << 20,
+                               "bytes": 1 << 20}}}
+    ok = spmd.compare_hlo(pred, {"all-reduce": {"count": 12,
+                                                "bytes": 1 << 20}})
+    assert ok["ok"], ok["reasons"]
+    # presence mismatch: predicted collectives, none lowered
+    miss = spmd.compare_hlo(pred, {})
+    assert not miss["ok"]
+    # empty-empty agrees (1-chip configs)
+    assert spmd.compare_hlo({}, {})["ok"]
+    # payload out past the ratio + slack
+    far = spmd.compare_hlo(pred, {"all-reduce": {
+        "count": 10, "bytes": (1 << 20) * 3 + spmd.HLO_BYTES_SLACK * 3}})
+    assert any("payload" in r for r in far["reasons"])
+
+
+def test_validate_hlo_matches_partitioner_tp2(tp2_traces):
+    """The honesty check, live: compile the TP-2 tiny train step on CPU
+    devices and require census/HLO agreement within tolerance."""
+    cfg, traces = tp2_traces
+    v = spmd.validate_hlo(traces)
+    assert "skipped" not in v, v
+    assert v["ok"], v["reasons"]
+    assert v["hlo"]["count"] > 0 and v["predicted"]["count"] > 0
+
+
+def test_validate_hlo_skips_shard_map_structures():
+    raw = json.load(open(os.path.join(REPO, "configs",
+                                      "8dev_composed_dryrun.json")))
+    raw.pop("_comment", None)
+    cfg = Config(raw)
+    ok, reason = spmd.hlo_compilable(cfg)
+    assert not ok and "shard_map" in reason
+
+
+def test_graftspmd_cli_check_and_json():
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftspmd.py"),
+         "--config", os.path.join(REPO, "configs", "bpe65k_1chip.json"),
+         "--check", "--json"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = json.loads(proc.stdout)
+    assert rows[0]["config"] == "bpe65k_1chip"
+    assert rows[0]["steps"]["train"]["seeded"]
+    assert rows[0]["findings"] == []
+
+
+def test_graftspmd_cli_rejects_unknown_step():
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftspmd.py"),
+         "--config", os.path.join(REPO, "configs", "bpe65k_1chip.json"),
+         "--steps", "trian"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "unknown step" in proc.stderr

@@ -129,6 +129,12 @@ def sheet(traces, devices, as_json: bool):
                     f"{ax}: {fmt_bytes(b).strip()}"
                     for ax, b in sorted(r.comm.bytes_per_axis.items()))
                 print(f"           collectives/axis: {axes}")
+            if r.implicit_comm.bytes_per_axis:
+                axes = ", ".join(
+                    f"{ax}: {fmt_bytes(b).strip()}"
+                    for ax, b in sorted(r.implicit_comm.bytes_per_axis.items()))
+                print(f"           implicit (GSPMD)/axis: {axes}"
+                      f"   [tools/graftspmd.py for the full census]")
         for kind, fits in out["fits"].items():
             verdict = " ".join(f"{s}:{'fits' if ok else 'OOM'}"
                                for s, ok in fits.items())

@@ -3,9 +3,10 @@
 
 Enumerates the DP/SP/PP/TP factorizations of a config's slice topology
 (``parallel/mesh.py::mesh_factorizations``), scores every candidate with the
-static cost model's ``static_step_times`` (plus the implicit data-parallel
-gradient all-reduce the traced jaxpr cannot show) against the config's
-``target_device``, gates each candidate on that device's HBM capacity
+static cost model's ``static_step_times`` (manual collectives plus the
+GSPMD-implicit ones the sharding propagation predicts, analysis/spmd.py)
+against the config's ``target_device``, gates each candidate on that
+device's HBM capacity
 (OOM-before-compile), and prints the ranked sheet with the committed
 hand-written mesh marked.  By default the sequence/pipeline axes stay pinned
 to the config's declared structure (one abstract trace prices every
@@ -83,12 +84,14 @@ def _sheet_text(result) -> str:
     for c in result.candidates:
         mark = "  <- hand-written" if c.is_hand else ""
         fit = "" if c.fits else "  [OOM]"
+        unpriced = ("  [IMPLICIT UNPRICED: " + c.spmd_error + "]"
+                    if c.spmd_error else "")
         lines.append(
             f"  #{c.rank:<2d} {c.describe():28s} "
             f"step {c.step_s * 1e3:9.4f} ms  (ici "
             f"{c.predicted.get('ici_s', 0.0) * 1e3:8.4f} ms, peak "
             f"{format_bytes(c.hbm_peak, width=7)}/dev)"
-            f"{fit}{mark}")
+            f"{fit}{mark}{unpriced}")
     for c in result.skipped:
         lines.append(f"  --  {c.axes}: skipped ({c.error})")
     lines.append(f"  hand-written mesh rank: #{result.hand_rank} of "

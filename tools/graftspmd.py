@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""graftspmd: the implicit-collective sheet — what GSPMD will insert, statically.
+
+Seeds every traced step input with its intended-mesh PartitionSpec and
+propagates shardings through the jaxpr (analysis/spmd.py): contractions and
+reductions over sharded dimensions surface as the implicit all-reduces /
+all-gathers the partitioner will add at compile time, per mesh axis, with
+payload bytes and an alpha-beta time estimate — the collectives the manual
+census (graftcheck) cannot see.  Conflicting operand shardings (the
+accidental-full-replication lint) are listed per equation.
+
+``--validate-hlo`` is the honesty check: on CPU-compilable configs the real
+train step is lowered + compiled under its real shardings and the predicted
+census is compared against the collectives actually present in the
+partitioned HLO text, within the documented tolerance
+(analysis/spmd.py::HLO_TOLERANCE).
+
+Usage:
+  python tools/graftspmd.py --config configs/32big_mixer.json      # sheet
+  python tools/graftspmd.py --all-configs --check                  # CI gate
+  python tools/graftspmd.py --all-configs --update-goldens
+  python tools/graftspmd.py --config configs/bpe65k_1chip.json \
+      --world 2 --validate-hlo                                     # honesty
+  python tools/graftspmd.py --config configs/x.json --json
+
+Exit code: 0 ok; 1 when --check finds errors or a non-skipped
+--validate-hlo comparison is out of tolerance; 2 on usage errors.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# same virtual mesh as graftcheck/graftcost so predictions are reproducible
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--config", action="append", default=[],
+                   help="config JSON to audit (repeatable)")
+    p.add_argument("--all-configs", action="store_true")
+    p.add_argument("--steps", default="train,decode",
+                   help="comma list of steps (train,eval,decode,prefill)")
+    p.add_argument("--world", type=int, default=0,
+                   help="override tpu_size (e.g. --world 2 to validate a "
+                        "1-chip config's sharded lowering on CPU devices)")
+    p.add_argument("--check", action="store_true",
+                   help="run the ratcheted implicit-collective rule "
+                        "against the committed spmd goldens; exit 1 on "
+                        "errors")
+    p.add_argument("--update-goldens", action="store_true",
+                   help="re-record analysis/goldens/spmd/<config>.json")
+    p.add_argument("--validate-hlo", action="store_true",
+                   help="lower+compile the train step and compare the "
+                        "predicted census against the HLO collectives "
+                        "(CPU-compilable configs; others report skipped)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    return p.parse_args(argv)
+
+
+def _fmt(b) -> str:
+    from homebrewnlp_tpu.analysis.cost_model import format_bytes
+    return format_bytes(b).strip()
+
+
+def sheet(traces, as_json: bool) -> dict:
+    from homebrewnlp_tpu.analysis import spmd
+    from homebrewnlp_tpu.analysis.cost_model import DEFAULT_VERDICT_DEVICE
+    from homebrewnlp_tpu.analysis.graph_rules import intended_mesh
+    from homebrewnlp_tpu.devices import resolve_device
+    imesh = intended_mesh(traces.cfg)
+    kind = (str(getattr(traces.cfg, "target_device", "") or "")
+            or DEFAULT_VERDICT_DEVICE)
+    spec = resolve_device(kind)
+    out = {"config": traces.config_name,
+           "intended_mesh": {k: int(v) for k, v in imesh.shape.items()},
+           "device": kind, "steps": {}, "errors": dict(traces.errors)}
+    for step, st in sorted(traces.steps.items()):
+        r = spmd.propagate(st, imesh)
+        row = {"seeded": bool(r.seeded), "error": r.error,
+               "implicit": spmd.census(r, imesh),
+               "conflicts": [{"location": c.location, "prim": c.prim,
+                              "detail": c.detail} for c in r.conflicts]}
+        if r.seeded and not r.error and spec is not None:
+            comm = spmd.implicit_comm(r, imesh)
+            row["ici_time_s_per_axis"] = {
+                k: round(v, 6)
+                for k, v in comm.times(dict(imesh.shape), spec).items()}
+        out["steps"][step] = row
+    if not as_json:
+        mesh_s = " ".join(f"{k}{v}" for k, v in sorted(imesh.shape.items())
+                          if v > 1) or "1chip"
+        print(f"\n== {traces.config_name}  (intended mesh: {mesh_s}, "
+              f"priced on {kind})")
+        for step, row in out["steps"].items():
+            if not row["seeded"] or row["error"]:
+                print(f"  {step:8s} unaudited "
+                      f"({row['error'] or 'no sharding seeds'})")
+                continue
+            if not row["implicit"]:
+                print(f"  {step:8s} no implicit collectives "
+                      f"(every contraction stays local)")
+            for fam, axes in sorted(row["implicit"].items()):
+                for ax, slot in sorted(axes.items()):
+                    t = row.get("ici_time_s_per_axis", {}).get(ax)
+                    # census rows are the as-LOWERED form (what the HLO
+                    # validation pins); the axis time is priced at the
+                    # tuned-lowering bound (best strategy + combiner) —
+                    # the spread between them is the optimization headroom
+                    print(f"  {step:8s} {fam:10s} x{slot['count']:<5d} over "
+                          f"{ax:18s} payload {_fmt(slot['payload_bytes']):>11s}"
+                          f"  moved {_fmt(slot['bytes']):>11s}"
+                          + (f"  (axis priced ~{t * 1e3:.3f} ms at the "
+                             f"tuned-lowering bound)"
+                             if t is not None else ""))
+            for c in row["conflicts"]:
+                print(f"  {step:8s} CONFLICT {c['prim']} at {c['location']}: "
+                      f"{c['detail']}")
+        for step, err in traces.errors.items():
+            print(f"  {step:8s} trace failed: {err}")
+    return out
+
+
+def validate(traces, as_json: bool) -> dict:
+    from homebrewnlp_tpu.analysis import spmd
+    v = spmd.validate_hlo(traces)
+    if not as_json:
+        if "skipped" in v:
+            print(f"[graftspmd] {traces.config_name}: HLO validation "
+                  f"skipped ({v['skipped']})", file=sys.stderr)
+        else:
+            p, h = v["predicted"], v["hlo"]
+            verdict = "OK" if v["ok"] else "OUT OF TOLERANCE"
+            ops = ", ".join("{} x{}".format(k, s["count"])
+                            for k, s in sorted(h["ops"].items())) or "none"
+            print(f"\n-- {traces.config_name} HLO cross-validation: {verdict}"
+                  f"\n   predicted {p['count']} implicit collective(s), "
+                  f"{_fmt(p['payload_bytes'])} payload"
+                  f"\n   lowered   {h['count']} collective op(s), "
+                  f"{_fmt(h['bytes'])} in partitioned HLO ({ops})")
+            for r in v.get("reasons", []):
+                print(f"   !! {r}")
+    return v
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    config_paths = list(args.config)
+    if args.all_configs:
+        config_paths += sorted(glob.glob(os.path.join(REPO, "configs",
+                                                      "*.json")))
+    if not config_paths:
+        print("nothing to do: pass --config or --all-configs",
+              file=sys.stderr)
+        return 2
+    steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
+    unknown = sorted(set(steps) - {"train", "eval", "decode", "prefill"})
+    if unknown:
+        print(f"unknown step(s) {', '.join(unknown)}; valid: "
+              f"train, eval, decode, prefill", file=sys.stderr)
+        return 2
+    if args.validate_hlo and "train" not in steps:
+        print("--validate-hlo compiles the train step; include train in "
+              "--steps", file=sys.stderr)
+        return 2
+    if args.world and (args.check or args.update_goldens):
+        print("--check/--update-goldens pin the committed topology and "
+              "cannot combine with --world", file=sys.stderr)
+        return 2
+
+    import contextlib
+
+    from homebrewnlp_tpu.analysis import trace_config
+    from homebrewnlp_tpu.analysis.spmd import check_implicit_collectives
+    from homebrewnlp_tpu.config import Config
+    results = []
+    rc = 0
+    t0 = time.time()
+    quiet = (contextlib.redirect_stdout(sys.stderr) if args.as_json
+             else contextlib.nullcontext())
+    for path in config_paths:
+        name = os.path.splitext(os.path.basename(path))[0]
+        with open(path) as f:
+            raw = json.load(f)
+        raw.pop("_comment", None)
+        if args.world:
+            raw["tpu_size"] = int(args.world)
+            name = f"{name}@world{args.world}"
+        with quiet:
+            try:
+                cfg = Config(raw)
+            except Exception as e:
+                results.append({"config": name,
+                                "error": f"{type(e).__name__}: {e}"})
+                rc = max(rc, 1)
+                continue
+            traces = trace_config(cfg, name, steps=steps)
+            row = sheet(traces, args.as_json)
+            if args.check or args.update_goldens:
+                findings = check_implicit_collectives(
+                    traces, update_goldens=args.update_goldens)
+                row["findings"] = [
+                    {"severity": f.severity, "message": f.message}
+                    for f in findings]
+                n_err = sum(1 for f in findings if f.severity == "error")
+                if n_err:
+                    rc = max(rc, 1)
+                if not args.as_json:
+                    for f in findings:
+                        print(f"  {f.severity.upper():7s} {f.message}")
+            if args.validate_hlo:
+                row["hlo_validation"] = validate(traces, args.as_json)
+                if ("skipped" not in row["hlo_validation"]
+                        and not row["hlo_validation"]["ok"]):
+                    rc = max(rc, 1)
+            results.append(row)
+    if args.as_json:
+        print(json.dumps(results, indent=2))
+    else:
+        print(f"\n[graftspmd] total {time.time() - t0:.1f}s -> exit {rc}",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
