@@ -42,7 +42,8 @@ GRAPH_RULES = ("collective-census", "dtype-promotion", "quant-dtype",
                "resource-budget", "implicit-collective", "mesh-rank")
 # "dtype-promotion" appears in both: the AST pass carries its static twin
 AST_RULES = ("axis-literal", "x-escape", "traced-rng", "partitionspec-axis",
-             "dtype-promotion", "host-sync", "obs-in-trace", "bare-io")
+             "dtype-promotion", "host-sync", "obs-in-trace", "bare-io",
+             "sync-shared-state", "sync-lock-order")
 # tree-wide gates (run once per --all-configs audit, not per config)
 TREE_RULES = ("golden-coverage",)
 ALL_RULES = tuple(dict.fromkeys(GRAPH_RULES + AST_RULES + TREE_RULES))

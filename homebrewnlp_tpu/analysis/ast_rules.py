@@ -678,6 +678,12 @@ def check_partitionspec_literals(root: str) -> typing.List[Finding]:
     return findings
 
 
+def _sync_rule(fn_name: str, root: str, update_goldens: bool
+               ) -> typing.List[Finding]:
+    from . import concurrency
+    return getattr(concurrency, fn_name)(root, update_goldens)
+
+
 def run_ast_rules(root: str, update_goldens: bool = False,
                   rules: typing.Optional[typing.Sequence[str]] = None
                   ) -> typing.List[Finding]:
@@ -692,6 +698,12 @@ def run_ast_rules(root: str, update_goldens: bool = False,
         "host-sync": lambda: check_host_sync(root, update_goldens),
         "obs-in-trace": lambda: check_obs_in_trace(root, update_goldens),
         "bare-io": lambda: check_bare_io(root, update_goldens),
+        # concurrency audit (analysis/concurrency.py): shared-state ratchet
+        # + lock-order golden over the declared-lock model
+        "sync-shared-state": lambda: _sync_rule(
+            "check_shared_state", root, update_goldens),
+        "sync-lock-order": lambda: _sync_rule(
+            "check_lock_order", root, update_goldens),
     }
     findings: typing.List[Finding] = []
     for name, fn in table.items():

@@ -1,0 +1,969 @@
+"""Static concurrency model of the threaded host layer (sync-* rules).
+
+The serving/observability/supervision layers are plain-Python threads around
+the jax core; graftcheck's graph rules cannot see them.  This module builds
+two static models from the AST of the threaded scopes (``SYNC_SCOPES``) and
+pins both against goldens, in the graftspmd house style (a static prediction
+validated against runtime reality — see ``tools/graftsync.py --validate``):
+
+**Shared-state model (rule ``sync-shared-state``).**  Every instance
+attribute access is attributed to the *thread identities* that can execute
+it:
+
+- ``init`` — reached (only) from ``__init__``/``__del__``/class body; never
+  concurrent by construction and ignored.
+- ``caller`` — the single bucket for externally-called public methods (the
+  analysis does not distinguish which caller thread).
+- ``thread:<Class>.<m>`` — a dedicated thread entry point: ``Thread(target=
+  self.m)`` / ``threading.Timer(..., self.m)`` targets, nested functions
+  passed as thread targets, and ``run()`` on Thread subclasses.
+- ``callback:<Class>.<m>`` — a bound method or lambda that escapes as a call
+  argument (gauge functions, probes, sinks): it may run on whichever thread
+  owns the registry it was handed to.
+
+Identities propagate through *resolvable* calls only: ``self.m()``, calls on
+attributes with inferred types (``self.x = ClassName(...)`` or annotated
+``__init__`` params), and same-module function calls.  An attribute is
+*concurrent* when its accesses span >= 2 identities of which at least one is
+a ``thread:``/``callback:`` identity; if any such attribute has a non-init
+write, every access must sit inside a ``with <declared lock>:`` scope of a
+lock declared on the owning class (or its module) — except reads whose
+identities are a subset of the sole writing identity (a single writer may
+read its own latest value).  Violation counts are ratcheted in
+``goldens/sync/shared_state.json`` (committed EMPTY: every true finding was
+fixed rather than allowlisted, so any new unguarded shared write is an
+error).  Suppress a deliberate site with ``# graftcheck:
+disable=sync-shared-state -- reason``.
+
+Documented blind spots (by design — the rule is a ratchet, not a verifier):
+module-global mutable state, writes through method calls (``list.append``)
+or subscripts (``d[k] = v`` counts as a *read* of ``d``), and writes from
+outside the owning class.
+
+**Lock-order graph (rule ``sync-lock-order``).**  Locks are *declared*
+through ``homebrewnlp_tpu.sync.make_lock/make_rlock/make_condition`` with
+their graph name (``<module>.<Class>.<attr>``; the analyzer derives the same
+id from the AST and warns on mismatch, and warns on raw ``threading.*``
+declarations which the runtime recorder cannot see).  Edges ``A -> B`` mean
+"B was acquired while A was held": lexically nested ``with`` scopes, plus
+calls into lock-acquiring code while holding (closed transitively over the
+resolvable call graph; unresolvable ``x.m()`` calls fall back to *every*
+scanned method named ``m`` so the static graph over- rather than
+under-approximates what the runtime recorder can observe).  Any cycle is a
+potential deadlock and always an error.  The edge set is pinned in
+``goldens/sync/lock_order.json``: a new edge is an error until reviewed and
+re-recorded (``--update-goldens``), a vanished edge is an info.
+
+**Runtime validation** (``validate_recorded``): every ``held -> acquired``
+edge logged by the ``HBNLP_SYNC_RECORD=1`` recording shim while the real
+suites run must already be in the static graph — a recorded edge the model
+missed fails, exactly like graftspmd's HLO collective pin.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import typing
+
+from .ast_rules import _check_ratchet, _iter_py_files, _suppressed
+from .findings import Finding
+
+#: threaded scopes the sync rules audit (package-relative).  tools/
+#: graftload.py is deliberately out: it is a load-generation *client* with
+#: one function-local lock and no shared engine state.
+SYNC_SCOPES = ("homebrewnlp_tpu/serve", "homebrewnlp_tpu/obs",
+               "homebrewnlp_tpu/data", "homebrewnlp_tpu/infer",
+               "homebrewnlp_tpu/reliability", "homebrewnlp_tpu/native",
+               "tools/supervise.py")
+
+#: analysis itself and the recorder are infrastructure, not subjects
+SYNC_EXEMPT = ("homebrewnlp_tpu/sync.py",)
+
+_FACTORY_KINDS = {"make_lock": "lock", "make_rlock": "rlock",
+                  "make_condition": "condition"}
+_RAW_KINDS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+_THREAD_CTORS = frozenset({"Thread"})
+_TIMER_CTORS = frozenset({"Timer"})
+_INIT_METHODS = frozenset({"__init__", "__del__", "__post_init__",
+                           "__set_name__"})
+#: dunders that are ordinary external entry points
+_PUBLIC_DUNDERS = frozenset({"__call__", "__iter__", "__next__",
+                             "__enter__", "__exit__", "__contains__",
+                             "__len__", "__repr__", "__str__"})
+
+
+class _Lock:
+    __slots__ = ("lid", "kind", "factory", "literal", "rel", "lineno")
+
+    def __init__(self, lid, kind, factory, literal, rel, lineno):
+        self.lid, self.kind, self.factory = lid, kind, factory
+        self.literal, self.rel, self.lineno = literal, rel, lineno
+
+
+class _Access:
+    __slots__ = ("owner", "attr", "write", "guards", "rel", "lineno", "ctx")
+
+    def __init__(self, owner, attr, write, guards, rel, lineno, ctx):
+        self.owner, self.attr, self.write = owner, attr, write
+        self.guards, self.rel, self.lineno, self.ctx = guards, rel, lineno, ctx
+
+
+class _Ctx:
+    """One execution context: a method, module function, or a synthetic
+    context for an escaping lambda / thread-target nested function."""
+    __slots__ = ("key", "seeds", "ids", "calls", "acquires", "call_events",
+                 "direct_locks")
+
+    def __init__(self, key):
+        self.key = key
+        self.seeds: typing.Set[str] = set()
+        self.ids: typing.Set[str] = set()
+        self.calls: typing.List[tuple] = []          # resolvable + name specs
+        self.acquires: typing.List[tuple] = []       # (held tuple, lid, rel, line)
+        self.call_events: typing.List[tuple] = []    # (held tuple, spec, rel, line)
+        self.direct_locks: typing.Set[str] = set()
+
+
+class SyncModel:
+    def __init__(self):
+        self.locks: typing.Dict[str, _Lock] = {}
+        self.class_locks: typing.Dict[tuple, typing.Dict[str, str]] = {}
+        self.module_locks: typing.Dict[str, typing.Dict[str, str]] = {}
+        self.class_index: typing.Dict[str, tuple] = {}   # name -> (mod, rel)
+        self.class_methods: typing.Dict[tuple, typing.Set[str]] = {}
+        self.attr_types: typing.Dict[tuple, typing.Dict[str, str]] = {}
+        self.ctxs: typing.Dict[tuple, _Ctx] = {}
+        self.accesses: typing.List[_Access] = []
+        self.escapes: typing.Set[str] = set()        # method names passed as args
+        self.thread_roots: typing.Set[tuple] = set() # (mod, cls, meth)
+        self.warnings: typing.List[Finding] = []
+        self.edges: typing.Dict[tuple, typing.Set[str]] = {}  # (a,b) -> locs
+        self.lines: typing.Dict[str, typing.List[str]] = {}   # rel -> lines
+        self.module_funcs: typing.Dict[str, typing.Set[str]] = {}
+
+    def ctx(self, key: tuple) -> _Ctx:
+        if key not in self.ctxs:
+            self.ctxs[key] = _Ctx(key)
+        return self.ctxs[key]
+
+
+def _modstem(rel: str) -> str:
+    norm = rel.replace(os.sep, "/")
+    if norm.startswith("homebrewnlp_tpu/"):
+        norm = norm[len("homebrewnlp_tpu/"):]
+    if norm.endswith("/__init__.py"):
+        norm = norm[:-len("/__init__.py")]
+    elif norm.endswith(".py"):
+        norm = norm[:-3]
+    return norm.replace("/", ".")
+
+
+def _terminal(func: ast.expr) -> typing.Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _ann_name(node: typing.Optional[ast.expr]) -> typing.Optional[str]:
+    """Class name out of an annotation: Name, string constant, or the inner
+    of a single-arg subscript (Optional[...])."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.split(".")[-1].strip()
+    if isinstance(node, ast.Subscript):
+        return _ann_name(node.slice)
+    return None
+
+
+def _self_attr(node: ast.expr) -> typing.Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _thread_target(call: ast.Call) -> typing.Optional[ast.expr]:
+    """The callable a Thread/Timer construction will run on a new thread."""
+    term = _terminal(call.func)
+    if term in _THREAD_CTORS:
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        if len(call.args) >= 2:
+            return call.args[1]
+    elif term in _TIMER_CTORS:
+        for kw in call.keywords:
+            if kw.arg == "function":
+                return kw.value
+        if len(call.args) >= 2:
+            return call.args[1]
+    return None
+
+
+class _FileScanner:
+    """Phase-B visitor for one module: builds contexts, accesses, lock
+    acquisition / call events.  ``model.class_index`` is complete (phase A
+    ran over every file) so constructor-based attr typing resolves
+    cross-module."""
+
+    def __init__(self, model: SyncModel, rel: str, tree: ast.Module):
+        self.m = model
+        self.rel = rel
+        self.mod = _modstem(rel)
+        self.tree = tree
+
+    # -- lock resolution -------------------------------------------------
+    def _lock_of(self, expr: ast.expr, cls: typing.Optional[str]
+                 ) -> typing.Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.m.module_locks.get(self.mod, {}).get(expr.id)
+        attr = _self_attr(expr)
+        if attr is not None and cls is not None:
+            return self.m.class_locks.get((self.mod, cls), {}).get(attr)
+        # self.X._lock with type(X) known
+        if isinstance(expr, ast.Attribute):
+            base = _self_attr(expr.value)
+            if base is not None and cls is not None:
+                t = self.m.attr_types.get((self.mod, cls), {}).get(base)
+                if t and t in self.m.class_index:
+                    tmod, _ = self.m.class_index[t]
+                    return self.m.class_locks.get((tmod, t), {}).get(expr.attr)
+        return None
+
+    # -- main entry ------------------------------------------------------
+    def scan(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_func(node, cls=None)
+
+    def _scan_class(self, cnode: ast.ClassDef) -> None:
+        cls = cnode.name
+        is_thread_subclass = any(_terminal(b) == "Thread"
+                                 for b in cnode.bases)
+        for node in cnode.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if is_thread_subclass and node.name == "run":
+                    self.m.thread_roots.add((self.mod, cls, "run"))
+                self._scan_func(node, cls=cls)
+
+    def _scan_func(self, fnode, cls: typing.Optional[str]) -> None:
+        key = (self.mod, cls, fnode.name)
+        ctx = self.m.ctx(key)
+        if cls is not None and fnode.name in _INIT_METHODS:
+            ctx.seeds.add("init")
+        elif cls is None or not fnode.name.startswith("_") \
+                or fnode.name in _PUBLIC_DUNDERS:
+            # thread roots discovered later drop this seed in _finalize
+            ctx.seeds.add("caller")
+        self._prescan_nested(fnode)
+        self._visit_block(fnode.body, ctx, cls, guards=(), meth=fnode.name)
+
+    def _prescan_nested(self, fnode) -> None:
+        """Classify nested defs BEFORE visiting bodies (the Thread(...)
+        call usually comes lexically after the def it targets)."""
+        defs: typing.Dict[str, typing.Any] = {}
+        for sub in ast.walk(fnode):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not fnode:
+                defs[sub.name] = sub
+        if not defs:
+            return
+        for call in ast.walk(fnode):
+            if not isinstance(call, ast.Call):
+                continue
+            target = _thread_target(call)
+            if isinstance(target, ast.Name) and target.id in defs:
+                self._nested_usage[id(defs[target.id])] = "thread"
+                continue
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    self._nested_usage.setdefault(id(defs[arg.id]),
+                                                  "callback")
+
+    # -- statement walk --------------------------------------------------
+    def _visit_block(self, stmts, ctx: _Ctx, cls, guards: tuple,
+                     meth: str) -> None:
+        for st in stmts:
+            self._visit_stmt(st, ctx, cls, guards, meth)
+
+    def _visit_stmt(self, st, ctx: _Ctx, cls, guards: tuple,
+                    meth: str) -> None:
+        if isinstance(st, ast.With) or isinstance(st, ast.AsyncWith):
+            inner = guards
+            for item in st.items:
+                lid = self._lock_of(item.context_expr, cls)
+                self._visit_expr(item.context_expr, ctx, cls, inner, meth)
+                if lid is not None:
+                    ctx.acquires.append((inner, lid, self.rel,
+                                         item.context_expr.lineno))
+                    ctx.direct_locks.add(lid)
+                    inner = inner + (lid,)
+            self._visit_block(st.body, ctx, cls, inner, meth)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._scan_nested(st, ctx, cls, meth)
+            return
+        if isinstance(st, ast.ClassDef):
+            return  # nested classes: out of scope
+        # generic expression fields first (targets/values), then sub-blocks
+        for field in ("targets", "target", "value", "test", "iter", "exc",
+                      "cause", "msg"):
+            v = getattr(st, field, None)
+            if isinstance(v, list):
+                for e in v:
+                    self._visit_expr(e, ctx, cls, guards, meth)
+            elif isinstance(v, ast.expr):
+                self._visit_expr(v, ctx, cls, guards, meth)
+        if isinstance(st, ast.AnnAssign) and st.annotation is not None:
+            pass  # annotations carry no runtime accesses
+        for block in ("body", "orelse", "finalbody"):
+            b = getattr(st, block, None)
+            if isinstance(b, list):
+                self._visit_block(b, ctx, cls, guards, meth)
+        for h in getattr(st, "handlers", ()) or ():
+            self._visit_block(h.body, ctx, cls, guards, meth)
+        if isinstance(st, ast.Return) and st.value is not None:
+            pass  # already covered by "value"
+        if isinstance(st, (ast.Expr,)):
+            pass  # covered by "value"
+
+    def _scan_nested(self, fnode, parent: _Ctx, cls, meth: str,
+                     forced: typing.Optional[str] = None) -> None:
+        """Nested def: thread target -> own thread context; passed as a
+        call argument -> callback context; only called inline -> folded
+        into the parent context (callbacks run outside the registration-
+        time guards, so nested bodies start with an empty guard stack)."""
+        usage = forced or self._nested_usage.get(id(fnode), "inline")
+        if usage == "inline":
+            self._visit_block(fnode.body, parent, cls, guards=(), meth=meth)
+            return
+        mod, pcls, _ = parent.key
+        key = (mod, pcls, f"{meth}.{fnode.name}")
+        sub = self.m.ctx(key)
+        qual = f"{pcls or mod}.{meth}.{fnode.name}"
+        sub.seeds.add(f"{usage}:{qual}")
+        self._visit_block(fnode.body, sub, cls, guards=(), meth=meth)
+
+    # -- expression walk -------------------------------------------------
+    def _visit_expr(self, e, ctx: _Ctx, cls, guards: tuple, meth: str,
+                    as_arg: bool = False) -> None:
+        if e is None or not isinstance(e, ast.expr):
+            return
+        if isinstance(e, ast.Lambda):
+            mod, pcls, _ = ctx.key
+            key = (mod, pcls, f"{meth}.<lambda@{e.lineno}>")
+            sub = self.m.ctx(key)
+            sub.seeds.add(f"callback:{pcls or mod}.{meth}.<lambda>")
+            self._visit_expr(e.body, sub, cls, (), meth)
+            return
+        if isinstance(e, ast.Call):
+            self._visit_call(e, ctx, cls, guards, meth)
+            return
+        if isinstance(e, ast.Attribute):
+            self._record_attr(e, ctx, cls, guards, as_arg)
+            # descend into the base object expression
+            self._visit_expr(e.value, ctx, cls, guards, meth)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self._visit_expr(child, ctx, cls, guards, meth,
+                                 as_arg=as_arg and isinstance(
+                                     e, (ast.Tuple, ast.List)))
+            elif isinstance(child, ast.comprehension):
+                self._visit_expr(child.iter, ctx, cls, guards, meth)
+                for cond in child.ifs:
+                    self._visit_expr(cond, ctx, cls, guards, meth)
+
+    def _record_attr(self, e: ast.Attribute, ctx: _Ctx, cls, guards: tuple,
+                     as_arg: bool) -> None:
+        attr = _self_attr(e)
+        if attr is not None and cls is not None:
+            write = isinstance(e.ctx, (ast.Store, ast.Del))
+            self.m.accesses.append(_Access(
+                (self.mod, cls), attr, write, frozenset(guards),
+                self.rel, e.lineno, ctx.key))
+            if as_arg and not write \
+                    and attr in self.m.class_methods.get((self.mod, cls),
+                                                         ()):
+                self.m.escapes.add(attr)
+            return
+        # self.X.Y with type(X) known: a cross-object access on type(X)
+        base = _self_attr(e.value)
+        if base is not None and cls is not None:
+            t = self.m.attr_types.get((self.mod, cls), {}).get(base)
+            if t and t in self.m.class_index:
+                tmod, _ = self.m.class_index[t]
+                write = isinstance(e.ctx, (ast.Store, ast.Del))
+                self.m.accesses.append(_Access(
+                    (tmod, t), e.attr, write, frozenset(guards),
+                    self.rel, e.lineno, ctx.key))
+            return
+        # escaping bound method on an arbitrary object (obj.m passed as arg)
+        if as_arg and isinstance(e.ctx, ast.Load) \
+                and isinstance(e.value, ast.Name):
+            self.m.escapes.add(e.attr)
+
+    def _visit_call(self, call: ast.Call, ctx: _Ctx, cls, guards: tuple,
+                    meth: str) -> None:
+        func = call.func
+        target = _thread_target(call)
+        if target is not None:
+            tattr = _self_attr(target)
+            if tattr is not None and cls is not None:
+                self.m.thread_roots.add((self.mod, cls, tattr))
+        # callee spec for identity propagation + lock-order
+        spec = None
+        if isinstance(func, ast.Name):
+            if func.id in self.m.module_funcs.get(self.mod, ()):
+                spec = ("mod", self.mod, func.id)
+        elif isinstance(func, ast.Attribute):
+            sattr = _self_attr(func)
+            if sattr is not None and cls is not None:
+                if sattr in self.m.class_methods.get((self.mod, cls), ()):
+                    spec = ("self", self.mod, cls, sattr)
+                else:
+                    # calling a stored callable (self._probe()): the read
+                    # of the attr is an access even though the callee is
+                    # unknowable
+                    self.m.accesses.append(_Access(
+                        (self.mod, cls), sattr, False, frozenset(guards),
+                        self.rel, func.lineno, ctx.key))
+            elif not (isinstance(func.value, ast.Name)
+                      and func.value.id == "self"):
+                base = _self_attr(func.value)
+                t = (self.m.attr_types.get((self.mod, cls), {}).get(base)
+                     if base is not None and cls is not None else None)
+                if t and t in self.m.class_index:
+                    spec = ("class", t, func.attr)
+                else:
+                    spec = ("name", func.attr)
+        if spec is not None:
+            ctx.calls.append(spec)
+            ctx.call_events.append((guards, spec, self.rel, call.lineno))
+        # walk the callee's base object (records self.X reads in chains)
+        if isinstance(func, ast.Attribute):
+            self._visit_expr(func.value, ctx, cls, guards, meth)
+        # arguments: escapes, lambdas, nested-def callbacks (classified in
+        # the prescan)
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            self._visit_expr(arg, ctx, cls, guards, meth, as_arg=True)
+
+    # populated per file in build_model / per function in _prescan_nested
+    _nested_usage: typing.Dict[int, str]
+
+
+def _phase_a(model: SyncModel, rel: str, tree: ast.Module) -> None:
+    """Collect declarations: classes + methods, lock decls, module funcs."""
+    mod = _modstem(rel)
+    model.module_funcs.setdefault(mod, set())
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            model.module_funcs[mod].add(node.name)
+        elif isinstance(node, ast.Assign):
+            _maybe_module_lock(model, mod, rel, node)
+        elif isinstance(node, ast.ClassDef):
+            ckey = (mod, node.name)
+            if node.name in model.class_index:
+                model.warnings.append(Finding(
+                    "sync-lock-order", "warning", f"{rel}:{node.lineno}",
+                    f"class name {node.name!r} shadows "
+                    f"{model.class_index[node.name][1]} in the sync scopes; "
+                    f"attr-type resolution keeps the first"))
+            else:
+                model.class_index[node.name] = (mod, rel)
+            methods = model.class_methods.setdefault(ckey, set())
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.add(sub.name)
+                    _collect_class_decls(model, mod, node.name, rel, sub)
+
+
+def _collect_class_decls(model: SyncModel, mod: str, cls: str, rel: str,
+                         fnode) -> None:
+    """Lock declarations + attr types from ``self.X = ...`` assignments
+    anywhere in the class (usually ``__init__``)."""
+    params: typing.Dict[str, str] = {}
+    args = fnode.args
+    for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+        t = _ann_name(a.annotation)
+        if t:
+            params[a.arg] = t
+    for node in ast.walk(fnode):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        attr = _self_attr(node.targets[0])
+        if attr is None:
+            continue
+        v = node.value
+        if isinstance(v, ast.Call):
+            term = _terminal(v.func)
+            if term in _FACTORY_KINDS:
+                literal = None
+                if v.args and isinstance(v.args[0], ast.Constant) \
+                        and isinstance(v.args[0].value, str):
+                    literal = v.args[0].value
+                _register_lock(model, f"{mod}.{cls}.{attr}", term, True,
+                               literal, rel, node.lineno,
+                               owner=(mod, cls), attr=attr)
+            elif term in _RAW_KINDS and _threading_rooted(v.func):
+                _register_lock(model, f"{mod}.{cls}.{attr}", term, False,
+                               None, rel, node.lineno,
+                               owner=(mod, cls), attr=attr)
+            elif term and term[:1].isupper():
+                # constructor assignment: the best-effort attr type
+                model.attr_types.setdefault((mod, cls), {})[attr] = term
+        elif isinstance(v, ast.Name) and v.id in params:
+            model.attr_types.setdefault((mod, cls), {})[attr] = params[v.id]
+
+
+def _maybe_module_lock(model: SyncModel, mod: str, rel: str,
+                       node: ast.Assign) -> None:
+    if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+        return
+    name = node.targets[0].id
+    v = node.value
+    if not isinstance(v, ast.Call):
+        return
+    term = _terminal(v.func)
+    if term in _FACTORY_KINDS:
+        literal = None
+        if v.args and isinstance(v.args[0], ast.Constant) \
+                and isinstance(v.args[0].value, str):
+            literal = v.args[0].value
+        _register_lock(model, f"{mod}.{name}", term, True, literal, rel,
+                       node.lineno, owner=mod, attr=name)
+    elif term in _RAW_KINDS and _threading_rooted(v.func):
+        _register_lock(model, f"{mod}.{name}", term, False, None, rel,
+                       node.lineno, owner=mod, attr=name)
+
+
+def _threading_rooted(func: ast.expr) -> bool:
+    """``threading.Lock`` / bare ``Lock`` (from-imported) both count."""
+    if isinstance(func, ast.Name):
+        return True
+    return (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading")
+
+
+def _register_lock(model: SyncModel, lid: str, kind: str, factory: bool,
+                   literal: typing.Optional[str], rel: str, lineno: int,
+                   owner, attr: str) -> None:
+    model.locks[lid] = _Lock(lid, kind, factory, literal, rel, lineno)
+    if isinstance(owner, tuple):
+        model.class_locks.setdefault(owner, {})[attr] = lid
+    else:
+        model.module_locks.setdefault(owner, {})[attr] = lid
+    if not factory:
+        factory_name = {"Lock": "make_lock", "RLock": "make_rlock",
+                        "Condition": "make_condition"}.get(kind, "make_lock")
+        model.warnings.append(Finding(
+            "sync-lock-order", "warning", f"{rel}:{lineno}",
+            f"lock {lid} is built directly on threading.{kind} — declare it "
+            f"through homebrewnlp_tpu.sync.{factory_name}({lid!r}) so the "
+            f"HBNLP_SYNC_RECORD recorder can see it"))
+    elif literal is not None and literal != lid:
+        model.warnings.append(Finding(
+            "sync-lock-order", "warning", f"{rel}:{lineno}",
+            f"declared lock name {literal!r} does not match its derived "
+            f"graph id {lid!r}; recorder edges will not line up with the "
+            f"static graph"))
+
+
+def build_model(root: str) -> SyncModel:
+    model = SyncModel()
+    files = []
+    for path, rel in _iter_py_files(root, SYNC_SCOPES):
+        norm = rel.replace(os.sep, "/")
+        if any(norm == e or norm.startswith(e + "/") for e in SYNC_EXEMPT):
+            continue
+        src = open(path).read()
+        tree = ast.parse(src, filename=rel)
+        model.lines[norm] = src.splitlines()
+        files.append((norm, tree))
+    for rel, tree in files:
+        _phase_a(model, rel, tree)
+    for rel, tree in files:
+        sc = _FileScanner(model, rel, tree)
+        sc._nested_usage = {}
+        sc.scan()
+    _finalize(model)
+    return model
+
+
+def _finalize(model: SyncModel) -> None:
+    """Seed thread/callback identities found during the scan, then
+    propagate identities over the resolvable call graph to a fixpoint."""
+    for (mod, cls, meth) in model.thread_roots:
+        key = (mod, cls, meth)
+        ctx = model.ctxs.get(key)
+        if ctx is None:
+            continue
+        ctx.seeds.discard("caller")
+        ctx.seeds.add(f"thread:{cls}.{meth}" if cls else f"thread:{meth}")
+    for (mod, cls), methods in model.class_methods.items():
+        for mname in methods & model.escapes:
+            key = (mod, cls, mname)
+            ctx = model.ctxs.get(key)
+            if ctx is not None and (mod, cls, mname) not in model.thread_roots:
+                ctx.seeds.add(f"callback:{cls}.{mname}")
+    for ctx in model.ctxs.values():
+        ctx.ids = set(ctx.seeds)
+    changed = True
+    while changed:
+        changed = False
+        for ctx in model.ctxs.values():
+            if not ctx.ids:
+                continue
+            for spec in ctx.calls:
+                for tgt in _resolve_spec(model, spec, identity=True):
+                    t = model.ctxs.get(tgt)
+                    if t is not None and not ctx.ids <= t.ids:
+                        t.ids |= ctx.ids
+                        changed = True
+    _build_edges(model)
+
+
+#: stdlib container/IO/threading method names the name-based call fallback
+#: never fans out to — they would wire ``q.put(...)`` to every scanned
+#: method of the same name.  Domain verbs (render, alloc, inc, close, ...)
+#: stay in; ``graftsync --validate`` catches any edge this filter hides.
+_NAME_FALLBACK_SKIP = frozenset({
+    "append", "appendleft", "pop", "popleft", "get", "get_nowait", "put",
+    "put_nowait", "set", "is_set", "clear", "wait", "notify", "notify_all",
+    "acquire", "release", "locked", "join", "start", "items", "keys",
+    "values", "update", "setdefault", "copy", "extend", "insert", "sort",
+    "index", "write", "read", "readline", "flush", "seek", "encode",
+    "decode", "split", "strip", "format", "startswith", "endswith",
+    "lower", "upper", "replace", "remove", "discard",
+    # subprocess.run / file.close would wire into Thread.run bodies and
+    # every scanned close() method; direct x.run() calls are not a pattern
+    # here (threads go through start()) and lock-holding close() calls on
+    # scanned objects do not occur (the recorder validation would flag one)
+    "run", "close"})
+
+
+def _resolve_spec(model: SyncModel, spec: tuple, identity: bool
+                  ) -> typing.List[tuple]:
+    """Callee contexts for a call spec.  Identity propagation uses only the
+    precisely-resolvable kinds; the lock-order closure additionally expands
+    ``name`` specs to every scanned method of that name (over-approximation
+    keeps the static graph a superset of what the recorder can observe)."""
+    kind = spec[0]
+    if kind == "self":
+        _, mod, cls, m = spec
+        return [(mod, cls, m)]
+    if kind == "mod":
+        _, mod, f = spec
+        return [(mod, None, f)]
+    if kind == "class":
+        _, t, m = spec
+        loc = model.class_index.get(t)
+        if loc and m in model.class_methods.get((loc[0], t), ()):
+            return [(loc[0], t, m)]
+        return []
+    if kind == "name" and not identity:
+        _, m = spec
+        if m in _NAME_FALLBACK_SKIP:
+            return []
+        out = []
+        for (mod, cls), methods in model.class_methods.items():
+            if m in methods:
+                out.append((mod, cls, m))
+        for mod, funcs in model.module_funcs.items():
+            if m in funcs:
+                out.append((mod, None, m))
+        return out
+    return []
+
+
+def _build_edges(model: SyncModel) -> None:
+    """Direct nesting edges + transitive call-while-holding edges."""
+    # total acquire sets to a fixpoint (cycles in the call graph are fine)
+    total: typing.Dict[tuple, typing.Set[str]] = {
+        k: set(c.direct_locks) for k, c in model.ctxs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, ctx in model.ctxs.items():
+            acc = total[key]
+            before = len(acc)
+            for spec in ctx.calls:
+                for tgt in _resolve_spec(model, spec, identity=False):
+                    acc |= total.get(tgt, set())
+            if len(acc) != before:
+                changed = True
+
+    def add(a: str, b: str, loc: str) -> None:
+        if a != b:
+            model.edges.setdefault((a, b), set()).add(loc)
+
+    for ctx in model.ctxs.values():
+        for held, lid, rel, line in ctx.acquires:
+            for h in held:
+                add(h, lid, f"{rel}:{line}")
+        for held, spec, rel, line in ctx.call_events:
+            if not held:
+                continue
+            for tgt in _resolve_spec(model, spec, identity=False):
+                for lid in total.get(tgt, ()):
+                    for h in held:
+                        add(h, lid, f"{rel}:{line}")
+
+
+# -- shared-state evaluation ---------------------------------------------
+
+def shared_state_report(model: SyncModel) -> typing.List[dict]:
+    by_attr: typing.Dict[tuple, typing.List[_Access]] = {}
+    for acc in model.accesses:
+        if acc.attr in model.class_locks.get(acc.owner, {}):
+            continue  # the lock attrs themselves
+        by_attr.setdefault((acc.owner, acc.attr), []).append(acc)
+    out: typing.List[dict] = []
+    for (owner, attr), accs in sorted(by_attr.items(),
+                                      key=lambda kv: (kv[0][0], kv[0][1])):
+        live = []
+        for a in accs:
+            ctx = model.ctxs.get(a.ctx)
+            ids = {i for i in (ctx.ids if ctx else ())
+                   if i != "init"}
+            if ids:
+                live.append((a, ids))
+        if not live:
+            continue
+        all_ids = set().union(*[ids for _, ids in live])
+        writes = [(a, ids) for a, ids in live if a.write]
+        if not writes:
+            continue
+        if len(all_ids) < 2 or not any(
+                i.startswith(("thread:", "callback:")) for i in all_ids):
+            continue
+        writer_ids = set().union(*[ids for _, ids in writes])
+        need = [(a, ids) for a, ids in live
+                if a.write or len(writer_ids) != 1 or not ids <= writer_ids]
+        if not need:
+            continue
+        mod, cls = owner
+        own = (set(model.class_locks.get(owner, {}).values())
+               | set(model.module_locks.get(mod, {}).values()))
+        counts: typing.Dict[str, int] = {}
+        for a, _ in need:
+            for lid in a.guards & own:
+                counts[lid] = counts.get(lid, 0) + 1
+        best = max(counts, key=counts.get) if counts else None
+        viol = []
+        for a, ids in need:
+            if best is not None and best in a.guards:
+                continue
+            lines = model.lines.get(a.rel, ())
+            if _suppressed(lines, a.lineno, "sync-shared-state"):
+                continue
+            viol.append({"file": a.rel, "line": a.lineno,
+                         "write": a.write, "identities": sorted(ids)})
+        if not viol:
+            continue
+        rel = model.class_index.get(cls, (None, "?"))[1]
+        out.append({"key": f"{rel}::{cls}.{attr}", "class": cls,
+                    "attr": attr, "lock": best,
+                    "identities": sorted(all_ids), "sites": viol})
+    return out
+
+
+def shared_state_counts(root: str) -> typing.Dict[str, int]:
+    model = build_model(root)
+    return {r["key"]: len(r["sites"]) for r in shared_state_report(model)}
+
+
+def sync_shared_state_golden_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "goldens", "sync", "shared_state.json")
+
+
+def check_shared_state(root: str, update_goldens: bool = False
+                       ) -> typing.List[Finding]:
+    return _check_ratchet(
+        "sync-shared-state", shared_state_counts(root),
+        sync_shared_state_golden_path(), update_goldens,
+        unit="unguarded multi-thread access(es)",
+        over_hint="an attribute reached from >=2 thread identities with an "
+                  "unlocked write is a data race; guard every access with "
+                  "the owning class's declared lock (see docs/"
+                  "static_analysis.md#concurrency-audit)")
+
+
+# -- lock-order evaluation ------------------------------------------------
+
+def lock_order_edges(root: str) -> typing.Dict[str, typing.List[str]]:
+    model = build_model(root)
+    return {f"{a} -> {b}": sorted(locs)
+            for (a, b), locs in model.edges.items()}
+
+
+def sync_lock_order_golden_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "goldens", "sync", "lock_order.json")
+
+
+def _find_cycles(edges: typing.Iterable[typing.Tuple[str, str]]
+                 ) -> typing.List[typing.List[str]]:
+    """Strongly-connected components with >1 node (self-edges are already
+    excluded at construction): each is a potential deadlock cycle."""
+    adj: typing.Dict[str, typing.List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index: typing.Dict[str, int] = {}
+    low: typing.Dict[str, int] = {}
+    on: typing.Set[str] = set()
+    stack: typing.List[str] = []
+    out: typing.List[typing.List[str]] = []
+    counter = [0]
+
+    def strong(v: str) -> None:
+        work = [(v, iter(adj[v]))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    out.append(sorted(scc))
+
+    for v in sorted(adj):
+        if v not in index:
+            strong(v)
+    return out
+
+
+def check_lock_order(root: str, update_goldens: bool = False
+                     ) -> typing.List[Finding]:
+    model = build_model(root)
+    findings = list(model.warnings)
+    edges = {f"{a} -> {b}": sorted(locs)
+             for (a, b), locs in model.edges.items()}
+    for cyc in _find_cycles(model.edges):
+        findings.append(Finding(
+            "sync-lock-order", "error", "lock-order graph",
+            f"lock acquisition cycle (potential deadlock): "
+            f"{' -> '.join(cyc)} -> {cyc[0]} — break it by ordering the "
+            f"acquisitions or moving the inner call outside the lock"))
+    path = sync_lock_order_golden_path()
+    if update_goldens:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"edges": sorted(edges)}, f, indent=2)
+            f.write("\n")
+        findings.append(Finding(
+            "sync-lock-order", "info", path,
+            f"lock-order golden updated ({len(edges)} edge(s), "
+            f"{len(model.locks)} declared lock(s))"))
+        return findings
+    if not os.path.exists(path):
+        findings.append(Finding(
+            "sync-lock-order", "error", path,
+            "no lock-order golden; run --update-goldens"))
+        return findings
+    golden = set(json.load(open(path)).get("edges", []))
+    for e in sorted(set(edges) - golden):
+        findings.append(Finding(
+            "sync-lock-order", "error", edges[e][0],
+            f"new lock-order edge {e} is not in the pinned golden — check "
+            f"every other path between these locks uses the same order, "
+            f"then re-record with --update-goldens"))
+    for e in sorted(golden - set(edges)):
+        findings.append(Finding(
+            "sync-lock-order", "info", path,
+            f"pinned lock-order edge {e} no longer occurs; re-record with "
+            f"--update-goldens"))
+    return findings
+
+
+def run_sync_rules(root: str, update_goldens: bool = False
+                   ) -> typing.List[Finding]:
+    return (check_shared_state(root, update_goldens)
+            + check_lock_order(root, update_goldens))
+
+
+# -- runtime validation ---------------------------------------------------
+
+def validate_recorded(root: str, records: typing.Iterable[dict]
+                      ) -> typing.List[Finding]:
+    """The graftspmd-style honesty check: every ``held -> acquired`` edge
+    the HBNLP_SYNC_RECORD shim logged while the real suites ran must appear
+    in the static graph; a missed edge means the model lied.  Held-while-
+    blocking events are informational (contention is legal; order is what
+    deadlocks), held-while-joining is a warning (shutdown hazard)."""
+    model = build_model(root)
+    static = {f"{a} -> {b}" for (a, b) in model.edges}
+    known = set(model.locks)
+    findings: typing.List[Finding] = []
+    seen_edges: typing.Set[typing.Tuple[str, str]] = set()
+    n_blocked = n_joins = 0
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "edge":
+            seen_edges.add((rec["src"], rec["dst"]))
+        elif kind == "blocked":
+            n_blocked += 1
+        elif kind == "join":
+            n_joins += 1
+            findings.append(Finding(
+                "sync-lock-order", "warning", "recorded",
+                f"Thread.join({rec.get('thread')!r}) while holding "
+                f"{rec.get('held')} — the joined thread may need that lock "
+                f"to exit; release before joining"))
+    for src, dst in sorted(seen_edges):
+        if src not in known or dst not in known:
+            findings.append(Finding(
+                "sync-lock-order", "error", "recorded",
+                f"recorded edge {src} -> {dst} involves a lock the static "
+                f"model does not know — a declaration outside the sync "
+                f"factories or outside SYNC_SCOPES"))
+        elif f"{src} -> {dst}" not in static:
+            findings.append(Finding(
+                "sync-lock-order", "error", "recorded",
+                f"recorded lock-order edge {src} -> {dst} is MISSING from "
+                f"the static graph — the model under-approximates reality; "
+                f"extend analysis/concurrency.py resolution until it sees "
+                f"this path"))
+    findings.append(Finding(
+        "sync-lock-order", "info", "recorded",
+        f"runtime validation: {len(seen_edges)} recorded edge(s) vs "
+        f"{len(static)} static, {n_blocked} held-while-blocking event(s), "
+        f"{n_joins} join-with-held event(s)"))
+    return findings

@@ -541,6 +541,20 @@ def check_golden_coverage(config_names: typing.Sequence[str]
                 "golden-coverage", "warning", os.path.relpath(path_fn(name)),
                 f"orphan {kind} golden: no configs/{name}.json — delete it "
                 f"or restore the config"))
+    # tree-wide (not per-config) goldens from the concurrency audit: the
+    # sync rules error out themselves when theirs are missing, but only if
+    # they run — this gate makes a deleted golden fail even rule-filtered
+    # runs that skip them
+    from .concurrency import (sync_lock_order_golden_path,
+                              sync_shared_state_golden_path)
+    for kind, path in (("sync shared-state", sync_shared_state_golden_path()),
+                       ("sync lock-order", sync_lock_order_golden_path())):
+        if not os.path.exists(path):
+            findings.append(Finding(
+                "golden-coverage", "error", os.path.relpath(path),
+                f"missing {kind} golden — the concurrency audit would "
+                f"refuse to ratchet; run `python tools/graftsync.py "
+                f"--update-goldens`"))
     return findings
 
 

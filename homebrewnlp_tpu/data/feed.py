@@ -181,9 +181,12 @@ class DeviceFeeder:
         self._err: typing.List[BaseException] = []
         self._finished = False  # DONE sentinel consumed: every later
         #                         __next__ must re-raise, never re-get()
-        self._producer_done = False  # producer exited through its normal
-        #                              tail (exhaustion), not a crash
-        self._closed = False
+        # cross-thread flags are Events (atomic set/is_set), not bare bools:
+        # _producer_done is written by the producer thread and read by
+        # /healthz probes, _closed by the consumer and read by the probes
+        self._producer_done = threading.Event()  # normal-tail exit, not a
+        #                                          crash
+        self._closed = threading.Event()
         self._thread: typing.Optional[threading.Thread] = None
         self._queue: typing.Optional[queuelib.Queue] = None
         self._stop = threading.Event()
@@ -222,7 +225,7 @@ class DeviceFeeder:
         except BaseException as e:  # surfaced on the consumer side
             self._err.append(e)
         self._put((self._DONE, None))
-        self._producer_done = True
+        self._producer_done.set()
 
     def _assemble(self, np_batch):
         """``to_global`` (host assembly + H2D transfer) under a span + the
@@ -276,11 +279,11 @@ class DeviceFeeder:
         normal tail (dataset exhaustion, or an error the consumer will be
         HANDED on its next read) is not a crash — only a thread that died
         without parking its sentinel reads as dead."""
-        if self.depth == 0 or self._closed:
+        if self.depth == 0 or self._closed.is_set():
             return True  # inline path / run over: nothing to die separately
         if self._thread is not None and self._thread.is_alive():
             return True
-        return self._producer_done
+        return self._producer_done.is_set()
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop the producer and join it; safe to call repeatedly.
@@ -290,7 +293,7 @@ class DeviceFeeder:
         on ``get()`` while it runs.  A producer blocked on the SOURCE
         (e.g. the host-prefetch queue) is woken by closing the source
         first — main.py closes the pipe before the feeder."""
-        self._closed = True
+        self._closed.set()
         if self._thread is None:
             return
         self._stop.set()
@@ -299,5 +302,7 @@ class DeviceFeeder:
                 self._queue.get_nowait()
         except queuelib.Empty:
             pass
+        # the handle is write-once (set in __init__, never cleared): alive()
+        # reads it from probe threads, and join() on a finished thread is a
+        # no-op, so repeated close() stays safe without nulling it
         self._thread.join(timeout)
-        self._thread = None

@@ -36,6 +36,7 @@ from ..models import build
 from ..models.ctx import Ctx, DecodeState
 from ..nd import NT
 from .sampler import _gumbel_argmax
+from ..sync import make_lock
 
 _SEQUENCE_MIXERS = ("cumsum", "cummean", "convolution",
                     "transpose_sequence_features")
@@ -200,8 +201,7 @@ class BlockAllocator:
             raise ValueError("BlockAllocator needs block_tokens >= 1")
         self.n_blocks = int(n_blocks)
         self.block_tokens = int(block_tokens)
-        import threading
-        self._lock = threading.Lock()
+        self._lock = make_lock("infer.kv_cache.BlockAllocator._lock")
         # LIFO free list: a finishing request's blocks go straight to the
         # next admission (warm reuse), and ids stay stable for tests
         self._free = list(range(self.n_blocks - 1, -1, -1))

@@ -115,11 +115,18 @@ def _gumbel_argmax_lanes(logits: jnp.ndarray, temperature: jnp.ndarray,
     """:func:`_gumbel_argmax` with per-lane traced (temperature, top_k,
     top_p) [lanes] vectors — the batched decode step samples every lane's
     row under its own request's knobs in one compilation.  Lane
-    temperature 0 stays exact greedy for that lane."""
+    temperature 0 stays exact greedy for that lane.
+
+    ``key`` is a [lanes] key array: each lane draws its Gumbel noise from
+    its OWN stream (serve/engine.py::lane_key), and a lane's draw covers
+    exactly one row — the same element count as the serialized sampler's
+    per-step draw, so the bits (and at temperature 1.0 the sampled
+    tokens) match that path key-for-key."""
     logits = logits.astype(jnp.float32)
     side = (logits.shape[0],) + (1,) * (logits.ndim - 1)
     t = temperature.astype(jnp.float32).reshape(side)
-    u = jax.random.uniform(key, logits.shape, jnp.float32, 1e-9, 1.0)
+    u = jax.vmap(lambda k: jax.random.uniform(
+        k, logits.shape[1:], jnp.float32, 1e-9, 1.0))(key)
     gumbel = -jnp.log(-jnp.log(u))
     hot = (t > 0).astype(jnp.float32)
     tempered = logits / jnp.where(t > 0, t, 1.0)

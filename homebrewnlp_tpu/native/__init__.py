@@ -14,10 +14,12 @@ import typing
 
 import numpy as np
 
+from ..sync import make_lock
+
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _NATIVE_DIR = os.path.join(_REPO, "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libhbnlp_native.so")
-_lock = threading.Lock()
+_lock = make_lock("native._lock")
 _lib: typing.Optional[ctypes.CDLL] = None
 _build_failed = False
 

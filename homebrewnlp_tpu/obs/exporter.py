@@ -29,6 +29,7 @@ import typing
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .registry import REGISTRY, MetricsRegistry
+from ..sync import make_lock
 
 LOG = logging.getLogger("homebrewnlp_tpu.obs")
 
@@ -54,7 +55,7 @@ class Health:
         threshold (``stall_threshold``); /healthz and the Watchdog both
         consult it, so the two consumers of the liveness signal cannot
         disagree."""
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.exporter.Health._lock")
         self.stall_factor = float(stall_factor) if stall_factor else 10.0
         self.ema_alpha = ema_alpha
         self.min_stall_s = float(min_stall_s)
@@ -333,7 +334,7 @@ def stop_server(server: _ObsServer) -> None:
 # -- diagnostics dump + watchdog ---------------------------------------------
 
 _DUMP_SEQ = [0]
-_DUMP_LOCK = threading.Lock()
+_DUMP_LOCK = make_lock("obs.exporter._DUMP_LOCK")
 
 
 def dump_diagnostics(model_path: str, health: typing.Optional[Health] = None,

@@ -60,6 +60,20 @@ except ImportError:  # loaded by file path (tools/supervise.py _load_light)
     merge_histogram_counts = _reg.merge_histogram_counts
     sample_quantile = _reg.sample_quantile
 
+try:
+    from ..sync import make_lock
+except ImportError:  # loaded by file path (tools/supervise.py _load_light)
+    import sys as _sys
+    _sync = (_sys.modules.get("homebrewnlp_tpu.sync")
+             or _sys.modules.get("hbnlp_sync"))
+    if _sync is not None:
+        make_lock = _sync.make_lock
+    else:  # truly standalone: plain lock, no recording
+
+        def make_lock(name: str) -> "threading.Lock":
+            return threading.Lock()
+
+
 LOG = logging.getLogger("homebrewnlp_tpu.obs.fleet")
 
 #: env vars the supervisor injects so the child (and its run-start markers,
@@ -697,7 +711,7 @@ class FleetReporter:
         #: constant for the process lifetime): stamped on every step post
         #: so skew attribution never compares walls across relaunches
         self.generation = identity().get("generation")
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.fleet.FleetReporter._lock")
         self._last_render = 0.0
         self._steps_f = None
         self._warned = False
@@ -721,6 +735,7 @@ class FleetReporter:
         row = {"step": int(step), "wall": float(dispatch_wall)}
         if self.generation is not None:
             row["gen"] = self.generation
+        due = False
         with self._lock:
             if self._steps_f is not None:
                 try:
@@ -732,21 +747,25 @@ class FleetReporter:
             if (self.registry is not None
                     and now - self._last_render >= self.min_render_s):
                 self._last_render = now
-                self._render_prom_locked()
+                due = True
+        if due:
+            self.render_prom()
 
     def render_prom(self) -> None:
-        with self._lock:
-            self._render_prom_locked()
-
-    def _render_prom_locked(self) -> None:
         if self.registry is None:
             return
+        # evaluate the registry's render-time gauge callbacks OUTSIDE the
+        # reporter lock: a callback may take its own lock (Health, engine
+        # probes) and must never nest under ours — the recorded-edge
+        # validation (graftsync --validate) pins this
+        text = self.registry.render()
         path = os.path.join(self.dir, f"metrics_r{self.rank}.prom")
         tmp = f"{path}.tmp.{os.getpid()}"
         try:
-            with open(tmp, "w") as f:  # graftcheck: disable=bare-io
-                f.write(self.registry.render())
-            os.replace(tmp, path)
+            with self._lock:  # tmp name is per-pid, not per-thread
+                with open(tmp, "w") as f:  # graftcheck: disable=bare-io
+                    f.write(text)
+                os.replace(tmp, path)
         except OSError as e:
             self._warn(f"prom snapshot failed: {e!r}")
 
@@ -772,8 +791,8 @@ class FleetReporter:
         return report
 
     def close(self) -> None:
+        self.render_prom()
         with self._lock:
-            self._render_prom_locked()
             if self._steps_f is not None:
                 try:
                     self._steps_f.close()

@@ -22,8 +22,22 @@ from __future__ import annotations
 
 import math
 import re
+import sys
 import threading
 import typing
+
+try:
+    from ..sync import make_rlock
+except ImportError:  # loaded by file path (tools/supervise.py _load_light)
+    _sync = (sys.modules.get("homebrewnlp_tpu.sync")
+             or sys.modules.get("hbnlp_sync"))
+    if _sync is not None:
+        make_rlock = _sync.make_rlock
+    else:  # truly standalone: plain lock, no recording
+
+        def make_rlock(name: str) -> "threading.RLock":
+            return threading.RLock()
+
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
@@ -419,7 +433,9 @@ class Histogram(_Metric):
 
 class MetricsRegistry:
     def __init__(self):
-        self._lock = threading.RLock()
+        # reentrant: render() holds it while evaluating gauge callbacks,
+        # and a callback may legitimately touch the same registry
+        self._lock = make_rlock("obs.registry.MetricsRegistry._lock")
         self._metrics: typing.Dict[str, _Metric] = {}
 
     def _get_or_make(self, cls, name: str, help_text: str,

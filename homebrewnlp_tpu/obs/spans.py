@@ -28,6 +28,8 @@ import threading
 import time
 import typing
 
+from ..sync import make_lock
+
 
 class _NullSpan:
     """Shared no-op context manager for the disabled path."""
@@ -83,7 +85,7 @@ class SpanTracer:
     stay exact regardless of the ring."""
 
     def __init__(self, mirror_jax: bool = True, max_events: int = 1_000_000):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.spans.SpanTracer._lock")
         # (name, t0, t1, tid, args) with t relative to tracer creation
         self._events: typing.Deque[tuple] = collections.deque(
             maxlen=max_events)

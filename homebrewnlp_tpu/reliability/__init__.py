@@ -29,6 +29,7 @@ from . import faults  # noqa: F401
 from .retry import (DEFAULT_POLICY, FLUSH_POLICY, RetryPolicy,  # noqa: F401
                     retry_call, retrying)
 from . import dist  # noqa: F401  (after retry/faults: dist imports both)
+from ..sync import make_lock, make_rlock
 
 LOG = logging.getLogger("homebrewnlp_tpu.reliability")
 
@@ -69,6 +70,10 @@ class GraceController:
         self.deadline_s = float(deadline_s)
         self.signame: typing.Optional[str] = None
         self._event = threading.Event()
+        # reentrant: the handler runs ON the main thread between bytecodes,
+        # so a signal landing inside uninstall()'s critical section would
+        # self-deadlock a plain Lock
+        self._lock = make_rlock("reliability.GraceController._lock")
         self._timer: typing.Optional[threading.Timer] = None
         self._prev: typing.Dict[int, typing.Any] = {}
         self._installed = False
@@ -89,9 +94,10 @@ class GraceController:
         return self
 
     def uninstall(self) -> None:
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
+        with self._lock:
+            timer, self._timer = self._timer, None
+        if timer is not None:
+            timer.cancel()
         if self._installed:
             for sig, prev in self._prev.items():
                 signal.signal(sig, prev)
@@ -107,9 +113,11 @@ class GraceController:
         self.signame = signal.Signals(signum).name
         self._event.set()
         if self.deadline_s > 0:
-            self._timer = threading.Timer(self.deadline_s, self._expire)
-            self._timer.daemon = True
-            self._timer.start()
+            timer = threading.Timer(self.deadline_s, self._expire)
+            timer.daemon = True
+            with self._lock:
+                self._timer = timer
+            timer.start()
         LOG.warning("%s received: draining the step loop and cutting a "
                     "grace checkpoint (deadline %.0fs)", self.signame,
                     self.deadline_s)
@@ -134,7 +142,7 @@ class CorruptRecordBudget:
         from ..obs.registry import REGISTRY
         self.limit = int(limit)
         self.spent = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("reliability.CorruptRecordBudget._lock")
         reg = REGISTRY if registry is None else registry
         # labelled by pipeline so dashboards can tell a rotting text corpus
         # from a rotting frame store (the video decoder spends the budget on

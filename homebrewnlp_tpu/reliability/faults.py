@@ -68,6 +68,8 @@ import signal as signal_mod
 import threading
 import typing
 
+from ..sync import make_lock
+
 LOG = logging.getLogger("homebrewnlp_tpu.reliability.faults")
 
 ACTIONS = ("fail", "die", "sigterm", "sigint", "corrupt", "nan", "drop")
@@ -158,7 +160,7 @@ class FaultPlan:
     def __init__(self, rules: typing.Sequence[FaultRule] = ()):
         self.rules = list(rules)
         self._counts: typing.Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("reliability.faults.FaultPlan._lock")
 
     @classmethod
     def from_spec(cls, spec: typing.Optional[str]) -> "FaultPlan":

@@ -54,6 +54,7 @@ from ..config import Config
 from ..data.feed import TEXT_AXES
 from ..infer import kv_cache as kvc
 from ..infer.sampler import _fire_first_token, _gumbel_argmax_lanes
+from ..sync import make_condition
 from . import slo
 from .interface import (QueueDeadlineExceeded, _RowStream,
                         effective_truncation, tokenizer_for)
@@ -63,7 +64,9 @@ from .interface import (QueueDeadlineExceeded, _RowStream,
 #: ones compiled WITHOUT donation (serialize_executable cannot round-trip
 #: input-output aliasing — see jit_executables), so the serialized calling
 #: convention is unchanged and existing caches stay valid.
-AOT_FORMAT = 1
+#: 2: the rng carry became a [n_lanes] key array (per-lane streams seeded
+#: by fold_in(request id) — :func:`lane_key`) instead of one shared key.
+AOT_FORMAT = 2
 
 #: donated argument positions of the jitted executables (relative to the
 #: bound callables :func:`jit_executables` builds).  The pooled KV caches,
@@ -82,6 +85,16 @@ DECODE_DONATE_ARG_NAMES = {1: "pooled KV caches", 2: "token pool",
 PREFILL_DONATE_ARG_NAMES = {1: "pooled KV caches", 2: "token pool"}
 
 
+def lane_key(seed: int, rid: int) -> jax.Array:
+    """The decode RNG stream for one admitted request: the run seed folded
+    with the request id.  A pure function of ``(seed, rid)`` — never of
+    lane index or admission order — so a request's sampled tokens are
+    reproducible under ANY interleaving, and lane 0 parity-pins against
+    the serialized sampler called with this same key
+    (tests/serve_engine_test.py)."""
+    return jax.random.fold_in(jax.random.key(seed), rid)
+
+
 def decode_body(cfg: Config, rows: int, n_lanes: int,
                 first_token_cb: typing.Optional[typing.Callable],
                 params, caches, toks, pos, active, end_row,
@@ -92,12 +105,21 @@ def decode_body(cfg: Config, rows: int, n_lanes: int,
     untouched.  Mirrors the serialized cached sampler's body
     (infer/kv_cache.py) with per-lane positions.  Module-level (bound via
     ``functools.partial``) so the static donation audit traces the exact
-    function the engine compiles."""
-    rng, sub = jax.random.split(rng)
+    function the engine compiles.
+
+    ``rng`` is a [n_lanes] key array — one stream per lane, seeded at
+    admission from :func:`lane_key`.  A lane's carry advances only on
+    steps it actually decodes, so the stream is a pure function of
+    (seed, rid, tokens generated so far): idle steps between admissions
+    cannot shift a request's samples."""
+    # the same carry/sub discipline as the serialized sampler's body
+    # (``key, sub = split(key)``), vmapped over lanes
+    pair = jax.vmap(jax.random.split)(rng)
+    advanced, subs = pair[:, 0], pair[:, 1]
     row = jnp.take_along_axis(toks, pos[:, None, None], axis=1)
     logits, caches = kvc._decode_logits(cfg, params, row, pos, caches,
                                         rows, TEXT_AXES)
-    sampled = _gumbel_argmax_lanes(logits, temps, sub, ks, ps)
+    sampled = _gumbel_argmax_lanes(logits, temps, subs, ks, ps)
     nxt = pos + 1
     write = active & (nxt < end_row) & (nxt < rows)
     tgt = jnp.minimum(nxt, rows - 1)
@@ -115,6 +137,12 @@ def decode_body(cfg: Config, rows: int, n_lanes: int,
                               write[b] & (nxt[b] == first_gen[b]),
                               new_row[b])
     pos = jnp.where(active, nxt, pos)
+    # advance only the lanes that decoded (typed keys: select on the raw
+    # key data, then re-wrap under the same impl)
+    data = jax.random.key_data(rng)
+    keep = active.reshape((-1,) + (1,) * (data.ndim - 1))
+    rng = jax.random.wrap_key_data(
+        jnp.where(keep, jax.random.key_data(advanced), data))
     return caches, toks, pos, rng, logits
 
 
@@ -180,7 +208,8 @@ def abstract_exec_args(cfg: Config, params_tree, rows: int, n_lanes: int):
     lanes = (n_lanes,)
     common = (tree, caches, s((n_lanes, rows, cfg.token_patch_size),
                               jnp.int32))
-    rng = jax.eval_shape(lambda: jax.random.key(0))
+    rng = jax.eval_shape(lambda: jax.random.split(jax.random.key(0),
+                                                  n_lanes))
     decode = common + (s(lanes, jnp.int32), s(lanes, jnp.bool_),
                        s(lanes, jnp.int32), s(lanes, jnp.int32),
                        s(lanes, jnp.float32), s(lanes, jnp.int32),
@@ -341,7 +370,10 @@ class BatchEngine:
         self._toks = jnp.zeros((self.n_lanes, self.rows, self.patch),
                                jnp.int32)
         self._pos = jnp.zeros((self.n_lanes,), jnp.int32)
-        self._rng = jax.random.key(cfg.data_seed)
+        # per-lane RNG carries; every admission overwrites its lane with
+        # lane_key(seed, rid), so these initial streams never sample
+        self._rngs = jax.random.split(jax.random.key(cfg.data_seed),
+                                      self.n_lanes)
         # host mirrors (the scheduler thread is the only writer)
         self._pos_h = np.zeros(self.n_lanes, np.int32)
         self._end_row = np.zeros(self.n_lanes, np.int32)
@@ -354,7 +386,7 @@ class BatchEngine:
         self._lane_req: typing.List[typing.Optional[_BatchRequest]] = (
             [None] * self.n_lanes)
         # scheduler plumbing
-        self._cv = threading.Condition()
+        self._cv = make_condition("serve.engine.BatchEngine._cv")
         self._queue: typing.List[_BatchRequest] = []
         self._pending = 0  # submitted, not yet admitted (queue_depth)
         self._closed = False
@@ -426,13 +458,17 @@ class BatchEngine:
         return self.allocator.free_blocks
 
     def active_lanes(self) -> int:
-        return sum(1 for r in self._lane_req if r is not None)
+        # _cv wraps an RLock, so the scheduler loop's locked wait
+        # predicate re-enters here safely
+        with self._cv:
+            return sum(1 for r in self._lane_req if r is not None)
 
     def set_batch_observer(self, fn: typing.Optional[typing.Callable]
                            ) -> None:
         """Per-decode-step occupancy sink (``ServeSLO.observe_batch``):
         called with the number of active lanes after each step."""
-        self._batch_observer = fn
+        with self._cv:
+            self._batch_observer = fn
 
     def set_step_observer(self, fn: typing.Optional[typing.Callable]
                           ) -> None:
@@ -442,7 +478,8 @@ class BatchEngine:
         values are contiguous host segments of the iteration, so they sum
         to ``wall_s`` (docs/observability.md "Streaming and inter-token
         latency")."""
-        self._step_observer = fn
+        with self._cv:
+            self._step_observer = fn
 
     def submit(self, prompt: typing.Sequence[int], temperature: float,
                max_tokens: typing.Optional[int],
@@ -669,6 +706,12 @@ class BatchEngine:
         self._ks[lane] = req.top_k
         self._ps[lane] = req.top_p
         self._tags[lane] = req.tag
+        # arm the lane's RNG stream: fold_in(seed, rid) — independent of
+        # lane placement and admission order (typed keys have no .at, so
+        # splice on the raw key data)
+        data = jax.random.key_data(self._rngs)
+        self._rngs = jax.random.wrap_key_data(data.at[lane].set(
+            jax.random.key_data(lane_key(cfg.data_seed, req.rid))))
         self._pos = jnp.asarray(self._pos_h)
         if self._pos_h[lane] >= req.end_row - 1:
             # nothing to generate (full prompt / zero budget): complete
@@ -691,10 +734,10 @@ class BatchEngine:
         prev_pos = self._pos_h.copy()
         active = (np.array([r is not None for r in self._lane_req])
                   & (self._pos_h < self._end_row - 1))
-        self._caches, self._toks, self._pos, self._rng, self._logits = (
+        self._caches, self._toks, self._pos, self._rngs, self._logits = (
             self._decode(self.params, self._caches, self._toks, self._pos,
                          active, self._end_row, self._first_gen, self._temps,
-                         self._ks, self._ps, self._rng, self._tags))
+                         self._ks, self._ps, self._rngs, self._tags))
         t_dispatch = time.perf_counter()
         segs.append(("dispatch", t_start, t_dispatch))
         # blocks until the step lands (the loop's pacing sync); copy — the
@@ -726,7 +769,8 @@ class BatchEngine:
                      np.asarray(self._toks[lane]).reshape(-1)[:req.end]))
         t_sample = time.perf_counter()
         segs.append(("sample", t_sync, t_sample))
-        obs = self._batch_observer
+        with self._cv:
+            obs = self._batch_observer
         if obs is not None:
             try:
                 obs(n_active)
@@ -828,7 +872,8 @@ class BatchEngine:
             phases[name] = phases.get(name, 0.0) + (s1 - s0)
         phases["admit"] = max(0.0, phases["admit"] - prefill_s)
         phases["prefill"] = prefill_s
-        observer = self._step_observer
+        with self._cv:
+            observer = self._step_observer
         if observer is not None:
             try:
                 observer(wall, phases, n_active, stall_s, stepped)
@@ -865,7 +910,8 @@ class BatchEngine:
         self._toks = jnp.zeros((self.n_lanes, self.rows, self.patch),
                                jnp.int32)
         self._pos = jnp.zeros((self.n_lanes,), jnp.int32)
-        self._rng = jax.random.key(cfg.data_seed)
+        self._rngs = jax.random.split(jax.random.key(cfg.data_seed),
+                                      self.n_lanes)
         self._pos_h = np.zeros(self.n_lanes, np.int32)
 
     def _fail_all(self, e: BaseException) -> None:

@@ -24,6 +24,7 @@ from ..data.feed import TEXT_AXES
 from ..infer.sampler import make_text_sampler
 from ..nd import NT
 from . import slo
+from ..sync import make_lock
 
 
 class QueueDeadlineExceeded(RuntimeError):
@@ -163,7 +164,7 @@ class _RowStream:
         self.emitted = min(int(prompt_len), self.end)
         self.next_row = int(first_row)
         self.buf: typing.Dict[int, typing.List[int]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.interface._RowStream._lock")
         self._closed = False
         if initial_tokens is not None:
             gap_hi = min(self.next_row * self.patch, self.end)
@@ -253,9 +254,11 @@ class CompletionEngine:
         # the dataset-driven sample run mode, reference inference.py:136-170)
         self._sampler = self._make_sampler(cfg)
         self._samplers: typing.Dict[tuple, typing.Callable] = {}
-        self._samplers_lock = threading.Lock()
+        self._samplers_lock = make_lock(
+            "serve.interface.CompletionEngine._samplers_lock")
         self._rng = jax.random.key(cfg.data_seed)
-        self._rng_lock = threading.Lock()
+        self._rng_lock = make_lock(
+            "serve.interface.CompletionEngine._rng_lock")
 
     def _make_sampler(self, cfg: Config):
         from ..infer.kv_cache import cache_eligible, make_cached_text_sampler
@@ -448,7 +451,8 @@ class InterfaceWrapper:
         # healthy arrivals, inflate hbnlp_serve_queue_depth, and overprice
         # Retry-After for as long as the workers stay busy
         self._pending = 0
-        self._pending_lock = threading.Lock()
+        self._pending_lock = make_lock(
+            "serve.interface.InterfaceWrapper._pending_lock")
         self._threads = []
         for _ in range(n):
             t = threading.Thread(target=self._worker, daemon=True)

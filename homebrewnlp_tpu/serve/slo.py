@@ -41,6 +41,7 @@ import typing
 from ..obs import spans
 from ..obs.registry import (DEFAULT_BUCKETS, FINE_LATENCY_BUCKETS, REGISTRY,
                             Histogram, MetricsRegistry, bucket_quantile)
+from ..sync import make_lock
 
 #: decode-rate buckets (tokens/second) — latency buckets make no sense here
 DECODE_RATE_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
@@ -93,7 +94,8 @@ class RequestRecord:
 
     __slots__ = ("rid", "path", "t_arrival", "t_parsed", "t_enqueued",
                  "t_started", "t_first_token", "t_engine_done", "t_finished",
-                 "queue_depth", "tokens_generated", "status", "token_times")
+                 "queue_depth", "tokens_generated", "status", "token_times",
+                 "_lock")
 
     def __init__(self, rid: int, path: str = ""):
         self.rid = rid
@@ -114,6 +116,11 @@ class RequestRecord:
         #: non-streaming serialized request records none — its tokens only
         #: became visible at completion)
         self.token_times: typing.List[float] = []
+        # the first-token stamp races two writers (the graph's TTFT
+        # callback thread vs the engine's emit pass calling mark_token) —
+        # "first stamp wins" needs the check-and-set atomic; instances
+        # share the declared name, which the recorder merges by design
+        self._lock = make_lock("serve.slo.RequestRecord._lock")
 
     # -- stamps (one writer each) -------------------------------------------
     def mark_parsed(self) -> None:
@@ -129,24 +136,28 @@ class RequestRecord:
     def mark_first_token(self, token: typing.Optional[int] = None) -> None:
         # first stamp wins; `token` (the sampled id) is accepted so the
         # engine dispatcher can hand the callback straight through
-        if self.t_first_token is None:
-            self.t_first_token = time.perf_counter()
+        with self._lock:
+            if self.t_first_token is None:
+                self.t_first_token = time.perf_counter()
 
     def mark_token(self, t: typing.Optional[float] = None) -> None:
-        """Stamp one token-row emission (the engine's writer thread is the
-        only caller).  The first stamp doubles as a first-token stamp for
-        engines without the in-graph TTFT callback."""
+        """Stamp one token-row emission (the engine's writer thread, or a
+        streaming sampler's callback thread).  The first stamp doubles as
+        a first-token stamp for engines without the in-graph TTFT
+        callback."""
         now = time.perf_counter() if t is None else t
-        self.token_times.append(now)
-        if self.t_first_token is None:
-            self.t_first_token = now
+        with self._lock:
+            self.token_times.append(now)
+            if self.t_first_token is None:
+                self.t_first_token = now
 
     def itl_gaps(self) -> typing.List[float]:
         """Client-visible inter-token gaps: the deltas between consecutive
         emission stamps.  One emission (or none) yields no gaps — a
         serialized non-streaming completion has no token-level cadence to
         report."""
-        ts = self.token_times
+        with self._lock:
+            ts = list(self.token_times)
         return [max(0.0, ts[i] - ts[i - 1]) for i in range(1, len(ts))]
 
     def mark_engine_done(self) -> None:
@@ -171,13 +182,19 @@ class RequestRecord:
         return self._dt(self.t_enqueued, self.t_started)
 
     def ttft_s(self):
-        return self._dt(self.t_arrival, self.t_first_token)
+        with self._lock:
+            t1 = self.t_first_token
+        return self._dt(self.t_arrival, t1)
 
     def prefill_s(self):
-        return self._dt(self.t_started, self.t_first_token)
+        with self._lock:
+            t1 = self.t_first_token
+        return self._dt(self.t_started, t1)
 
     def decode_s(self):
-        return self._dt(self.t_first_token, self.t_engine_done)
+        with self._lock:
+            t0 = self.t_first_token
+        return self._dt(t0, self.t_engine_done)
 
     def engine_s(self):
         return self._dt(self.t_started, self.t_engine_done)
@@ -197,7 +214,7 @@ class RequestRecord:
 # serves every request); the graph-side ``jax.debug.callback`` lands here on
 # the host, and this table resolves the tag back to the per-request sink.
 
-_TTFT_LOCK = threading.Lock()
+_TTFT_LOCK = make_lock("serve.slo._TTFT_LOCK")
 _TTFT_SINKS: typing.Dict[int, typing.Callable] = {}
 
 
@@ -286,7 +303,9 @@ class ServeSLO:
     def __init__(self, registry: typing.Optional[MetricsRegistry] = None):
         reg = registry if registry is not None else REGISTRY
         self.registry: MetricsRegistry = reg
-        self._lock = threading.Lock()
+        # guards the inflight count, probe attach/detach (server setup and
+        # teardown threads vs the exporter's gauge scrapes) and lane count
+        self._lock = make_lock("serve.slo.ServeSLO._lock")
         self._inflight = 0
         self.ttft = reg.histogram(
             "hbnlp_serve_ttft_seconds",
@@ -379,7 +398,8 @@ class ServeSLO:
         """Live engine-queue depth source (``InterfaceWrapper``'s queue);
         graftload samples the resulting gauge over time for its queue-depth
         trace."""
-        self._queue_probe = fn
+        with self._lock:
+            self._queue_probe = fn
 
     def clear_queue_probe(self, fn: typing.Callable[[], int]) -> None:
         """Detach ``fn`` if it is still the installed probe (a probe a
@@ -387,11 +407,15 @@ class ServeSLO:
         registry's gauge callback otherwise pins probe -> wrapper ->
         engine -> params (the full model weights) for the process
         lifetime."""
-        if self._queue_probe is fn:
-            self._queue_probe = None
+        with self._lock:
+            if self._queue_probe is fn:
+                self._queue_probe = None
 
     def queue_depth(self) -> int:
-        probe = self._queue_probe
+        # snapshot under the lock, call outside it: a probe that blocks
+        # (dying engine) must not hold up attach/detach or /metrics
+        with self._lock:
+            probe = self._queue_probe
         if probe is None:
             return 0
         try:
@@ -406,16 +430,19 @@ class ServeSLO:
         self.batch_size.observe(float(n_active))
 
     def set_kv_blocks_probe(self, fn: typing.Callable[[], int]) -> None:
-        self._kv_blocks_probe = fn
+        with self._lock:
+            self._kv_blocks_probe = fn
 
     def clear_kv_blocks_probe(self, fn: typing.Callable[[], int]) -> None:
         """Detach ``fn`` if still installed (server teardown — same
         pinning hazard as :meth:`clear_queue_probe`)."""
-        if self._kv_blocks_probe is fn:
-            self._kv_blocks_probe = None
+        with self._lock:
+            if self._kv_blocks_probe is fn:
+                self._kv_blocks_probe = None
 
     def kv_blocks_free(self) -> int:
-        probe = self._kv_blocks_probe
+        with self._lock:
+            probe = self._kv_blocks_probe
         if probe is None:
             return -1
         try:
@@ -445,16 +472,19 @@ class ServeSLO:
             self.prefill_stall.inc(float(prefill_stall_s))
 
     def set_lane_probe(self, fn: typing.Callable[[], int]) -> None:
-        self._lane_probe = fn
+        with self._lock:
+            self._lane_probe = fn
 
     def clear_lane_probe(self, fn: typing.Callable[[], int]) -> None:
         """Detach ``fn`` if still installed (server teardown — same
         pinning hazard as :meth:`clear_queue_probe`)."""
-        if self._lane_probe is fn:
-            self._lane_probe = None
+        with self._lock:
+            if self._lane_probe is fn:
+                self._lane_probe = None
 
     def lane_occupancy(self) -> int:
-        probe = self._lane_probe
+        with self._lock:
+            probe = self._lane_probe
         if probe is None:
             return -1
         try:
@@ -465,7 +495,8 @@ class ServeSLO:
     def set_lane_count(self, n: int) -> None:
         """Concurrent drain width for :meth:`retry_after_s` (the batch
         engine's ``serve_max_batch``; the serialized engine stays 1)."""
-        self._lane_count = max(1, int(n))
+        with self._lock:
+            self._lane_count = max(1, int(n))
 
     def retry_after_s(self, deadline_s: float = 0.0) -> int:
         """Whole-second Retry-After hint for a shed/timed-out request: the
@@ -487,9 +518,10 @@ class ServeSLO:
         factor."""
         p50 = self.engine.quantile(0.5)
         backlog = max(self.queue_depth(), self.inflight() - 1, 1)
+        with self._lock:
+            lanes = self._lane_count
         if p50 is not None and p50 > 0:
-            return max(1, int(math.ceil(
-                p50 * backlog / max(1, self._lane_count))))
+            return max(1, int(math.ceil(p50 * backlog / max(1, lanes))))
         return max(1, int(math.ceil(deadline_s))) if deadline_s else 1
 
     def begin(self, path: str = "") -> RequestRecord:
@@ -592,6 +624,12 @@ class ServeSLO:
                 pass
         loop_s = self.decode_loop.value()
         stall_s = self.prefill_stall.value()
+        # probe presence snapshotted under the lock, like the readers; the
+        # kv_blocks_free()/lane_occupancy() calls re-snapshot and invoke
+        # the probe OUTSIDE it (see those methods)
+        with self._lock:
+            have_kv = self._kv_blocks_probe is not None
+            have_lane = self._lane_probe is not None
         return {
             "requests_total": int(total),
             "error_rate": round(errors / total, 6) if total else None,
@@ -613,9 +651,6 @@ class ServeSLO:
             # serialized path never populates it (parity contract)
             "batch_size": (self._pcts(self.batch_size)
                            if self.batch_size.count() else None),
-            "kv_blocks_free": (self.kv_blocks_free()
-                               if self._kv_blocks_probe is not None
-                               else None),
-            "lane_occupancy": (self.lane_occupancy()
-                               if self._lane_probe is not None else None),
+            "kv_blocks_free": self.kv_blocks_free() if have_kv else None,
+            "lane_occupancy": self.lane_occupancy() if have_lane else None,
         }

@@ -1,0 +1,232 @@
+"""Declared-lock factories + the HBNLP_SYNC_RECORD runtime recorder.
+
+Every lock in the threaded host layer is created through :func:`make_lock` /
+:func:`make_rlock` / :func:`make_condition` with its DECLARED name — the
+same ``<module>.<Class>.<attr>`` identity the static concurrency model
+(``analysis/concurrency.py``) derives from the source tree.  Normally the
+factories return plain ``threading`` primitives (zero overhead); with
+``HBNLP_SYNC_RECORD=1`` in the environment (or :func:`set_recording`) they
+return recording proxies that log, per acquisition:
+
+- **ordering edges**: for every lock already held by the acquiring thread,
+  one ``held -> acquired`` edge — the runtime ground truth ``graftsync
+  --validate`` pins against the static lock-order graph;
+- **held-while-blocking** events: the acquire found the lock contended
+  while the thread already held another lock (the precondition of every
+  real deadlock);
+- **held-while-joining** events: ``Thread.join`` called with any declared
+  lock held (the classic shutdown deadlock — the joined thread may need
+  that lock to exit).
+
+Recorder tolerance (documented in docs/static_analysis.md): reentrant
+re-acquisition of the SAME lock object (RLock, Condition) records no edge,
+and two distinct instances sharing one declared name (per-request locks)
+merge onto one graph node — a self-edge ``A -> A`` is therefore dropped
+rather than reported.  Locks created BEFORE recording was enabled stay
+plain and invisible; the subprocess runs ``graftsync --validate`` drives
+set the env var so import-time module locks are covered too.
+
+Stdlib-only by contract: ``tools/supervise.py`` loads this file standalone
+(``_load_light``) so the recorder survives a broken jax install.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import typing
+
+__all__ = ["make_lock", "make_rlock", "make_condition", "recording",
+           "set_recording", "snapshot", "reset", "dump"]
+
+# internal recorder state; guarded by a PLAIN lock that is itself never
+# recorded (it would otherwise appear in every edge)
+_STATE_LOCK = threading.Lock()
+_TLS = threading.local()
+_EDGES: typing.Set[typing.Tuple[str, str]] = set()
+_BLOCKED: typing.List[dict] = []
+_JOINS: typing.List[dict] = []
+_FLAG = {"on": False}
+_ORIG_JOIN = threading.Thread.join
+
+
+def _held() -> list:
+    held = getattr(_TLS, "held", None)
+    if held is None:
+        held = _TLS.held = []
+    return held
+
+
+def _record_edge(src: str, dst: str) -> None:
+    if src == dst:
+        return  # same declared name: reentrant or sibling instance
+    with _STATE_LOCK:
+        _EDGES.add((src, dst))
+
+
+def _record_blocked(held: list, name: str) -> None:
+    with _STATE_LOCK:
+        _BLOCKED.append({"held": [n for _, n in held], "lock": name})
+
+
+def _patched_join(self, timeout=None):
+    held = getattr(_TLS, "held", None)
+    if held:
+        with _STATE_LOCK:
+            _JOINS.append({"held": [n for _, n in held],
+                           "thread": self.name})
+    return _ORIG_JOIN(self, timeout)
+
+
+class _RecordingLock:
+    """Proxy over one threading primitive that maintains the per-thread
+    held-lock stack and records ordering/blocking events.  Unknown
+    attributes delegate to the inner lock, so ``Condition`` built on a
+    proxied RLock keeps CPython's ``_is_owned``/``_release_save`` fast
+    paths (wait()'s release/re-acquire bypasses the proxy, which is fine:
+    no acquisition can happen on a thread parked in wait)."""
+
+    def __init__(self, name: str, inner):
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held()
+        reentrant = any(i == id(self) for i, _ in held)
+        if held and not reentrant:
+            for _, hname in held:
+                _record_edge(hname, self.name)
+        if blocking and held and not reentrant:
+            got = self._inner.acquire(False)
+            if not got:
+                _record_blocked(held, self.name)
+                got = (self._inner.acquire(True) if timeout == -1
+                       else self._inner.acquire(True, timeout))
+        else:
+            got = (self._inner.acquire(blocking) if timeout == -1
+                   else self._inner.acquire(blocking, timeout))
+        if got:
+            held.append((id(self), self.name))
+        return got
+
+    def release(self) -> None:
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == id(self):
+                del held[i]
+                break
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def __repr__(self) -> str:
+        return f"<RecordingLock {self.name} over {self._inner!r}>"
+
+
+def recording() -> bool:
+    return _FLAG["on"]
+
+
+def set_recording(on: bool) -> None:
+    """Toggle recording for locks created AFTER this call; also patches /
+    unpatches ``Thread.join`` for held-while-joining detection.  Already-
+    created plain locks stay plain (recorder tolerance — the subprocess
+    validate runs set ``HBNLP_SYNC_RECORD=1`` before import instead)."""
+    _FLAG["on"] = bool(on)
+    threading.Thread.join = _patched_join if on else _ORIG_JOIN
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` under its declared graph name."""
+    if _FLAG["on"]:
+        return _RecordingLock(name, threading.Lock())
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    """A ``threading.RLock`` under its declared graph name (reentrant
+    re-acquires record no edge)."""
+    if _FLAG["on"]:
+        return _RecordingLock(name, threading.RLock())
+    return threading.RLock()
+
+
+def make_condition(name: str):
+    """A ``threading.Condition`` whose underlying (reentrant) lock carries
+    the declared name, so waits/notifies flow through the same held-stack
+    accounting as plain acquisitions."""
+    if _FLAG["on"]:
+        return threading.Condition(lock=_RecordingLock(
+            name, threading.RLock()))
+    return threading.Condition()
+
+
+def snapshot() -> dict:
+    """Copy of everything recorded so far: sorted edge pairs, blocked
+    events, join events."""
+    with _STATE_LOCK:
+        return {"edges": sorted(list(e) for e in _EDGES),
+                "blocked": [dict(b) for b in _BLOCKED],
+                "joins": [dict(j) for j in _JOINS]}
+
+
+def reset() -> None:
+    with _STATE_LOCK:
+        _EDGES.clear()
+        _BLOCKED.clear()
+        _JOINS.clear()
+
+
+def dump(path: str) -> None:
+    """Append the recorded events to ``path`` as JSONL (one event per
+    line; append-mode so subprocesses sharing a record file through the
+    env var all land)."""
+    snap = snapshot()
+    lines = ([json.dumps({"kind": "edge", "src": a, "dst": b})
+              for a, b in snap["edges"]]
+             + [json.dumps({"kind": "blocked", **b})
+                for b in snap["blocked"]]
+             + [json.dumps({"kind": "join", **j}) for j in snap["joins"]])
+    if not lines:
+        return
+    with open(path, "a") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def load_records(path: str) -> typing.List[dict]:
+    """Parse a recorder JSONL file back into event dicts (torn tail lines
+    from a killed process are skipped)."""
+    out: typing.List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+if os.environ.get("HBNLP_SYNC_RECORD", "") == "1":
+    set_recording(True)
+    _record_file = os.environ.get("HBNLP_SYNC_RECORD_FILE", "")
+    if _record_file:
+        atexit.register(dump, _record_file)

@@ -1,0 +1,277 @@
+"""Concurrency-audit tests (analysis/concurrency.py + tools/graftsync.py,
+ISSUE 16): seeded regressions — an injected unguarded multi-thread write
+must fail ``sync-shared-state`` and an injected lock inversion must fail
+``sync-lock-order`` — plus recorder semantics, golden wiring through
+graftcheck, and the repo-clean assertions the CI gate relies on."""
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+from homebrewnlp_tpu import sync
+from homebrewnlp_tpu.analysis import concurrency as cc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _seed_tree(tmp_path, files):
+    """Materialize a minimal scoped tree the analyzer will walk."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+GUARDED = """\
+    import threading
+    from homebrewnlp_tpu.sync import make_lock
+
+
+    class Worker:
+        def __init__(self):
+            self._lock = make_lock("serve.victim.Worker._lock")
+            self.counter = 0
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def _run(self):
+            with self._lock:
+                self.counter += 1
+
+        def read(self):
+            with self._lock:
+                return self.counter
+"""
+
+#: same class, with the thread-side write outside the lock — THE seeded bug
+UNGUARDED = GUARDED.replace(
+    """        def _run(self):
+            with self._lock:
+                self.counter += 1
+""",
+    """        def _run(self):
+            self.counter += 1
+""")
+assert UNGUARDED != GUARDED
+
+
+def test_seeded_unguarded_write_fails_shared_state(tmp_path, monkeypatch):
+    root = _seed_tree(tmp_path, {"homebrewnlp_tpu/serve/victim.py": UNGUARDED})
+    golden = tmp_path / "shared_state.json"
+    golden.write_text("{}\n")
+    monkeypatch.setattr(cc, "sync_shared_state_golden_path",
+                        lambda: str(golden))
+    findings = cc.check_shared_state(root)
+    errs = [f for f in findings if f.severity == "error"]
+    assert errs, "injected unguarded multi-thread write not flagged"
+    assert any("Worker" in f.location and f.rule == "sync-shared-state"
+               for f in errs)
+
+
+def test_seeded_guarded_write_passes_shared_state(tmp_path, monkeypatch):
+    root = _seed_tree(tmp_path, {"homebrewnlp_tpu/serve/victim.py": GUARDED})
+    golden = tmp_path / "shared_state.json"
+    golden.write_text("{}\n")
+    monkeypatch.setattr(cc, "sync_shared_state_golden_path",
+                        lambda: str(golden))
+    assert [f for f in cc.check_shared_state(root)
+            if f.severity == "error"] == []
+
+
+INVERSION = """\
+    from homebrewnlp_tpu.sync import make_lock
+
+
+    class Pair:
+        def __init__(self):
+            self._a = make_lock("serve.inv.Pair._a")
+            self._b = make_lock("serve.inv.Pair._b")
+
+        def ab(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def ba(self):
+            with self._b:
+                with self._a:
+                    pass
+"""
+
+
+def test_seeded_lock_inversion_fails_lock_order(tmp_path, monkeypatch):
+    root = _seed_tree(tmp_path, {"homebrewnlp_tpu/serve/inv.py": INVERSION})
+    golden = tmp_path / "lock_order.json"
+    monkeypatch.setattr(cc, "sync_lock_order_golden_path",
+                        lambda: str(golden))
+    # cycle detection fires even on a fresh (just-recorded) golden: an
+    # inversion is a deadlock, not a new-edge formality
+    findings = cc.check_lock_order(root, update_goldens=True)
+    errs = [f for f in findings if f.severity == "error"]
+    assert any("cycle" in f.message for f in errs), findings
+
+
+def test_new_lock_order_edge_fails_against_pinned_golden(tmp_path,
+                                                         monkeypatch):
+    one_way = INVERSION.replace(
+        """        def ba(self):
+            with self._b:
+                with self._a:
+                    pass
+""", "")
+    assert one_way != INVERSION
+    root = _seed_tree(tmp_path, {"homebrewnlp_tpu/serve/inv.py": one_way})
+    golden = tmp_path / "lock_order.json"
+    golden.write_text(json.dumps({"edges": []}) + "\n")
+    monkeypatch.setattr(cc, "sync_lock_order_golden_path",
+                        lambda: str(golden))
+    errs = [f for f in cc.check_lock_order(root) if f.severity == "error"]
+    assert any("new lock-order edge" in f.message for f in errs)
+    # ... and re-recording then re-checking is clean
+    cc.check_lock_order(root, update_goldens=True)
+    assert [f for f in cc.check_lock_order(root)
+            if f.severity == "error"] == []
+
+
+def test_raw_threading_lock_draws_warning(tmp_path, monkeypatch):
+    root = _seed_tree(tmp_path, {"homebrewnlp_tpu/serve/raw.py": """\
+        import threading
+
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+    """})
+    model = cc.build_model(root)
+    assert any("make_lock" in f.message for f in model.warnings)
+
+
+def test_repo_is_clean():
+    """The committed tree passes both rules against its committed goldens —
+    the exact CI gate (`graftsync --check`)."""
+    findings = cc.run_sync_rules(REPO)
+    assert [f for f in findings if f.severity == "error"] == []
+
+
+def test_repo_shared_state_golden_is_empty():
+    """ISSUE 16 satellite: every true finding was FIXED, not allowlisted —
+    the ratchet golden must pin zero."""
+    with open(cc.sync_shared_state_golden_path()) as f:
+        assert json.load(f) == {}
+
+
+def test_validate_recorded_matches_static():
+    model = cc.build_model(REPO)
+    a, b = sorted(model.locks)[:2]
+    static_pairs = [(x, y) for (x, y) in model.edges]
+    # a recorded edge present in the static graph: no error
+    if static_pairs:
+        src, dst = static_pairs[0]
+        recs = [{"kind": "edge", "src": src, "dst": dst}]
+        assert [f for f in cc.validate_recorded(REPO, recs)
+                if f.severity == "error"] == []
+    # a recorded edge absent from it: error
+    recs = [{"kind": "edge", "src": a, "dst": b}]
+    if (a, b) not in model.edges:
+        assert any(f.severity == "error"
+                   for f in cc.validate_recorded(REPO, recs))
+    # an unknown lock name: error
+    recs = [{"kind": "edge", "src": "nowhere.X._lock", "dst": a}]
+    assert any("does not know" in f.message
+               for f in cc.validate_recorded(REPO, recs)
+               if f.severity == "error")
+    # held-while-joining: warning, not error
+    recs = [{"kind": "join", "held": [a], "thread": "t"}]
+    fs = cc.validate_recorded(REPO, recs)
+    assert any(f.severity == "warning" and "join" in f.message.lower()
+               for f in fs)
+    assert [f for f in fs if f.severity == "error"] == []
+
+
+# -- recorder unit tests ------------------------------------------------------
+
+@pytest.fixture
+def recorder():
+    sync.set_recording(True)
+    sync.reset()
+    try:
+        yield sync
+    finally:
+        sync.set_recording(False)
+        sync.reset()
+
+
+def test_recorder_edges_and_reentrancy(recorder):
+    a = recorder.make_lock("t.A._lock")
+    r = recorder.make_rlock("t.B._lock")
+    with a:
+        with r:
+            with r:  # reentrant: no self-edge
+                pass
+    snap = recorder.snapshot()
+    assert snap["edges"] == [["t.A._lock", "t.B._lock"]]
+
+
+def test_recorder_same_name_instances_merge(recorder):
+    """Two instances sharing a declared name (per-request locks) are one
+    graph node: nesting them records no self-edge."""
+    a1 = recorder.make_lock("t.R._lock")
+    a2 = recorder.make_lock("t.R._lock")
+    with a1:
+        with a2:
+            pass
+    assert recorder.snapshot()["edges"] == []
+
+
+def test_recorder_held_while_blocking(recorder):
+    outer = recorder.make_lock("t.Outer._lock")
+    inner = recorder.make_lock("t.Inner._lock")
+    started = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with inner:
+            started.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert started.wait(5.0)
+    with outer:
+        got = inner.acquire(False)  # contended probe path, non-blocking
+        if got:
+            inner.release()
+    release.set()
+    t.join(5.0)
+    # the edge is recorded either way; the blocked event only on contention
+    assert ["t.Outer._lock", "t.Inner._lock"] in recorder.snapshot()["edges"]
+
+
+def test_recorder_join_with_held_lock(recorder):
+    lk = recorder.make_lock("t.J._lock")
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    with lk:
+        t.join(5.0)
+    joins = recorder.snapshot()["joins"]
+    assert joins and joins[0]["held"] == ["t.J._lock"]
+
+
+def test_recorder_off_returns_plain_primitives():
+    assert sync.recording() is False
+    lk = sync.make_lock("t.off._lock")
+    assert type(lk) is type(threading.Lock())
+
+
+def test_factories_registered_in_graftcheck():
+    from homebrewnlp_tpu import analysis
+    assert "sync-shared-state" in analysis.AST_RULES
+    assert "sync-lock-order" in analysis.AST_RULES
+    fs = analysis.run_ast_rules(
+        REPO, rules=["sync-shared-state", "sync-lock-order"])
+    assert [f for f in fs if f.severity == "error"] == []

@@ -410,6 +410,13 @@ def test_rest_request_logging_counts_and_latency():
         assert post("boom") == 500
         assert post("missing") == 404
         c = reg.counter("hbnlp_serve_requests_total")
+        # the handler records the request in its `finally`, AFTER the
+        # response bytes are on the wire — the client can observe the last
+        # 404 before the server thread increments, so wait for it to land
+        deadline = time.time() + 5.0
+        while (time.time() < deadline
+               and c.value(method="POST", path="other", status="404") < 1):
+            time.sleep(0.01)
         assert c.value(method="POST", path="/encode", status="200") == 2
         assert c.value(method="POST", path="/boom", status="500") == 1
         # unmatched paths fold into the fixed "other" bucket — a scanner

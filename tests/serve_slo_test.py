@@ -495,7 +495,13 @@ def test_rest_maps_queue_deadline_to_503_with_retry_after():
         assert retry is not None and int(retry) >= 1
         body = json.loads(err.read())
         assert body["retry_after_s"] == int(retry)
-        # the rejection is a counted, phase-attributed request like any other
+        # the rejection is a counted, phase-attributed request like any
+        # other — but the record lands in the handler's `finally`, AFTER the
+        # 503 is on the wire, so wait for it before asserting
+        deadline = time.time() + 5.0
+        while (time.time() < deadline
+               and server.slo.summary()["error_rate"] is None):
+            time.sleep(0.01)
         assert server.slo.summary()["error_rate"] == 1.0
     finally:
         server.shutdown()

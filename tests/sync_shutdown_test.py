@@ -1,0 +1,150 @@
+"""Shutdown-ordering coverage under the HBNLP_SYNC_RECORD shim (ISSUE 16
+satellite): engine close, exporter teardown, feeder close and supervisor
+SIGTERM each run with every declared lock wrapped in the recording proxy,
+and must produce (a) no held-while-joining event — joining a thread while
+holding a lock it may need is the classic shutdown deadlock — and (b) no
+lock-order edge outside the static graph pinned in
+``analysis/goldens/sync/lock_order.json``."""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from backend import mixer_config  # noqa: E402
+
+from homebrewnlp_tpu import sync  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def static_edges():
+    from homebrewnlp_tpu.analysis import concurrency as cc
+    model = cc.build_model(REPO)
+    return ({f"{a} -> {b}" for a, b in model.edges}, set(model.locks))
+
+
+@pytest.fixture
+def recorder():
+    """Arm the recording shim for locks created inside the test; always
+    disarm (and unpatch ``Thread.join``) afterwards."""
+    sync.set_recording(True)
+    sync.reset()
+    try:
+        yield sync
+    finally:
+        sync.set_recording(False)
+        sync.reset()
+
+
+def _assert_clean(snap, static_edges):
+    static, known = static_edges
+    assert snap["joins"] == [], (
+        f"Thread.join with declared lock(s) held during shutdown: "
+        f"{snap['joins']}")
+    for src, dst in snap["edges"]:
+        assert src in known and dst in known, (src, dst)
+        assert f"{src} -> {dst}" in static, (
+            f"recorded lock-order edge {src} -> {dst} missing from the "
+            f"static graph — run `python tools/graftsync.py` and extend "
+            f"the analyzer (never the golden) if the order is intended")
+
+
+def test_engine_close_clean_shutdown(recorder, static_edges):
+    """close() must notify the scheduler out of its wait and join it with
+    no declared lock held; the admit path's nested _cv -> allocator
+    acquisition must match the pinned order."""
+    from homebrewnlp_tpu.models import init_params
+    from homebrewnlp_tpu.serve.engine import BatchEngine
+    from homebrewnlp_tpu.utils import random_text_batch
+    cfg = mixer_config(depth=1, sequence_length=12, heads=2,
+                       features_per_head=16, vocab_size=32,
+                       train_batch_size=1, sampling_temperature=0.0,
+                       use_autoregressive_sampling=True, serve_max_batch=2)
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    eng = BatchEngine(cfg, params)
+    out = eng.complete_tokens([1, 2, 3], 0.0, 4)
+    assert len(out) >= 1
+    eng.close()
+    _assert_clean(recorder.snapshot(), static_edges)
+
+
+def test_feeder_close_clean_shutdown(recorder, static_edges, tmp_path,
+                                     eight_devices):
+    from homebrewnlp_tpu.data import GptPipeline, write_text_tfrecords
+    from homebrewnlp_tpu.data.feed import DeviceFeeder
+    from homebrewnlp_tpu.parallel import make_mesh
+    cfg = mixer_config(interleaved_datasets=1)
+    paths = write_text_tfrecords(str(tmp_path), 2, 2, 100, seed=7)
+    mesh = make_mesh(cfg)
+    feeder = DeviceFeeder(iter(GptPipeline(cfg, 2, paths=paths)), cfg, mesh,
+                          depth=2)
+    next(feeder)
+    feeder.close()  # joins the producer: must hold nothing while waiting
+    _assert_clean(recorder.snapshot(), static_edges)
+
+
+def test_exporter_teardown_clean_shutdown(recorder, static_edges, tmp_path):
+    """stop_server joins the serving thread and Watchdog.stop joins the
+    poller — both while the freshly recorded Health/registry locks are
+    live."""
+    import socket
+
+    from homebrewnlp_tpu.obs import (Health, MetricsRegistry, Watchdog,
+                                     start_server, stop_server)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    reg = MetricsRegistry()
+    health = Health()
+    health.step_completed(1)
+    server = start_server(port, registry=reg, health=health)
+    wd = Watchdog(health, str(tmp_path), poll_s=0.02)
+    wd.start()
+    time.sleep(0.1)
+    wd.stop()
+    stop_server(server)
+    _assert_clean(recorder.snapshot(), static_edges)
+
+
+def test_supervisor_sigterm_clean_shutdown(recorder, static_edges):
+    """The fleet watcher's terminate() crosses threads into the launcher:
+    the Popen-handle lock must be released before any signalling/waiting,
+    and the launch thread join happens lock-free."""
+    from tools.supervise import SubprocessLauncher
+    launcher = SubprocessLauncher(
+        [sys.executable, "-c", "import time; time.sleep(30)"])
+    rc = []
+    t = threading.Thread(target=lambda: rc.append(launcher()))
+    t.start()
+    deadline = time.time() + 10.0
+    while time.time() < deadline and not launcher.terminate():
+        time.sleep(0.02)
+    t.join(timeout=15.0)
+    assert not t.is_alive()
+    assert rc and rc[0] == -signal.SIGTERM
+    _assert_clean(recorder.snapshot(), static_edges)
+
+
+def test_record_file_dump_round_trip(recorder, tmp_path):
+    """The subprocess contract graftsync --validate relies on: events dump
+    as appendable JSONL and load back losslessly."""
+    a = recorder.make_lock("x.A._lock")
+    b = recorder.make_lock("x.B._lock")
+    with a:
+        with b:
+            pass
+    path = str(tmp_path / "rec.jsonl")
+    recorder.dump(path)
+    recorder.dump(path)  # append-mode: a second process would land too
+    recs = sync.load_records(path)
+    assert {"kind": "edge", "src": "x.A._lock", "dst": "x.B._lock"} in recs
+    assert len([r for r in recs if r["kind"] == "edge"]) == 2

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""graftsync: the concurrency sheet — shared state and lock order, statically.
+
+Builds the declared-lock model of the threaded host layer
+(``analysis/concurrency.py``): every lock created through the
+``homebrewnlp_tpu.sync`` factories under its ``<module>.<Class>.<attr>``
+name, every attribute reachable from more than one thread identity, and the
+lock-acquisition-order graph (nested ``with`` scopes plus calls into
+lock-taking methods while holding).  Unguarded multi-thread writes are
+ratcheted findings (``analysis/goldens/sync/shared_state.json`` — the count
+may only go down); the order graph is pinned edge-for-edge
+(``lock_order.json``) and cycle-checked.
+
+``--validate`` is the honesty check: the serving/observability/data test
+suites run in subprocesses with ``HBNLP_SYNC_RECORD=1``, which swaps every
+declared lock for a recording proxy logging real ``held -> acquired`` edges
+and held-while-blocking/joining events; every recorded edge must appear in
+the static graph, or the model under-approximates reality.
+
+Usage:
+  python tools/graftsync.py                       # sheet
+  python tools/graftsync.py --check               # CI gate (ratchet+golden)
+  python tools/graftsync.py --update-goldens
+  python tools/graftsync.py --validate            # runtime honesty check
+  python tools/graftsync.py --json
+
+Exit code: 0 ok; 1 when --check/--validate find errors; 2 on usage errors.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the analyzer is pure-AST, but the recorded suites need the same pinned
+# host platform as every other graft* tool so they run device-free
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8").strip()
+
+#: suites whose threads exercise the declared locks (engine scheduler +
+#: streams, SLO probes, exporter/watchdog, fleet reporter, feeder)
+VALIDATE_SUITES = ("serve_engine_test.py", "serve_slo_test.py",
+                   "serve_stream_test.py", "obs_test.py",
+                   "fleet_obs_test.py", "data_test.py")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--check", action="store_true",
+                   help="run the ratcheted shared-state rule and the pinned "
+                        "lock-order golden; exit 1 on errors")
+    p.add_argument("--update-goldens", action="store_true",
+                   help="re-record analysis/goldens/sync/*.json")
+    p.add_argument("--validate", action="store_true",
+                   help="run the serving/obs/data suites under "
+                        "HBNLP_SYNC_RECORD=1 and assert every recorded "
+                        "lock-order edge appears in the static graph")
+    p.add_argument("--suite", action="append", default=[],
+                   help="override the --validate suite list (repeatable, "
+                        "paths relative to tests/)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    return p.parse_args(argv)
+
+
+def sheet(model, as_json: bool) -> dict:
+    from homebrewnlp_tpu.analysis import concurrency as cc
+    report = cc.shared_state_report(model)
+    edges = {f"{a} -> {b}": sorted(locs)
+             for (a, b), locs in model.edges.items()}
+    cycles = cc._find_cycles(model.edges)
+    out = {"locks": {lid: lk.kind for lid, lk in sorted(model.locks.items())},
+           "edges": sorted(edges),
+           "cycles": [list(c) for c in cycles],
+           "unguarded": report,
+           "warnings": [f.message for f in model.warnings]}
+    if not as_json:
+        print(f"\n== declared locks ({len(model.locks)})")
+        for lid, kind in sorted(out["locks"].items()):
+            print(f"  {kind:9s} {lid}")
+        print(f"\n== lock-order edges ({len(edges)})")
+        for e in sorted(edges):
+            print(f"  {e}   [{edges[e][0]}]")
+        if not edges:
+            print("  (no nested acquisitions)")
+        for cyc in cycles:
+            print(f"  CYCLE: {' -> '.join(cyc)} -> {cyc[0]}")
+        print(f"\n== unguarded multi-thread state ({len(report)})")
+        for r in report:
+            sites = ", ".join(f"{s['file']}:{s['line']}" for s in r["sites"])
+            print(f"  {r['class']}.{r['attr']} (lock {r['lock'] or 'NONE'}) "
+                  f"at {sites}")
+        if not report:
+            print("  (every shared attribute is guarded)")
+        for w in out["warnings"]:
+            print(f"  WARN {w}")
+    return out
+
+
+def run_validate(suites, as_json: bool):
+    """Drive the threaded suites with the recording shim armed, then pin
+    the recorded edges against the static graph."""
+    from homebrewnlp_tpu.analysis import concurrency as cc
+    from homebrewnlp_tpu.sync import load_records
+    fd, record_file = tempfile.mkstemp(prefix="graftsync_", suffix=".jsonl")
+    os.close(fd)
+    suite_results = []
+    try:
+        for suite in suites:
+            path = os.path.join(REPO, "tests", suite)
+            if not os.path.exists(path):
+                suite_results.append({"suite": suite, "rc": None,
+                                      "error": "missing"})
+                continue
+            env = dict(os.environ, HBNLP_SYNC_RECORD="1",
+                       HBNLP_SYNC_RECORD_FILE=record_file)
+            t1 = time.time()
+            r = subprocess.run(
+                [sys.executable, "-m", "pytest", path, "-q", "-x",
+                 "-m", "not slow", "-p", "no:cacheprovider",
+                 "-p", "no:xdist", "-p", "no:randomly"],
+                cwd=REPO, env=env, capture_output=True, text=True)
+            suite_results.append({"suite": suite, "rc": r.returncode,
+                                  "seconds": round(time.time() - t1, 1),
+                                  "tail": r.stdout.strip().splitlines()[-1:]})
+            if not as_json:
+                tail = (r.stdout.strip().splitlines() or ["(no output)"])[-1]
+                print(f"[graftsync] {suite}: rc={r.returncode} "
+                      f"({time.time() - t1:.1f}s) {tail}", file=sys.stderr)
+        records = load_records(record_file)
+    finally:
+        try:
+            os.unlink(record_file)
+        except OSError:
+            pass
+    findings = cc.validate_recorded(REPO, records)
+    return findings, suite_results, records
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from homebrewnlp_tpu.analysis import concurrency as cc
+    rc = 0
+    t0 = time.time()
+    model = cc.build_model(REPO)
+    out = sheet(model, args.as_json)
+    findings = []
+    if args.check or args.update_goldens:
+        findings += cc.run_sync_rules(REPO,
+                                      update_goldens=args.update_goldens)
+    if args.validate:
+        vfind, suite_results, records = run_validate(
+            args.suite or VALIDATE_SUITES, args.as_json)
+        findings += vfind
+        out["validate"] = {
+            "suites": suite_results,
+            "recorded_events": len(records)}
+        for s in suite_results:
+            if s["rc"] not in (0,):  # a failing suite means nothing ran
+                rc = max(rc, 1)
+    out["findings"] = [{"rule": f.rule, "severity": f.severity,
+                        "location": f.location, "message": f.message}
+                       for f in findings]
+    if any(f.severity == "error" for f in findings):
+        rc = max(rc, 1)
+    if args.as_json:
+        print(json.dumps(out, indent=2))
+    else:
+        for f in findings:
+            print(f"  {f.severity.upper():7s} [{f.rule}] {f.message}")
+        print(f"\n[graftsync] total {time.time() - t0:.1f}s -> exit {rc}",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

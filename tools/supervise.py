@@ -78,6 +78,14 @@ def _load_light(name: str, relpath: str):
     return mod
 
 
+# declared-lock factories (stdlib-only by contract): loaded FIRST and
+# published under the light alias so the standalone registry/fleet loads
+# below can find the recorder through sys.modules — the supervisor's own
+# locks then show up in HBNLP_SYNC_RECORD runs like everyone else's
+_sync = _load_light("hbnlp_sync", "homebrewnlp_tpu/sync.py")
+sys.modules.setdefault("hbnlp_sync", _sync)
+make_lock = _sync.make_lock
+
 _registry = _load_light("hbnlp_obs_registry",
                         "homebrewnlp_tpu/obs/registry.py")
 MetricsRegistry = _registry.MetricsRegistry
@@ -206,6 +214,11 @@ class FleetCoordinator:
         all_gens = [g for gens in self._scan().values() for g in gens]
         all_gens += [g for gens in self._scan(re_=_READY_FILE_RE).values()
                      for g in gens]
+        # the generation counter is read by the FleetWatcher thread (its
+        # peer_down polls) and the federation's /healthz callback while the
+        # main loop advances it — all cross-thread reads go through
+        # current_generation()
+        self._lock = make_lock("tools.supervise.FleetCoordinator._lock")
         self.generation = (max(all_gens) + 1) if all_gens else 0
         #: ranks that missed a barrier entirely (no posting, no tombstone —
         #: host vanished): later barriers skip them until they post again,
@@ -247,12 +260,16 @@ class FleetCoordinator:
             out.setdefault(r, {})[g] = rc
         return out
 
+    def current_generation(self) -> int:
+        with self._lock:
+            return self.generation
+
     def peer_down(self) -> typing.Optional[int]:
         """Rank of a peer whose FAILED exit is posted for the current
         generation (its child is down while ours still runs), else None.
         Clean exits (rc 0) never trigger termination: a rank finishing the
         run slightly earlier than us must not cut our final steps short."""
-        for r, gens in self._scan(self.generation).items():
+        for r, gens in self._scan(self.current_generation()).items():
             if r == self.rank:
                 continue
             if any(rc != 0 for rc in gens.values()):
@@ -288,7 +305,8 @@ class FleetCoordinator:
                 time.sleep(0.2 * (attempt + 1))
 
     def post_exit(self, rc: int) -> None:
-        self._write_json(f"exit_r{self.rank}_g{self.generation}.json",
+        self._write_json(f"exit_r{self.rank}_g{self.current_generation()}"
+                         f".json",
                          {"rc": int(rc), "wall_time": time.time()})
 
     def post_ready(self, rc: int) -> None:
@@ -297,7 +315,8 @@ class FleetCoordinator:
         immediately so watchers react, but a rank sleeping a long crash
         backoff must keep holding its peers — releasing them early would
         burn their dist-init deadlines against an absent coordinator)."""
-        self._write_json(f"ready_r{self.rank}_g{self.generation}.json",
+        self._write_json(f"ready_r{self.rank}_g{self.current_generation()}"
+                         f".json",
                          {"rc": int(rc), "wall_time": time.time()})
 
     def post_final(self, rc: int) -> None:
@@ -307,7 +326,8 @@ class FleetCoordinator:
         relaunch would pay the full peer timeout waiting for a rank whose
         supervisor no longer exists."""
         self._write_json(f"final_r{self.rank}.json",
-                         {"rc": int(rc), "generation": self.generation,
+                         {"rc": int(rc),
+                          "generation": self.current_generation(),
                           "wall_time": time.time()})
 
     def _final_ranks(self) -> typing.Dict[int, int]:
@@ -342,6 +362,7 @@ class FleetCoordinator:
         must not tax every later relaunch with the full timeout."""
         deadline = time.monotonic() + self.peer_timeout_s
         want = set(range(self.world_size))
+        gen = self.current_generation()
         while True:
             for r, rc in self._final_ranks().items():
                 if r in want and r != self.rank:
@@ -352,8 +373,7 @@ class FleetCoordinator:
                              "rc %d); not holding the barrier for it", r, rc)
                     want.discard(r)
             seen: typing.Dict[int, int] = {}
-            for r, gens in self._scan(self.generation,
-                                      re_=_READY_FILE_RE).items():
+            for r, gens in self._scan(gen, re_=_READY_FILE_RE).items():
                 seen[r] = gens[max(gens)]
             self._absent -= set(seen)  # a vanished rank posting is back
             if want - self._absent <= set(seen):
@@ -369,16 +389,18 @@ class FleetCoordinator:
                     "fleets resume via checkpoint resharding; coordinator-"
                     "mode fleets need a restart with the new --world-size "
                     "— docs/reliability.md)",
-                    self.generation, self.peer_timeout_s, missing)
+                    gen, self.peer_timeout_s, missing)
                 return seen
             time.sleep(self.poll_s)
 
     def advance(self) -> None:
-        self.generation += 1
+        with self._lock:
+            self.generation += 1
+            gen = self.generation
         # prune OUR superseded postings (keep the previous generation —
         # peers may still be reading it): bounds the directory listing the
         # watcher polls several times a second for the run's whole lifetime
-        for g in range(max(0, self.generation - 8), self.generation - 1):
+        for g in range(max(0, gen - 8), gen - 1):
             for fn in (f"exit_r{self.rank}_g{g}.json",
                        f"ready_r{self.rank}_g{g}.json"):
                 try:
@@ -409,7 +431,8 @@ class FleetWatcher:
                 LOG.warning(
                     "peer rank %d posted an exit for generation %d while "
                     "our child still runs; terminating the child for the "
-                    "lockstep fleet relaunch", r, self.fleet.generation)
+                    "lockstep fleet relaunch", r,
+                    self.fleet.current_generation())
                 fired = True
             # retry ONLY until one signal is delivered to a live child:
             # the first poll can race the launcher (Popen not started yet
@@ -438,6 +461,9 @@ class SubprocessLauncher:
                  env: typing.Optional[dict] = None):
         self.cmd = list(cmd)
         self.env = env
+        # the launcher runs on the supervisor's thread; terminate() is
+        # called from the fleet watcher — the Popen handle crosses threads
+        self._lock = make_lock("tools.supervise.SubprocessLauncher._lock")
         self._proc: typing.Optional[subprocess.Popen] = None
 
     def __call__(self, extra_env: typing.Optional[dict] = None) -> int:
@@ -447,18 +473,22 @@ class SubprocessLauncher:
         env = self.env
         if extra_env:
             env = dict(env if env is not None else os.environ, **extra_env)
-        self._proc = subprocess.Popen(self.cmd, env=env)
+        proc = subprocess.Popen(self.cmd, env=env)
+        with self._lock:
+            self._proc = proc
         try:
-            return self._proc.wait()
+            return proc.wait()
         finally:
-            self._proc = None
+            with self._lock:
+                self._proc = None
 
     def terminate(self) -> bool:
         """SIGTERM the child if it is running; True when the signal was
         actually delivered (the watcher retries until then, and must stop
         after — a second SIGTERM escalates the child's grace shutdown to
         the forced no-checkpoint exit)."""
-        p = self._proc
+        with self._lock:
+            p = self._proc
         if p is not None and p.poll() is None:
             try:
                 p.send_signal(signal.SIGTERM)
@@ -529,6 +559,9 @@ class Supervisor:
         # progress — a restart loop reads as goodput -> 0 on the same
         # dashboard that shows the child's MFU
         self._t0 = self.clock()
+        # written by run() on the supervisor thread, read by the metrics
+        # server's scrape thread through the gauge callables below
+        self._lock = make_lock("tools.supervise.Supervisor._lock")
         self._productive_s = 0.0
         self.registry.gauge(
             "hbnlp_supervisor_wall_seconds",
@@ -539,7 +572,7 @@ class Supervisor:
             "hbnlp_supervisor_productive_seconds",
             "wall seconds inside launch segments that advanced on-disk "
             "progress", labelnames=("rank",)).labels(
-            rank=self.rank).set_function(lambda: self._productive_s)
+            rank=self.rank).set_function(self.productive_seconds)
         self.registry.gauge(
             "hbnlp_supervisor_goodput",
             "productive seconds / wall seconds across all relaunches",
@@ -547,9 +580,13 @@ class Supervisor:
             self.goodput)
         self.restarts = 0
 
+    def productive_seconds(self) -> float:
+        with self._lock:
+            return self._productive_s
+
     def goodput(self) -> float:
         wall = self.clock() - self._t0
-        return self._productive_s / wall if wall > 0 else 0.0
+        return self.productive_seconds() / wall if wall > 0 else 0.0
 
     def write_metrics(self) -> None:
         """Render the supervisor's registry to ``metrics_path`` (after every
@@ -601,7 +638,8 @@ class Supervisor:
         peers = self.fleet.await_peers()
         others = {r: c for r, c in peers.items() if r != self.fleet.rank}
         LOG.info("fleet generation %d complete: own exit %d, peers %s",
-                 self.fleet.generation, rc, others or "(none posted)")
+                 self.fleet.current_generation(), rc,
+                 others or "(none posted)")
         if len(peers) < self.fleet.world_size and self.suggest_mesh is not None:
             # DEGRADED relaunch: some rank never posted readiness — consult
             # the mesh searcher for the shrunken world before relaunching,
@@ -630,7 +668,8 @@ class Supervisor:
             advanced = now > last
             last = max(last, now)
             if advanced:
-                self._productive_s += segment_s
+                with self._lock:
+                    self._productive_s += segment_s
             if rc == 0:
                 LOG.info("training completed cleanly at %s "
                          "(%d restart(s), goodput %.3f)", last,
@@ -848,7 +887,7 @@ def main(argv=None) -> int:
         # per-launch: the child's /healthz identity block, run-start
         # marker, and step posts name the generation that launched it
         return launcher(extra_env={
-            fleet_obs.ENV_FLEET_GENERATION: str(fleet.generation)})
+            fleet_obs.ENV_FLEET_GENERATION: str(fleet.current_generation())})
 
     launcher = SubprocessLauncher(args.command, env=env)
     sup = Supervisor(
@@ -878,7 +917,7 @@ def main(argv=None) -> int:
             identity_doc={"rank": args.rank,
                           "world_size": args.world_size,
                           "coordinator": args.coordinator},
-            generation=lambda: fleet.generation)
+            generation=fleet.current_generation)
         try:
             server = fleet_obs.serve_federation(args.obs_port, federation)
         except OSError as e:
