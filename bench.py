@@ -181,6 +181,14 @@ SERVE_RESPONSE_LEN = int(os.environ.get("HBNLP_BENCH_SERVE_RESPONSE_LEN",
 #: (docs/observability.md "Continuous batching"); 1 = the pre-engine
 #: serialized path (what the committed baselines were measured under)
 SERVE_MAX_BATCH = int(os.environ.get("HBNLP_BENCH_SERVE_MAX_BATCH", "4"))
+#: chunked-prefill A/B probe: when > 0, the serving row runs two extra
+#: contained closed-loop drives over a mixed-length corpus — one with
+#: serve_prefill_chunk_tokens=0 (monolithic admission prefill on the
+#: decode thread) and one at this chunk size — and records itl_p95 +
+#: prefill_stall_fraction for both arms under row["chunked_prefill"].
+#: Deliberately NOT part of SERVE_SHAPE_OVERRIDDEN: the probe never
+#: touches the main drive, so its presence must not skip the ratchet.
+SERVE_CHUNK_TOKENS = int(os.environ.get("HBNLP_BENCH_SERVE_CHUNK", "0"))
 
 # Peak table + MFU arithmetic shared with the LIVE utilization accounting
 # (homebrewnlp_tpu/train/flops.py): bench's offline mfu and the run's
@@ -843,6 +851,56 @@ def bench_serving() -> dict:
         shutil.rmtree(aot_dir, ignore_errors=True)
 
 
+def _serve_chunk_arm(params, chunk_tokens: int) -> dict:
+    """One arm of the chunked-prefill A/B probe: fresh engine + server at
+    ``serve_prefill_chunk_tokens=chunk_tokens`` driven closed-loop over a
+    MIXED-length corpus (graftload --long-frac/--long-len) so long-prompt
+    admissions land while short requests are mid-decode — the workload the
+    decode-stall exists on.  No AOT dir: both arms pay their own compile,
+    keeping donation identical to production.  Returns the figures the
+    ratchet compares (goodput, itl_p95, prefill_stall_fraction)."""
+    import graftload
+
+    from homebrewnlp_tpu.obs.registry import MetricsRegistry
+    from homebrewnlp_tpu.serve import RestAPI, serve
+    from homebrewnlp_tpu.utils import load_config
+
+    cfg = load_config(f"configs/{SERVE_CONFIG}.json", **_COMMON,
+                      train_batch_size=1, serve_max_batch=SERVE_MAX_BATCH,
+                      serve_prefill_chunk_tokens=chunk_tokens)
+    reg = MetricsRegistry()
+    api = RestAPI(cfg, params)
+    server = serve(cfg, None, port=0, background=True, registry=reg,
+                   obs_port=0, api=api)
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}"
+        murl = f"http://127.0.0.1:{server._obs_server.server_address[1]}"
+        api.wrapper.complete([1, 2, 3], 0.0, SERVE_RESPONSE_LEN)
+        # long prompts fill most of the context window minus the response;
+        # short ones keep decode lanes busy underneath the long admissions
+        long_len = max(8, cfg.sequence_length - SERVE_RESPONSE_LEN)
+        report = graftload.drive(
+            url, metrics_url=murl, n_requests=SERVE_REQUESTS,
+            concurrency=max(8, SERVE_CONCURRENCY), vocab=cfg.vocab_size,
+            min_prompt=4,
+            max_prompt=max(4, min(16, cfg.sequence_length // 4)),
+            long_frac=0.25, long_len=long_len,
+            response_len=SERVE_RESPONSE_LEN, seed=5)
+    finally:
+        server.shutdown()
+        server.server_close()
+        api.wrapper.close()
+    c = report.get("client") or {}
+    srv = report.get("server") or {}
+    arm = {"goodput_tok_s": c.get("goodput_tok_s"),
+           "error_rate": c.get("error_rate")}
+    if isinstance(srv, dict) and "error" not in srv:
+        itl = srv.get("itl_s")
+        arm["itl_p95"] = itl.get("p95") if isinstance(itl, dict) else None
+        arm["prefill_stall_fraction"] = srv.get("prefill_stall_fraction")
+    return arm
+
+
 def _bench_serving_inner(aot_dir: str, t0: float) -> dict:
     import graftload
 
@@ -945,6 +1003,20 @@ def _bench_serving_inner(aot_dir: str, t0: float) -> dict:
             # gate, and a failed warm-start probe must not sink a row whose
             # core serving figures are healthy
             cold["warm_probe_error"] = f"{type(e).__name__}: {e}"[:200]
+    chunk_probe: dict = {}
+    if SERVE_CHUNK_TOKENS > 0 and SERVE_MAX_BATCH > 1:
+        # chunked-prefill A/B (contained): same model, same mixed-length
+        # corpus, chunking off vs on.  Off measures the real decode stall
+        # (the blocking admission prefill the PR-14 ruler prices); on must
+        # cut the stall fraction without regressing itl_p95 — the ratchet
+        # in evaluate_serve_baseline enforces exactly that once recorded
+        try:
+            chunk_probe["chunked_prefill"] = {
+                "chunk_tokens": SERVE_CHUNK_TOKENS,
+                "off": _serve_chunk_arm(params, 0),
+                "on": _serve_chunk_arm(params, SERVE_CHUNK_TOKENS)}
+        except Exception as e:  # noqa: BLE001 - probe failure, row survives
+            chunk_probe["chunk_probe_error"] = f"{type(e).__name__}: {e}"[:200]
     c = report["client"]
     e2e = c.get("e2e_s") or {}
     row = {
@@ -967,6 +1039,7 @@ def _bench_serving_inner(aot_dir: str, t0: float) -> dict:
     }
     row.update(cold)
     row.update(stream_probe)
+    row.update(chunk_probe)
     srv = report.get("server") or {}
     if isinstance(srv, dict) and "error" not in srv:
         for key, out_key in (("ttft_s", "ttft"), ("queue_wait_s",
@@ -1048,6 +1121,29 @@ def evaluate_serve_baseline(row: dict, baseline: dict,
         out["prefill_stall_fraction"] = {
             "baseline": base_frac, "value": frac,
             "limit": round(limit, 4), "pass": passed}
+        ok = ok and passed
+    # chunked-prefill ratchet (chunked prefill PR): once a baseline has
+    # recorded the A/B probe's ON arm, a later round's ON arm may not
+    # regress it — the stall fraction gets the same ratio + 0.05 absolute
+    # slack as the main stall gate, and itl_p95 gates like the other
+    # latencies (chunk interleave must stay off the decode critical path)
+    on = (row.get("chunked_prefill") or {}).get("on") or {}
+    base_on = (baseline.get("chunked_prefill") or {}).get("on") or {}
+    c_frac = on.get("prefill_stall_fraction")
+    b_frac = base_on.get("prefill_stall_fraction")
+    if isinstance(c_frac, (int, float)) and isinstance(b_frac, (int, float)):
+        limit = b_frac * max_latency_ratio + 0.05
+        passed = bool(c_frac <= limit)
+        out["chunked_stall_fraction"] = {
+            "baseline": b_frac, "value": c_frac,
+            "limit": round(limit, 4), "pass": passed}
+        ok = ok and passed
+    c_itl, b_itl = on.get("itl_p95"), base_on.get("itl_p95")
+    if isinstance(c_itl, (int, float)) and b_itl:
+        ratio = c_itl / b_itl
+        passed = bool(ratio <= max_latency_ratio)
+        out["chunked_itl_p95"] = {"baseline_s": b_itl,
+                                  "ratio": round(ratio, 3), "pass": passed}
         ok = ok and passed
     return (out or None), ok
 
@@ -1242,8 +1338,22 @@ def main() -> None:
                     "prefill_stall_fraction": srow.get(
                         "prefill_stall_fraction"),
                     "stream_ttft_s": srow.get("stream_ttft_s"),
+                    # chunked-prefill A/B figures (chunked prefill PR),
+                    # present only when HBNLP_BENCH_SERVE_CHUNK ran the probe
+                    "chunked_prefill": srow.get("chunked_prefill"),
                     "shape": shape,
                     "recorded": time.time()})
+                with open(SERVE_BASELINE_FILE, "w") as f:
+                    json.dump(serve_baselines, f, indent=2, sort_keys=True)
+                    f.write("\n")
+            elif (dev_serve and not SERVE_SHAPE_OVERRIDDEN
+                    and isinstance(srow.get("chunked_prefill"), dict)
+                    and not dev_serve.get("chunked_prefill")
+                    and dev_serve.get("shape", shape) == shape):
+                # the A/B probe self-records into an EXISTING baseline the
+                # first time HBNLP_BENCH_SERVE_CHUNK runs at the default
+                # shape, so the next round ratchets the ON arm
+                dev_serve["chunked_prefill"] = srow["chunked_prefill"]
                 with open(SERVE_BASELINE_FILE, "w") as f:
                     json.dump(serve_baselines, f, indent=2, sort_keys=True)
                     f.write("\n")

@@ -7,7 +7,7 @@ module predicts *how much*: per-device HBM, bytes per mesh axis, and whether
 a workload is compute-, bandwidth-, or interconnect-bound, all from the
 abstract traces, in seconds, on a CPU.
 
-Per config x step (train / decode / prefill):
+Per config x step (train / decode / prefill / prefill_chunk):
 
 - **peak HBM per device** (analysis/memory.py): exact param + optimizer-slot
   bytes under the intended-mesh sharding, the input batch, KV-cache bytes
@@ -302,7 +302,7 @@ def step_resources(traces: ConfigTraces, step: str, st: StepTrace, imesh,
         hbm["batch"] = b
         scaled["batch"] = b_scaled
     kv = 0
-    if step in ("decode", "prefill"):
+    if step in ("decode", "prefill", "prefill_chunk"):
         try:
             kv, kv_scaled = _kv_bytes(traces, imesh)
             scaled["kv_cache"] = kv_scaled
@@ -317,7 +317,7 @@ def step_resources(traces: ConfigTraces, step: str, st: StepTrace, imesh,
     # scaling) by the kv_cache term above, so counting them again as
     # liveness outputs would double the KV term and halve the sweep's
     # predicted max prompt length.
-    if step == "prefill":
+    if step in ("prefill", "prefill_chunk"):
         inner = st.jaxpr.jaxpr if hasattr(st.jaxpr, "jaxpr") else st.jaxpr
         live = liveness_peak(st.jaxpr, exclude_output_indices=set(
             range(1, len(inner.outvars))))
@@ -331,7 +331,7 @@ def step_resources(traces: ConfigTraces, step: str, st: StepTrace, imesh,
         act += b
         act_scaled.append(classify_shape(getattr(aval, "shape", ()), b, cfg))
     hbm["activation_peak"] = int(act)
-    if step in ("decode", "prefill"):
+    if step in ("decode", "prefill", "prefill_chunk"):
         # the decode/prefill traces run a batch of ONE (a batch dim of 1 is
         # invisible to shape classification), but every serving buffer is
         # per-request: impose linear batch scaling so the sweep can answer

@@ -309,9 +309,10 @@ def _check_serve_donation(traces: ConfigTraces) -> typing.List[Finding]:
     # above, so serve_max_batch > 1 here)
     n_lanes = int(cfg.serve_max_batch)
     try:
-        dec_jit, pre_jit = engine.jit_executables(cfg, rows, n_lanes)
-        dec_abs, pre_abs = engine.abstract_exec_args(cfg, params, rows,
-                                                     n_lanes)
+        dec_jit, pre_jit, chk_jit = engine.jit_executables(cfg, rows,
+                                                           n_lanes)
+        dec_abs, pre_abs, chk_abs = engine.abstract_exec_args(cfg, params,
+                                                              rows, n_lanes)
         with trace_compat():
             audits = (("decode", dec_jit.trace(*dec_abs),
                        engine.DECODE_DONATE_ARGNUMS,
@@ -319,6 +320,13 @@ def _check_serve_donation(traces: ConfigTraces) -> typing.List[Finding]:
                       ("prefill", pre_jit.trace(*pre_abs),
                        engine.PREFILL_DONATE_ARGNUMS,
                        engine.PREFILL_DONATE_ARG_NAMES))
+            if chk_jit is not None and chk_abs is not None:
+                # serve_prefill_chunk_tokens > 0: the chunk executable
+                # carries the same pooled state — audit it too (knob off
+                # keeps the audit, and the census goldens, byte-stable)
+                audits += (("prefill_chunk", chk_jit.trace(*chk_abs),
+                            engine.PREFILL_CHUNK_DONATE_ARGNUMS,
+                            engine.PREFILL_CHUNK_DONATE_ARG_NAMES),)
     except Exception as e:
         return findings + [Finding(
             "donation", "warning", _loc(traces, "serve"),

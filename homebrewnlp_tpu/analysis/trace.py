@@ -340,6 +340,48 @@ def trace_prefill(cfg: Config, params, mesh=None, axes=None) -> StepTrace:
                      in_axes=_check_in_axes(jaxpr, in_axes))
 
 
+def trace_prefill_chunk(cfg: Config, params, mesh=None,
+                        axes=None) -> StepTrace:
+    """Trace ONE chunk-granular prefill forward: ``serve_prefill_chunk_rows``
+    rows at a scalar running position against a populated cache — the
+    executable the chunked admission path dispatches between decode steps
+    (serve/engine.py::prefill_chunk_body).  Priced as its own step so the
+    resource-budget audit stays honest when ``serve_prefill_chunk_tokens``
+    is on: chunk activation peak scales with the chunk, not the prompt."""
+    from ..infer.kv_cache import _decode_logits
+    from ..serve.engine import prefill_chunk_rows
+    mesh = make_mesh(cfg) if mesh is None else mesh
+    names = ("batch", "sequence", "language_token_patch")
+    seq = cfg.sequence_length // cfg.token_patch_size
+    n_rows = prefill_chunk_rows(cfg)
+    if n_rows <= 0:
+        raise ValueError("trace_prefill_chunk needs "
+                         "serve_prefill_chunk_tokens > 0")
+    chunk = jax.ShapeDtypeStruct((1, n_rows, cfg.token_patch_size), jnp.int32)
+    if cfg.pipeline_parallel > 1 and pipeline_params_stacked(cfg, params):
+        from ..models import unstack_pipeline_params
+        params = jax.eval_shape(
+            lambda p: unstack_pipeline_params(cfg, p), params)
+
+    def probe(p):
+        return _decode_logits(
+            cfg, p, jnp.zeros((1, 1, cfg.token_patch_size), jnp.int32),
+            jnp.int32(0), {}, seq, names)[1]
+
+    with trace_compat():
+        caches = jax.eval_shape(probe, params)
+
+        def chunk_step(p, t, c):
+            return _decode_logits(cfg, p, t, jnp.int32(0), c, seq, names)
+
+        jaxpr = jax.make_jaxpr(chunk_step)(
+            params, jnp.zeros(chunk.shape, chunk.dtype), caches)
+    in_axes = (_param_in_axes(params, axes or {}) + [tuple(names)]
+               + [None] * len(jax.tree_util.tree_leaves(caches)))
+    return StepTrace("prefill_chunk", jaxpr, mesh,
+                     in_axes=_check_in_axes(jaxpr, in_axes))
+
+
 def trace_decode(cfg: Config, params, mesh=None, axes=None) -> StepTrace:
     """Trace ONE incremental KV-cached decode step (the serving hot path)."""
     from ..infer.kv_cache import _decode_logits
@@ -412,6 +454,17 @@ def trace_config(cfg: Config, config_name: str,
             out["prefill"] = trace_prefill(cfg, params, mesh, axes=axes)
         except Exception as e:
             errors["prefill"] = f"{type(e).__name__}: {e}"
+    # the chunk executable rides along with "prefill" whenever the config
+    # would actually compile it (serve_prefill_chunk_tokens > 0), and can
+    # be requested explicitly; knob=0 configs trace exactly as before
+    chunked = int(getattr(cfg, "serve_prefill_chunk_tokens", 0) or 0) > 0
+    if (("prefill_chunk" in steps or ("prefill" in steps and chunked))
+            and chunked and params and decode_traceable(cfg)):
+        try:
+            out["prefill_chunk"] = trace_prefill_chunk(cfg, params, mesh,
+                                                       axes=axes)
+        except Exception as e:
+            errors["prefill_chunk"] = f"{type(e).__name__}: {e}"
     if params and not opt_shapes:
         # no successful train trace to reuse the slot shapes from
         try:

@@ -392,6 +392,15 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # front and recycles it on completion; 0 = auto
     # (serve_max_batch x blocks-per-sequence, i.e. the physical pool)
     serve_kv_blocks=0,
+    # serve_prefill_chunk_tokens: >0 splits admission prefill into chunks of
+    # this many tokens (must be a multiple of the KV-block size, i.e. of
+    # serve_block_tokens when paged, else token_patch_size), dispatched
+    # asynchronously between decode steps so a long prompt admits over N
+    # loop iterations while occupied lanes keep decoding
+    # (docs/observability.md "Streaming and inter-token latency");
+    # 0 = monolithic admission prefill on the decode thread — byte-identical
+    # graphs, census/spmd goldens untouched
+    serve_prefill_chunk_tokens=0,
     # serve_aot_cache_dir: directory for serialized prefill/decode
     # executables keyed by config hash + mesh + toolchain — a second
     # server start deserializes instead of re-compiling (cold start in
@@ -531,6 +540,23 @@ class Config:
                     f"full-length sequence ({need} blocks of "
                     f"{self.serve_block_tokens or self.sequence_length} "
                     "tokens); raise serve_kv_blocks or serve_block_tokens")
+        if int(self.serve_prefill_chunk_tokens) < 0:
+            raise ValueError("serve_prefill_chunk_tokens must be >= 0 "
+                             "(0 = monolithic admission prefill)")
+        self.serve_prefill_chunk_tokens = int(self.serve_prefill_chunk_tokens)
+        if self.serve_prefill_chunk_tokens:
+            # chunks scatter-write whole KV-pool blocks at the lane's running
+            # position; a chunk that straddles a block boundary would split a
+            # block across two asynchronous dispatches
+            unit = self.serve_block_tokens or self.token_patch_size
+            if self.serve_prefill_chunk_tokens % unit:
+                raise ValueError(
+                    f"serve_prefill_chunk_tokens="
+                    f"{self.serve_prefill_chunk_tokens} must be a multiple of "
+                    f"the KV-block size ({unit} = "
+                    + ("serve_block_tokens" if self.serve_block_tokens
+                       else "token_patch_size")
+                    + "); chunks scatter whole blocks")
         self.serve_aot_cache_dir = str(self.serve_aot_cache_dir or "")
         self.serve_stream = bool(self.serve_stream)
         self.serve_trace_path = str(self.serve_trace_path or "")

@@ -182,6 +182,41 @@ def pool_nbytes(cfg: Config, params: dict,
     return int(round(pool_blocks(cfg) * block_rows(cfg) * per_row))
 
 
+def lane_view(caches: typing.Dict[str, tuple], lane) -> typing.Dict[str, tuple]:
+    """One lane's rows of every pooled cache as batch-1 arrays
+    (``dynamic_slice`` at a traced lane index) — the per-lane cache a
+    chunk-granular prefill forward runs against
+    (serve/engine.py::prefill_chunk_body)."""
+    out = {}
+    for name, kv in caches.items():
+        out[name] = tuple(
+            jax.lax.dynamic_slice(p, (lane,) + (0,) * (p.ndim - 1),
+                                  (1,) + p.shape[1:])
+            for p in kv)
+    return out
+
+
+def write_lane_rows(caches: typing.Dict[str, tuple],
+                    lane_caches: typing.Dict[str, tuple],
+                    lane, start_row, n_rows: int) -> typing.Dict[str, tuple]:
+    """Scatter ``n_rows`` cache rows (sequence axis 1) of the batch-1
+    ``lane_caches`` into lane ``lane`` of the pooled caches at row
+    ``start_row`` — the chunk-granular write over the block pool: only the
+    chunk's rows move, every other lane's (and the lane's own other) blocks
+    are byte-untouched, so chunked and monolithic prefill leave identical
+    cache prefixes."""
+    out = {}
+    for name, kv in caches.items():
+        updated = []
+        for pool, one in zip(kv, lane_caches[name]):
+            rows = jax.lax.dynamic_slice_in_dim(one, start_row, n_rows, 1)
+            updated.append(jax.lax.dynamic_update_slice(
+                pool, jnp.asarray(rows, pool.dtype),
+                (lane, start_row) + (0,) * (pool.ndim - 2)))
+        out[name] = tuple(updated)
+    return out
+
+
 class BlockAllocator:
     """Fixed-capacity KV-pool accountant (docs/observability.md
     "Continuous batching"): ``n_blocks`` blocks of ``block_tokens`` tokens,

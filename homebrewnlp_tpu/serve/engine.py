@@ -66,7 +66,12 @@ from .interface import (QueueDeadlineExceeded, _RowStream,
 #: convention is unchanged and existing caches stay valid.
 #: 2: the rng carry became a [n_lanes] key array (per-lane streams seeded
 #: by fold_in(request id) — :func:`lane_key`) instead of one shared key.
-AOT_FORMAT = 2
+#: 3: chunked prefill added a third executable (:func:`prefill_chunk_body`,
+#: persisted as ``prefill_chunk-<key>.jaxexec`` when
+#: ``serve_prefill_chunk_tokens > 0``) — the cache-hit contract now spans
+#: all executables the knobs require, so pre-chunk caches must not
+#: half-hit.
+AOT_FORMAT = 3
 
 #: donated argument positions of the jitted executables (relative to the
 #: bound callables :func:`jit_executables` builds).  The pooled KV caches,
@@ -77,12 +82,14 @@ AOT_FORMAT = 2
 #: dropped donate_argnums fails graftcheck before it doubles serving HBM.
 DECODE_DONATE_ARGNUMS = (1, 2, 3, 10)  # caches, toks, pos, rng
 PREFILL_DONATE_ARGNUMS = (1, 2)  # caches, toks
+PREFILL_CHUNK_DONATE_ARGNUMS = (1, 2)  # caches, toks
 #: human names for the donated positions above, keyed per executable so
 #: the donation audit's messages stay in lockstep with the signatures —
-#: update these three tables together when reordering body arguments
+#: update these tables together when reordering body arguments
 DECODE_DONATE_ARG_NAMES = {1: "pooled KV caches", 2: "token pool",
                            3: "lane positions", 10: "rng carry"}
 PREFILL_DONATE_ARG_NAMES = {1: "pooled KV caches", 2: "token pool"}
+PREFILL_CHUNK_DONATE_ARG_NAMES = {1: "pooled KV caches", 2: "token pool"}
 
 
 def lane_key(seed: int, rid: int) -> jax.Array:
@@ -172,13 +179,48 @@ def prefill_body(cfg: Config, rows: int,
     return out, toks
 
 
+def prefill_chunk_rows(cfg: Config) -> int:
+    """Decode rows per prefill chunk — ``serve_prefill_chunk_tokens`` in
+    rows, clamped to the sequence; 0 = chunking off (the monolithic
+    :func:`prefill_body` path, byte-identical graphs)."""
+    tokens = int(getattr(cfg, "serve_prefill_chunk_tokens", 0) or 0)
+    if tokens <= 0:
+        return 0
+    rows = cfg.sequence_length // cfg.token_patch_size
+    return max(1, min(rows, tokens // cfg.token_patch_size))
+
+
+def prefill_chunk_body(cfg: Config, rows: int, chunk_rows: int,
+                       params, caches, toks, chunk, lane, start_row):
+    """Prefill ONE chunk of a request into lane ``lane``: a forward over
+    ``chunk_rows`` rows at scalar position ``start_row`` against the
+    lane's own cache (the model's cached-attention path is exact for any
+    row count at a scalar position — masked positions contribute exact
+    0.0 to every full-length reduction, so N chunk forwards are bitwise
+    the monolithic prefill), then ONLY the chunk's KV rows and token rows
+    are scatter-written back into the (donated) pools at the lane's
+    running position.  The scheduler dispatches at most one chunk between
+    decode steps and never blocks on the result — prefill device time
+    hides under decode device time (docs/observability.md "Streaming and
+    inter-token latency")."""
+    lane_caches = kvc.lane_view(caches, lane)
+    filled = kvc._decode_logits(cfg, params, chunk, start_row,
+                                lane_caches, rows, TEXT_AXES)[1]
+    caches = kvc.write_lane_rows(caches, filled, lane, start_row, chunk_rows)
+    toks = jax.lax.dynamic_update_slice(toks, chunk, (lane, start_row, 0))
+    return caches, toks
+
+
 def jit_executables(cfg: Config, rows: int, n_lanes: int,
                     first_token_cb: typing.Optional[
                         typing.Callable] = None,
                     donate: bool = True):
-    """The engine's two jitted (not yet compiled) step functions with
-    their donation contract applied — shared by :class:`BatchEngine` and
-    the ``donation`` graph rule's abstract serving trace.
+    """The engine's jitted (not yet compiled) step functions with their
+    donation contract applied — shared by :class:`BatchEngine` and the
+    ``donation`` graph rule's abstract serving trace.  Returns
+    ``(decode, prefill, prefill_chunk)``; the third element is ``None``
+    when ``serve_prefill_chunk_tokens`` is 0 (the monolithic path — the
+    compiled graph set is byte-identical to the pre-chunking engine).
 
     ``donate=False`` is the AOT-cache compromise: this toolchain's
     ``serialize_executable`` does not round-trip input-output aliasing
@@ -190,16 +232,22 @@ def jit_executables(cfg: Config, rows: int, n_lanes: int,
     import functools
     dec = functools.partial(decode_body, cfg, rows, n_lanes, first_token_cb)
     pre = functools.partial(prefill_body, cfg, rows)
+    chunk_rows = prefill_chunk_rows(cfg)
+    chk = (functools.partial(prefill_chunk_body, cfg, rows, chunk_rows)
+           if chunk_rows else None)
     if not donate:
-        return jax.jit(dec), jax.jit(pre)
+        return jax.jit(dec), jax.jit(pre), (jax.jit(chk) if chk else None)
     return (jax.jit(dec, donate_argnums=DECODE_DONATE_ARGNUMS),
-            jax.jit(pre, donate_argnums=PREFILL_DONATE_ARGNUMS))
+            jax.jit(pre, donate_argnums=PREFILL_DONATE_ARGNUMS),
+            (jax.jit(chk, donate_argnums=PREFILL_CHUNK_DONATE_ARGNUMS)
+             if chk else None))
 
 
 def abstract_exec_args(cfg: Config, params_tree, rows: int, n_lanes: int):
-    """Abstract (ShapeDtypeStruct) argument tuples for the decode and
-    prefill executables — ``params_tree`` may already be abstract (the
-    static analysis path passes the traced param shapes)."""
+    """Abstract (ShapeDtypeStruct) argument tuples for the decode,
+    prefill and (when ``serve_prefill_chunk_tokens > 0``, else ``None``)
+    prefill-chunk executables — ``params_tree`` may already be abstract
+    (the static analysis path passes the traced param shapes)."""
     s = jax.ShapeDtypeStruct
     tree = jax.tree_util.tree_map(
         lambda a: a if isinstance(a, jax.ShapeDtypeStruct)
@@ -216,7 +264,11 @@ def abstract_exec_args(cfg: Config, params_tree, rows: int, n_lanes: int):
                        s(lanes, jnp.float32), rng, s(lanes, jnp.int32))
     prefill = common + (s((1, rows, cfg.token_patch_size), jnp.int32),
                         s((), jnp.int32), s((), jnp.int32))
-    return decode, prefill
+    chunk_rows = prefill_chunk_rows(cfg)
+    chunk = (common + (s((1, chunk_rows, cfg.token_patch_size), jnp.int32),
+                       s((), jnp.int32), s((), jnp.int32))
+             if chunk_rows else None)
+    return decode, prefill, chunk
 
 
 def use_batch_engine(cfg: Config) -> bool:
@@ -295,7 +347,12 @@ class _BatchRequest:
     __slots__ = ("rid", "prompt", "temperature", "max_tokens", "top_k",
                  "top_p", "rec", "out", "t_enq", "cancelled", "admitted",
                  "end", "end_row", "first_gen", "prompt_rows", "tag",
-                 "sink", "rstream", "t_admitted")
+                 "sink", "rstream", "t_admitted",
+                 # chunked-prefill state machine: the padded [1, rows,
+                 # patch] token layout chunks are sliced from, the next
+                 # chunk's start row, and the rows chunks must cover
+                 # before the lane arms for decode
+                 "padded", "next_chunk_row", "prefill_rows")
 
     def __init__(self, rid: int, prompt, temperature, max_tokens,
                  top_k, top_p, rec, sink=None):
@@ -313,13 +370,18 @@ class _BatchRequest:
         self.sink = sink
         self.rstream: typing.Optional[_RowStream] = None
         self.t_admitted: typing.Optional[float] = None
+        self.padded: typing.Optional[np.ndarray] = None
+        self.next_chunk_row = 0
+        self.prefill_rows = 0
 
 
 class BatchEngine:
     """The scheduler: owns the pooled device state (per-layer KV caches
     ``[serve_max_batch, seq_rows, ...]``, the token pool, per-lane
-    positions), the two AOT executables, and one worker thread running
-    admit -> decode-step -> complete forever.
+    positions), the AOT executables (decode, prefill, and — when
+    ``serve_prefill_chunk_tokens > 0`` — prefill-chunk), and one worker
+    thread running admit -> prefill-chunk -> decode-step -> complete
+    forever.
 
     ``first_token_callback`` is the serving TTFT hook (host
     ``(tag, token)``): the decode step fires it per lane at that lane's
@@ -354,6 +416,7 @@ class BatchEngine:
         self.patch = cfg.token_patch_size
         self.rows = cfg.sequence_length // self.patch
         self.n_lanes = int(cfg.serve_max_batch)
+        self._chunk_rows = prefill_chunk_rows(cfg)
         self.allocator = kvc.BlockAllocator(
             kvc.pool_blocks(cfg), kvc.block_rows(cfg) * self.patch)
         # cold-start accounting (bench.py serving row: cold_start_s =
@@ -385,6 +448,10 @@ class BatchEngine:
         self._logits = None  # last decode step's logits (tests/debug)
         self._lane_req: typing.List[typing.Optional[_BatchRequest]] = (
             [None] * self.n_lanes)
+        # lanes mid-chunked-prefill, in admission order: the head lane
+        # receives at most ONE chunk per loop iteration (between decode
+        # steps), then arms for decode once its chunks cover the prompt
+        self._prefill_fifo: typing.List[int] = []
         # scheduler plumbing
         self._cv = make_condition("serve.engine.BatchEngine._cv")
         self._queue: typing.List[_BatchRequest] = []
@@ -410,44 +477,59 @@ class BatchEngine:
 
     # -- executables ---------------------------------------------------------
     def _build_executables(self) -> None:
-        """AOT-compile (or AOT-deserialize) the prefill + decode
-        executables — both with the pooled state DONATED
-        (``DECODE_DONATE_ARGNUMS``/``PREFILL_DONATE_ARGNUMS``): the caches,
-        token pool, positions and rng are step-carried state, and without
+        """AOT-compile (or AOT-deserialize) the prefill + decode (and,
+        when chunking is on, prefill-chunk) executables — all with the
+        pooled state DONATED
+        (``DECODE_DONATE_ARGNUMS``/``PREFILL_DONATE_ARGNUMS``/
+        ``PREFILL_CHUNK_DONATE_ARGNUMS``): the caches, token pool,
+        positions and rng are step-carried state, and without
         input-output aliasing every decode step pays a full pool copy on
         device.  The cache key covers config + params structure + mesh +
-        toolchain (``aot_cache_key``); a miss compiles and then
-        best-effort persists both."""
+        toolchain (``aot_cache_key``); a hit requires EVERY executable
+        the knobs call for; a miss compiles and then best-effort
+        persists all of them."""
         cfg = self.cfg
-        decode_abs, prefill_abs = abstract_exec_args(
+        decode_abs, prefill_abs, chunk_abs = abstract_exec_args(
             cfg, self.params, self.rows, self.n_lanes)
         cache_dir = getattr(cfg, "serve_aot_cache_dir", "")
-        dec_path = pre_path = None
+        dec_path = pre_path = chk_path = None
+        self._prefill_chunk = None
         if cache_dir:
             key = aot_cache_key(cfg, self.params, self.n_lanes)
             os.makedirs(cache_dir, exist_ok=True)
             dec_path = os.path.join(cache_dir, f"decode-{key}.jaxexec")
             pre_path = os.path.join(cache_dir, f"prefill-{key}.jaxexec")
+            if chunk_abs is not None:
+                chk_path = os.path.join(cache_dir,
+                                        f"prefill_chunk-{key}.jaxexec")
             t0 = time.perf_counter()
             dec = _aot_load(dec_path)
             pre = _aot_load(pre_path) if dec is not None else None
-            if dec is not None and pre is not None:
+            chk = (_aot_load(chk_path)
+                   if chk_path is not None and pre is not None else None)
+            if (dec is not None and pre is not None
+                    and (chk_path is None or chk is not None)):
                 self._decode, self._prefill = dec, pre
+                self._prefill_chunk = chk
                 self.aot_reload_s = time.perf_counter() - t0
                 self.aot_cache_hit = True
                 return
             self.aot_cache_hit = False
-        dec_jit, pre_jit = jit_executables(
+        dec_jit, pre_jit, chk_jit = jit_executables(
             cfg, self.rows, self.n_lanes,
             self._first_token_cb if self._graph_ttft else None,
             donate=not cache_dir)
         t0 = time.perf_counter()
         self._decode = dec_jit.lower(*decode_abs).compile()
         self._prefill = pre_jit.lower(*prefill_abs).compile()
+        if chk_jit is not None:
+            self._prefill_chunk = chk_jit.lower(*chunk_abs).compile()
         self.compile_s = time.perf_counter() - t0
         if dec_path is not None:
             _aot_save(dec_path, self._decode)
             _aot_save(pre_path, self._prefill)
+            if chk_path is not None:
+                _aot_save(chk_path, self._prefill_chunk)
 
     # -- submission (any thread) ---------------------------------------------
     def queue_depth(self) -> int:
@@ -596,15 +678,19 @@ class BatchEngine:
     def _admit(self, prefill_segs: typing.List[tuple],
                stall: typing.List[float]) -> None:
         """Fill free lanes from the queue between decode steps: allocate
-        the KV-block footprint, prefill the lane, arm the mirrors.  Stops
-        at the first request the pool cannot hold RIGHT NOW (FIFO — a
-        small request never starves a big one already at the head).
+        the KV-block footprint, then either prefill the lane and arm the
+        mirrors (monolithic) or enqueue it on the chunked-prefill FIFO
+        (``serve_prefill_chunk_tokens > 0`` — chunks dispatch one per loop
+        iteration, :meth:`_advance_prefill`).  Stops at the first request
+        the pool cannot hold RIGHT NOW (FIFO — a small request never
+        starves a big one already at the head).
 
-        ``prefill_segs`` collects each prefill's ``(t0, t1, lane, rid)``
-        host segment; ``stall[0]`` accumulates the slice of that wall spent
-        while OTHER lanes held active requests — decode blocked on
-        admission prefill, the direct cost of running prefill on the
-        scheduler thread (docs/observability.md)."""
+        ``prefill_segs`` collects each prefill dispatch's
+        ``(t0, t1, lane, rid)`` host segment; ``stall[0]`` accumulates
+        stalled-lane-seconds — the monolithic path's BLOCKING prefill wall
+        times the lanes that held active requests while the scheduler
+        thread was pinned (docs/observability.md).  The chunked path never
+        blocks, so it never stalls."""
         while True:
             with self._cv:
                 # snapshot the cancel flags ONCE: a deadline-cancel landing
@@ -638,7 +724,6 @@ class BatchEngine:
     def _start_request(self, req: _BatchRequest, lane: int,
                        prefill_segs: typing.List[tuple],
                        stall: typing.List[float]) -> None:
-        cfg = self.cfg
         rec = req.rec
         req.admitted.set()
         prompt_rows = len(req.prompt) // self.patch
@@ -655,6 +740,7 @@ class BatchEngine:
         if req.tag:
             slo.register_first_token(req.tag, rec.mark_first_token)
         padded = self._pad_prompt(req)
+        req.padded = padded
         if req.sink is not None:
             # streaming: chunks concatenate to exactly the completion; the
             # host-built padded layout covers positions decode never
@@ -663,11 +749,26 @@ class BatchEngine:
                                      self.patch, req.first_gen,
                                      initial_tokens=padded.reshape(-1),
                                      rec=rec)
-        # prefill is timed INCLUDING the device wall (block_until_ready):
-        # the scheduler thread would pay it at the next step's sync anyway,
-        # and attributing it here is the whole point — this segment, while
-        # other lanes sit active, is hbnlp_serve_prefill_stall_seconds
-        others_active = self.active_lanes() > 0
+        if self._chunk_rows:
+            # chunked prefill: the lane is occupied (holds the request and
+            # its blocks) but NOT armed for decode (_end_row stays 0, so
+            # the decode mask skips it) until _advance_prefill has covered
+            # the prompt.  Coverage is max(prompt_rows, 1): decode starts
+            # at row prompt_rows - 1 and writes every later row itself,
+            # and an empty prompt's seed row still needs its token written
+            # (monolithic prefill writes the whole padded layout)
+            req.prefill_rows = max(prompt_rows, 1)
+            req.next_chunk_row = 0
+            self._lane_req[lane] = req
+            self._prefill_fifo.append(lane)
+            return
+        # monolithic (serve_prefill_chunk_tokens=0): timed INCLUDING the
+        # device wall (block_until_ready) — the scheduler thread would pay
+        # it at the next step's sync anyway, and attributing it here is the
+        # whole point.  This wall, times the lanes concurrently holding
+        # active requests, is hbnlp_serve_prefill_stall_seconds
+        # (stalled-lane-seconds: an idle-engine admission stalls nobody)
+        n_stalled = self.active_lanes()
         t_p0 = time.perf_counter()
         try:
             self._caches, self._toks = self._prefill(
@@ -675,31 +776,79 @@ class BatchEngine:
                 np.int32(lane), np.int32(prompt_rows))
             jax.block_until_ready(self._toks)
         except Exception as e:  # noqa: BLE001 - fail THIS request, keep serving
-            # the request is already admitted (deadline-cancel disabled) and
-            # holds blocks — an unhandled prefill error would leak both and
-            # leave its fetch() blocking forever
-            self.allocator.free(req.rid)
-            if req.tag:
-                slo.unregister_first_token(req.tag)
-            if rec is not None:
-                rec.mark_engine_done()
-            if req.rstream is not None:
-                req.rstream.close()
-            req.out.put(("err", e))
-            if self._pool_deleted():
-                # the prefill DONATES the pool; a failure after dispatch
-                # consumed the buffers, so the other lanes' state is gone
-                # too — escalate to the loop's fail-everything path, which
-                # reinitializes the pool
-                raise
+            self._fail_admission(req, e)
             return
         t_p1 = time.perf_counter()
         prefill_segs.append((t_p0, t_p1, lane, req.rid))
-        if others_active:
-            stall[0] += t_p1 - t_p0
-        req.t_admitted = t_p1
+        stall[0] += (t_p1 - t_p0) * n_stalled
         self._lane_req[lane] = req
-        self._pos_h[lane] = max(prompt_rows - 1, 0)
+        self._arm_lane(req, lane)
+
+    def _fail_admission(self, req: _BatchRequest, e: BaseException) -> None:
+        """Fail ONE request whose prefill (monolithic or a chunk) raised,
+        keep serving: the request is already admitted (deadline-cancel
+        disabled) and holds blocks — an unhandled prefill error would leak
+        both and leave its fetch() blocking forever.  Re-raises when the
+        failed dispatch consumed the donated pool (the other lanes' state
+        is gone too), escalating to the loop's fail-everything path, which
+        reinitializes the pool."""
+        self.allocator.free(req.rid)
+        if req.tag:
+            slo.unregister_first_token(req.tag)
+        if req.rec is not None:
+            req.rec.mark_engine_done()
+        if req.rstream is not None:
+            req.rstream.close()
+        req.out.put(("err", e))
+        if self._pool_deleted():
+            raise e
+
+    def _advance_prefill(self, prefill_segs: typing.List[tuple]) -> None:
+        """Dispatch AT MOST ONE prefill chunk — the head-of-FIFO lane's
+        next ``_chunk_rows`` rows — per scheduler iteration, WITHOUT
+        blocking (overlapped dispatch): the chunk executable donates the
+        pools, so the next decode step consumes its output by data
+        dependence and the host never waits on prefill device time; a
+        lane's readiness is synced implicitly at the first step that reads
+        its state.  A long prompt therefore admits over N iterations while
+        every armed lane keeps decoding.  The last chunk arms the lane.
+
+        The final chunk's start row is clamped so the executable stays
+        static-shaped: re-writing already-covered rows recomputes
+        bit-identical values (same tokens against the same cache prefix),
+        so a ragged last chunk costs overlap, never correctness."""
+        lane = self._prefill_fifo[0]
+        req = self._lane_req[lane]
+        start = max(0, min(req.next_chunk_row, self.rows - self._chunk_rows))
+        t_c0 = time.perf_counter()
+        try:
+            chunk = jnp.asarray(
+                req.padded[:, start:start + self._chunk_rows, :])
+            self._caches, self._toks = self._prefill_chunk(
+                self.params, self._caches, self._toks, chunk,
+                np.int32(lane), np.int32(start))
+        except Exception as e:  # noqa: BLE001 - fail THIS request, keep serving
+            # partially-admitted: release the lane and its whole block
+            # footprint before failing the request
+            self._prefill_fifo.pop(0)
+            self._lane_req[lane] = None
+            self._fail_admission(req, e)
+            return
+        t_c1 = time.perf_counter()
+        prefill_segs.append((t_c0, t_c1, lane, req.rid))
+        req.next_chunk_row += self._chunk_rows
+        if req.next_chunk_row >= req.prefill_rows:
+            self._prefill_fifo.pop(0)
+            req.padded = None  # the chunks are on device; drop the host copy
+            self._arm_lane(req, lane)
+
+    def _arm_lane(self, req: _BatchRequest, lane: int) -> None:
+        """Arm a prefilled lane for decode: host mirrors, the per-request
+        RNG stream, the device position vector.  Completes the request
+        immediately when there is nothing to generate (full prompt / zero
+        budget) — the lane never joins the decode loop."""
+        req.t_admitted = time.perf_counter()
+        self._pos_h[lane] = max(req.prompt_rows - 1, 0)
         self._end_row[lane] = req.end_row
         self._first_gen[lane] = req.first_gen
         self._temps[lane] = req.temperature
@@ -711,11 +860,9 @@ class BatchEngine:
         # splice on the raw key data)
         data = jax.random.key_data(self._rngs)
         self._rngs = jax.random.wrap_key_data(data.at[lane].set(
-            jax.random.key_data(lane_key(cfg.data_seed, req.rid))))
+            jax.random.key_data(lane_key(self.cfg.data_seed, req.rid))))
         self._pos = jnp.asarray(self._pos_h)
         if self._pos_h[lane] >= req.end_row - 1:
-            # nothing to generate (full prompt / zero budget): complete
-            # straight off the prefill, the lane never joins the loop
             self._finish_lane(lane)
 
     def _step(self, segs: typing.List[tuple], t_start: float) -> int:
@@ -827,6 +974,14 @@ class BatchEngine:
         self.allocator.free(req.rid)
         req.out.put(("ok", out))
 
+    def _decode_armed(self) -> bool:
+        """Whether any lane is armed for decode.  Lanes mid-chunked-prefill
+        occupy a lane (``active_lanes`` counts them, keeping the loop
+        awake) but keep ``_end_row`` at 0 until :meth:`_arm_lane`, so a
+        decode step never runs for prefill-only iterations."""
+        return any(r is not None and self._end_row[lane] > 0
+                   for lane, r in enumerate(self._lane_req))
+
     def _loop(self) -> None:
         while True:
             with self._cv:
@@ -843,9 +998,11 @@ class BatchEngine:
             n_active = 0
             try:
                 self._admit(prefill_segs, stall)
+                if self._prefill_fifo:
+                    self._advance_prefill(prefill_segs)
                 t_admit = time.perf_counter()
                 segs.append(("admit", t0, t_admit))
-                if self.active_lanes():
+                if self._decode_armed():
                     n_active = self._step(segs, t_admit)
                     stepped = True
             except Exception as e:  # noqa: BLE001 - fail every in-flight req
@@ -915,6 +1072,7 @@ class BatchEngine:
         self._pos_h = np.zeros(self.n_lanes, np.int32)
 
     def _fail_all(self, e: BaseException) -> None:
+        self._prefill_fifo.clear()
         for lane, req in enumerate(self._lane_req):
             if req is not None:
                 self._lane_req[lane] = None

@@ -71,7 +71,10 @@ ITL_BUCKETS = FINE_LATENCY_BUCKETS
 #: the decode-loop phase names the engine attributes each scheduler
 #: iteration into (docs/observability.md "Streaming and inter-token
 #: latency"); contiguous host segments, so their per-step sum equals the
-#: decode-loop wall by construction
+#: decode-loop wall by construction.  ``prefill`` covers every prefill
+#: dispatch in the iteration — the monolithic blocking call, or (under
+#: ``serve_prefill_chunk_tokens``) the one asynchronous chunk dispatch
+#: interleaved before the decode step, one segment per chunk
 STEP_PHASES = ("admit", "prefill", "dispatch", "sync", "sample", "emit")
 
 _REQUEST_IDS = itertools.count(1)
@@ -375,9 +378,11 @@ class ServeSLO:
             "waits between requests)")
         self.prefill_stall = reg.counter(
             "hbnlp_serve_prefill_stall_seconds",
-            "decode wall spent blocked on admission prefill while other "
-            "lanes held active requests (the cost of running prefill on "
-            "the decode critical path)")
+            "stalled lane-seconds: BLOCKING admission-prefill wall times "
+            "the lanes that held active requests while the scheduler "
+            "thread was pinned (the cost of running monolithic prefill on "
+            "the decode critical path; chunked prefill dispatches "
+            "asynchronously and contributes zero)")
         self._lane_probe: typing.Optional[typing.Callable[[], int]] = None
         reg.gauge("hbnlp_serve_lane_occupancy",
                   "decode lanes currently holding a request (-1 = no "
@@ -458,10 +463,12 @@ class ServeSLO:
                      stepped: bool = True) -> None:
         """Engine hook, once per scheduler-loop iteration: the iteration's
         wall, its phase decomposition (contiguous host segments — they sum
-        to ``wall_s``), and the slice of prefill wall that stalled active
-        decode lanes.  ``stepped=False`` (an iteration that only admitted,
-        never decoded) still feeds the counters but not the per-step
-        histogram."""
+        to ``wall_s``), and ``prefill_stall_s`` in stalled lane-seconds
+        (blocking prefill wall x concurrently-active lanes; zero under
+        chunked prefill, whose dispatches never block the thread).
+        ``stepped=False`` (an iteration that only admitted or dispatched a
+        prefill chunk, never decoded) still feeds the counters but not the
+        per-step histogram."""
         if stepped:
             self.decode_step.observe(float(wall_s))
         self.decode_loop.inc(max(0.0, float(wall_s)))

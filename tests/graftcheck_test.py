@@ -208,7 +208,7 @@ def test_serve_donation_dropped_is_caught(eight_devices, monkeypatch):
         dec = functools.partial(engine.decode_body, cfg, rows, n_lanes,
                                 first_token_cb)
         pre = functools.partial(engine.prefill_body, cfg, rows)
-        return jax.jit(dec), jax.jit(pre)
+        return jax.jit(dec), jax.jit(pre), None
 
     monkeypatch.setattr(engine, "jit_executables", undonated)
     findings = graph_rules.check_donation(traces)

@@ -1,0 +1,226 @@
+"""Chunked-prefill tests (serve/engine.py ``serve_prefill_chunk_tokens``,
+docs/observability.md "Continuous batching"): knob validation, chunked vs
+monolithic bit-identicality across chunk geometries (ragged last chunk,
+prompt shorter than one chunk, empty prompt, exact fit), AOT round-trip
+with the third executable, mid-admission chunk failure recycling blocks,
+the stalled-lane-seconds A/B (chunked admission contributes zero), and the
+trace-level proof that decode steps fire BETWEEN a long prompt's chunks."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+from backend import mixer_config  # noqa: E402
+
+from homebrewnlp_tpu.config import Config  # noqa: E402
+from homebrewnlp_tpu.models import init_params  # noqa: E402
+from homebrewnlp_tpu.utils import random_text_batch  # noqa: E402
+
+
+def _chunk_cfg(**over) -> Config:
+    base = dict(depth=1, sequence_length=12, heads=2, features_per_head=16,
+                vocab_size=32, train_batch_size=1, sampling_temperature=0.0,
+                use_autoregressive_sampling=True, serve_max_batch=3)
+    base.update(over)
+    return mixer_config(**base)
+
+
+@pytest.fixture(scope="module")
+def chunk_setup():
+    cfg = _chunk_cfg()
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    return cfg, params
+
+
+# one of each chunk-coverage geometry: multi-chunk with a ragged last
+# chunk (7 rows / chunk 4), shorter than one chunk, empty prompt (the
+# seed row still needs its token written), and an exact one-chunk fit
+PROMPTS = ([1, 2, 3, 4, 5, 6, 7], [9, 8], [], [4, 4, 4, 4])
+
+
+def _run_engine(cfg, params, prompts=PROMPTS, temperature=0.7,
+                response_len=4):
+    from homebrewnlp_tpu.serve.engine import BatchEngine
+    eng = BatchEngine(cfg, params)
+    try:
+        reqs = [eng.submit(list(p), temperature, response_len, 0, 1.0)
+                for p in prompts]
+        return [list(map(int, eng.fetch(r))) for r in reqs]
+    finally:
+        eng.close()
+
+
+def test_chunk_knob_validation():
+    with pytest.raises(ValueError, match="serve_prefill_chunk_tokens"):
+        _chunk_cfg(serve_prefill_chunk_tokens=-1)
+    # chunks scatter whole blocks: the knob must divide into block units
+    with pytest.raises(ValueError, match="multiple"):
+        _chunk_cfg(serve_block_tokens=4, serve_prefill_chunk_tokens=6)
+    assert _chunk_cfg(serve_block_tokens=4,
+                      serve_prefill_chunk_tokens=8) is not None
+    assert _chunk_cfg(serve_prefill_chunk_tokens=0) is not None
+
+
+@pytest.fixture(scope="module")
+def monolithic_tokens(chunk_setup):
+    cfg, params = chunk_setup
+    return _run_engine(cfg, params)
+
+
+@pytest.mark.parametrize("chunk_tokens", [1, 2, 4, 12])
+def test_chunked_prefill_bit_identical_tokens(chunk_setup, monolithic_tokens,
+                                              chunk_tokens):
+    """Chunked and monolithic prefill sample IDENTICAL tokens (stochastic
+    temperature, so logits agree to the bit): every sequence-axis
+    reduction runs full-length with masked rows contributing exact 0.0,
+    and the clamped ragged last chunk recomputes identical rows."""
+    cfg, params = chunk_setup
+    chunked = _run_engine(
+        _chunk_cfg(serve_prefill_chunk_tokens=chunk_tokens), params)
+    assert chunked == monolithic_tokens
+
+
+def test_aot_round_trip_includes_chunk_executable(tmp_path, chunk_setup):
+    """knob > 0 serializes THREE executables; a half-populated pre-chunk
+    cache must miss (AOT_FORMAT key bump), and a second engine reloads
+    all three with identical outputs."""
+    from homebrewnlp_tpu.serve.engine import BatchEngine, aot_cache_key
+    _, params = chunk_setup
+    cfg = _chunk_cfg(serve_prefill_chunk_tokens=4,
+                     serve_aot_cache_dir=str(tmp_path))
+    e1 = BatchEngine(cfg, params)
+    assert e1.aot_cache_hit is False and e1.compile_s is not None
+    key = aot_cache_key(cfg, e1.params, cfg.serve_max_batch)
+    assert sorted(os.listdir(tmp_path)) == [
+        f"decode-{key}.jaxexec", f"prefill-{key}.jaxexec",
+        f"prefill_chunk-{key}.jaxexec"]
+    out1 = np.asarray(e1.complete_tokens([1, 2, 3], 0.0, 5))
+    e1.close()
+    e2 = BatchEngine(cfg, params)
+    assert e2.aot_cache_hit is True and e2.aot_reload_s is not None
+    assert e2.compile_s is None
+    out2 = np.asarray(e2.complete_tokens([1, 2, 3], 0.0, 5))
+    assert out1.tolist() == out2.tolist()
+    e2.close()
+
+
+def test_chunk_failure_mid_admission_frees_blocks(chunk_setup):
+    """A chunk dispatch failure mid-admission must fail THAT request and
+    recycle its whole block allocation — the lane was occupied but never
+    armed for decode, so nothing else can clean it up."""
+    from homebrewnlp_tpu.serve.engine import BatchEngine
+    _, params = chunk_setup
+    cfg = _chunk_cfg(serve_prefill_chunk_tokens=2)
+    eng = BatchEngine(cfg, params)
+    try:
+        def broken_chunk(*a, **k):
+            raise RuntimeError("injected chunk failure")
+
+        eng._prefill_chunk = broken_chunk
+        req = eng.submit([1, 2, 3, 4, 5], 0.7, 4, 0, 1.0)
+        with pytest.raises(RuntimeError, match="injected chunk"):
+            eng.fetch(req)
+        assert eng.kv_blocks_free() == eng.allocator.n_blocks
+        assert eng.active_lanes() == 0 and eng.queue_depth() == 0
+    finally:
+        eng.close()
+
+
+def _drive_with_stall(cfg, params, prompts, response_len=6):
+    """Run the prompts through a fresh engine while a step observer sums
+    the stalled-lane-seconds the SLO layer would publish."""
+    from homebrewnlp_tpu.serve.engine import BatchEngine
+    stall = [0.0]
+    eng = BatchEngine(cfg, params)
+    eng.set_step_observer(
+        lambda wall, phases, n_active, stall_s, stepped:
+        stall.__setitem__(0, stall[0] + stall_s))
+    try:
+        reqs = [eng.submit(list(p), 0.0, response_len, None, None)
+                for p in prompts]
+        for r in reqs:
+            eng.fetch(r)
+    finally:
+        eng.close()
+    return stall[0]
+
+
+def test_stall_ab_and_idle_admission_zero(chunk_setup):
+    """The stall counter is stalled-LANE-seconds: a monolithic admission
+    while other lanes decode stalls them (> 0); admission into an IDLE
+    engine stalls nobody (== 0); chunked admission dispatches
+    asynchronously and NEVER increments the counter."""
+    cfg, params = chunk_setup
+    burst = ([1, 2], [3, 4, 5, 6, 7, 8], [5, 6, 7])
+    # all three queued before the admit scan: the 2nd/3rd monolithic
+    # prefills run with >= 1 lane already active — deterministic stall
+    mono = _drive_with_stall(cfg, params, burst)
+    assert mono > 0.0
+    # idle engine, one request: n_stalled snapshots 0 active lanes
+    assert _drive_with_stall(cfg, params, ([1, 2, 3],)) == 0.0
+    chunked = _drive_with_stall(
+        _chunk_cfg(serve_prefill_chunk_tokens=1), params, burst)
+    assert chunked == 0.0
+
+
+def test_decode_interleaves_between_chunks(tmp_path, chunk_setup):
+    """The exported lane trace proves the scheduler alternates: a short
+    request armed first keeps decoding (engine/dispatch spans) strictly
+    between the long prompt's per-chunk ``prefilling`` spans."""
+    from homebrewnlp_tpu.serve.engine import BatchEngine
+    _, params = chunk_setup
+    trace_path = os.path.join(str(tmp_path), "chunked.trace.json")
+    cfg = _chunk_cfg(serve_prefill_chunk_tokens=1,
+                     serve_trace_path=trace_path)
+    eng = BatchEngine(cfg, params)
+    try:
+        short = eng.submit([1, 2], 0.0, 8, None, None)
+        long_ = eng.submit([3] * 8, 0.0, 2, None, None)
+        eng.fetch(short)
+        eng.fetch(long_)
+        long_rid = str(long_.rid)
+    finally:
+        eng.close()
+    with open(trace_path) as f:
+        events = json.load(f)["traceEvents"]
+    spans = sorted((e["ts"], e["ts"] + e["dur"]) for e in events
+                   if e.get("name") == "prefilling" and e.get("ph") == "X"
+                   and (e.get("args") or {}).get("rid") == long_rid)
+    assert len(spans) == 8, spans  # one span per chunk row
+    dispatch = [e["ts"] for e in events
+                if e.get("name") == "engine/dispatch" and e.get("ph") == "X"]
+    first_end, last_start = spans[0][1], spans[-1][0]
+    assert any(first_end < ts < last_start for ts in dispatch), (
+        spans, dispatch)
+
+
+def test_evaluate_serve_baseline_chunked_ratchets():
+    """The bench A/B probe's ON arm ratchets once recorded: stall fraction
+    with the ratio + 0.05 absolute slack, itl_p95 like the other
+    latencies; a baseline without the probe skips (absence is not a
+    regression)."""
+    import bench
+    on = {"prefill_stall_fraction": 0.02, "itl_p95": 0.010}
+    row = {"e2e_p50_s": 1.0,
+           "chunked_prefill": {"chunk_tokens": 8, "on": dict(on)}}
+    base = {"e2e_p50_s": 1.0,
+            "chunked_prefill": {"chunk_tokens": 8, "on": dict(on)}}
+    out, ok = bench.evaluate_serve_baseline(row, base)
+    assert ok and out["chunked_stall_fraction"]["pass"]
+    assert out["chunked_itl_p95"]["pass"]
+    row["chunked_prefill"]["on"]["prefill_stall_fraction"] = 0.30
+    out, ok = bench.evaluate_serve_baseline(row, base)
+    # 0.30 > 0.02 * 1.5 + 0.05 = 0.08 -> the stall regressed
+    assert not ok and not out["chunked_stall_fraction"]["pass"]
+    row["chunked_prefill"]["on"]["prefill_stall_fraction"] = 0.02
+    row["chunked_prefill"]["on"]["itl_p95"] = 0.020  # 2x -> fail
+    out, ok = bench.evaluate_serve_baseline(row, base)
+    assert not ok and not out["chunked_itl_p95"]["pass"]
+    out, ok = bench.evaluate_serve_baseline(
+        row, {"e2e_p50_s": 1.0})  # probe never recorded -> skipped
+    assert ok and "chunked_stall_fraction" not in (out or {})

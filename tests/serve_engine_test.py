@@ -394,9 +394,11 @@ def test_engine_executables_donate_pooled_state(engine_setup):
     from homebrewnlp_tpu.serve import engine
     cfg, params = engine_setup
     rows = cfg.sequence_length // cfg.token_patch_size
-    dec_jit, pre_jit = engine.jit_executables(cfg, rows, cfg.serve_max_batch)
-    dec_abs, pre_abs = engine.abstract_exec_args(cfg, params, rows,
-                                                 cfg.serve_max_batch)
+    dec_jit, pre_jit, chk_jit = engine.jit_executables(cfg, rows,
+                                                       cfg.serve_max_batch)
+    dec_abs, pre_abs, chk_abs = engine.abstract_exec_args(
+        cfg, params, rows, cfg.serve_max_batch)
+    assert chk_jit is None and chk_abs is None  # chunking off by default
     for jitted, abs_args, want in (
             (dec_jit, dec_abs, engine.DECODE_DONATE_ARGNUMS),
             (pre_jit, pre_abs, engine.PREFILL_DONATE_ARGNUMS)):

@@ -88,7 +88,8 @@ def main(argv=None) -> int:
               "--all-configs", file=sys.stderr)
         return 2
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
-    unknown_steps = sorted(set(steps) - {"train", "eval", "decode", "prefill"})
+    unknown_steps = sorted(set(steps) - {"train", "eval", "decode",
+                                         "prefill", "prefill_chunk"})
     if unknown_steps:
         print(f"unknown step(s) {', '.join(unknown_steps)}; valid: "
               f"train, eval, decode, prefill", file=sys.stderr)

@@ -211,7 +211,7 @@ def main(argv=None) -> int:
         return 2
     devices = [d.strip() for d in args.devices.split(",") if d.strip()]
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
-    valid_steps = {"train", "eval", "decode", "prefill"}
+    valid_steps = {"train", "eval", "decode", "prefill", "prefill_chunk"}
     unknown = sorted(set(steps) - valid_steps)
     if args.sweep_step and args.sweep_step not in valid_steps:
         unknown.append(args.sweep_step)

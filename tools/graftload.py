@@ -79,16 +79,31 @@ SERVER_SERIES = (("e2e_s", "hbnlp_serve_request_seconds"),
 
 
 def make_corpus(seed: int, n: int, vocab: int = 256, min_len: int = 4,
-                max_len: int = 24) -> typing.List[typing.List[int]]:
+                max_len: int = 24, long_frac: float = 0.0,
+                long_len: int = 0) -> typing.List[typing.List[int]]:
     """Deterministic token-id prompt corpus: same (seed, n, vocab, bounds)
     -> byte-identical prompts on every machine, so two graftload runs (or a
-    run and the bench serving row) drive the exact same work."""
+    run and the bench serving row) drive the exact same work.
+
+    ``long_frac``/``long_len`` mix in LONG prompts (exactly ``long_len``
+    tokens, chosen per-request from the same seeded stream) — the
+    mixed-length corpus that reproduces the admission-prefill stall a long
+    prompt inflicts on decoding lanes (docs/observability.md; the scenario
+    ``serve_prefill_chunk_tokens`` exists to fix).  The defaults draw no
+    extra randomness, so pre-existing fixed-seed corpora are unchanged."""
     rng = random.Random(seed)
     lo, hi = max(1, int(min_len)), max(1, int(max_len))
     if hi < lo:
         lo, hi = hi, lo
-    return [[rng.randrange(1, max(2, vocab)) for _ in range(rng.randint(lo, hi))]
-            for _ in range(max(1, n))]
+    mix_long = float(long_frac) > 0.0 and int(long_len) > 0
+    out = []
+    for _ in range(max(1, n)):
+        if mix_long and rng.random() < float(long_frac):
+            n_tok = max(1, int(long_len))
+        else:
+            n_tok = rng.randint(lo, hi)
+        out.append([rng.randrange(1, max(2, vocab)) for _ in range(n_tok)])
+    return out
 
 
 def _post(url: str, body: dict, timeout_s: float) -> typing.Tuple[int, dict]:
@@ -533,11 +548,14 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
           temperature: float = 1.0, timeout_s: float = 300.0,
           log_path: typing.Optional[str] = None,
           log_format: typing.Optional[str] = None,
-          stream: bool = False) -> dict:
+          stream: bool = False, long_frac: float = 0.0,
+          long_len: int = 0) -> dict:
     """One full run: corpus -> load -> client report -> server scrape ->
-    reconciliation.  The importable entry bench.py and the tests share."""
+    reconciliation.  The importable entry bench.py and the tests share.
+    ``long_frac``/``long_len`` thread through to :func:`make_corpus` (the
+    mixed prompt-length stall scenario)."""
     corpus = make_corpus(seed, max(8, n_requests), vocab, min_prompt,
-                         max_prompt)
+                         max_prompt, long_frac=long_frac, long_len=long_len)
     records, trace, duration, truncated = run_load(
         url, corpus, n_requests, concurrency=concurrency, mode=mode,
         rate=rate, ramp_s=ramp_s, response_len=response_len,
@@ -545,6 +563,7 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
     report = {"url": url, "mode": mode, "concurrency": concurrency,
               "rate": rate, "seed": seed, "response_len": response_len,
               "stream": bool(stream),
+              "long_frac": float(long_frac), "long_len": int(long_len),
               "client": client_report(records, trace, duration,
                                       truncated=truncated)}
     if log_path:
@@ -579,6 +598,13 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     ap.add_argument("--vocab", type=int, default=256)
     ap.add_argument("--min-prompt", type=int, default=4)
     ap.add_argument("--max-prompt", type=int, default=24)
+    ap.add_argument("--long-frac", type=float, default=0.0,
+                    help="fraction of prompts drawn LONG (--long-len "
+                         "tokens) — the fixed-seed mixed-length corpus that "
+                         "reproduces the admission-prefill stall; 0 = off")
+    ap.add_argument("--long-len", type=int, default=0,
+                    help="token length of the long prompts --long-frac "
+                         "mixes in")
     ap.add_argument("--response-len", type=int, default=16)
     ap.add_argument("--temperature", type=float, default=1.0)
     ap.add_argument("--timeout-s", type=float, default=300.0)
@@ -604,7 +630,8 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                        response_len=args.response_len,
                        temperature=args.temperature,
                        timeout_s=args.timeout_s, log_path=args.log or None,
-                       stream=args.stream)
+                       stream=args.stream, long_frac=args.long_frac,
+                       long_len=args.long_len)
     except (OSError, ValueError) as e:
         print(f"graftload: {e}", file=sys.stderr)
         return 2
@@ -624,6 +651,10 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
             row = report.get("server", {}).get(key)
             if row:
                 print(f"server {key}: " + json.dumps(row))
+        stall_frac = report.get("server", {}).get("prefill_stall_fraction")
+        if stall_frac is not None:
+            print(f"prefill_stall_fraction: {stall_frac} "
+                  "(decode-loop wall lost to blocking admission prefill)")
         if "reconcile" in report:
             print("reconcile: " + json.dumps(report["reconcile"]))
     if args.check:

@@ -163,7 +163,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     steps = tuple(s.strip() for s in args.steps.split(",") if s.strip())
-    unknown = sorted(set(steps) - {"train", "eval", "decode", "prefill"})
+    unknown = sorted(set(steps) - {"train", "eval", "decode", "prefill",
+                                   "prefill_chunk"})
     if unknown:
         print(f"unknown step(s) {', '.join(unknown)}; valid: "
               f"train, eval, decode, prefill", file=sys.stderr)
