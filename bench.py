@@ -1040,6 +1040,38 @@ def _bench_serving_inner(aot_dir: str, t0: float) -> dict:
     row.update(cold)
     row.update(stream_probe)
     row.update(chunk_probe)
+    # flight-recorder steady-state overhead (observability PR): time the
+    # recorder's whole per-request hot path (trail build + ring append +
+    # tail-sampling quantile) on realistic finished records and price it
+    # against this row's measured p50 latency — the figure the ≤1%
+    # acceptance bound ratchets (contained: probe failure, row survives)
+    try:
+        from homebrewnlp_tpu.obs.flight import FlightRecorder
+        from homebrewnlp_tpu.serve.slo import RequestRecord
+        fr = FlightRecorder(registry=reg)
+
+        def _probe_rec(i: int) -> RequestRecord:
+            r = RequestRecord(i, path="/token_completion")
+            r.xid = f"bench-{i:04d}"
+            r.mark_parsed()
+            r.mark_enqueued(queue_depth=0)
+            r.mark_started()
+            r.mark_first_token()
+            r.mark_engine_done()
+            r.tokens_generated = SERVE_RESPONSE_LEN
+            r.mark_finished(200)
+            return r
+
+        probe_recs = [_probe_rec(i) for i in range(256)]
+        t_fl = time.perf_counter()
+        for r in probe_recs:
+            fr.observe_request(r)
+        per_req_s = (time.perf_counter() - t_fl) / len(probe_recs)
+        row["flight_observe_us"] = round(per_req_s * 1e6, 2)
+        if isinstance(e2e.get("p50"), (int, float)) and e2e["p50"] > 0:
+            row["flight_overhead_frac"] = round(per_req_s / e2e["p50"], 6)
+    except Exception as e:  # noqa: BLE001 - probe failure, row survives
+        row["flight_probe_error"] = f"{type(e).__name__}: {e}"[:200]
     srv = report.get("server") or {}
     if isinstance(srv, dict) and "error" not in srv:
         for key, out_key in (("ttft_s", "ttft"), ("queue_wait_s",
@@ -1144,6 +1176,15 @@ def evaluate_serve_baseline(row: dict, baseline: dict,
         passed = bool(ratio <= max_latency_ratio)
         out["chunked_itl_p95"] = {"baseline_s": b_itl,
                                   "ratio": round(ratio, 3), "pass": passed}
+        ok = ok and passed
+    # flight-recorder overhead (observability PR): an ABSOLUTE cap, not a
+    # ratio against baseline — the ≤1%-of-p50 bound IS the acceptance
+    # criterion, so a baseline recorded at 0.2% must not license 0.3%
+    fo = row.get("flight_overhead_frac")
+    if isinstance(fo, (int, float)):
+        passed = bool(fo <= 0.01)
+        out["flight_overhead_frac"] = {"value": fo, "limit": 0.01,
+                                       "pass": passed}
         ok = ok and passed
     return (out or None), ok
 
@@ -1341,6 +1382,10 @@ def main() -> None:
                     # chunked-prefill A/B figures (chunked prefill PR),
                     # present only when HBNLP_BENCH_SERVE_CHUNK ran the probe
                     "chunked_prefill": srow.get("chunked_prefill"),
+                    # flight-recorder per-request cost (observability PR) —
+                    # recorded for trajectory visibility; the gate itself
+                    # is the absolute ≤1% cap, not a ratio against this
+                    "flight_overhead_frac": srow.get("flight_overhead_frac"),
                     "shape": shape,
                     "recorded": time.time()})
                 with open(SERVE_BASELINE_FILE, "w") as f:

@@ -414,8 +414,30 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     serve_stream=True,
     # serve_trace_path: Chrome-trace JSON of the serving engine's decode
     # loop (per-phase spans + per-lane occupancy tracks + request phase
-    # trails), exported when the engine closes; "" = serving trace off
+    # trails), exported when the engine closes — and, while the flight
+    # recorder is on (flight_buffer_spans > 0), ROTATED into rolling
+    # <path>.NNN.json segments whenever the span ring fills, so a crash
+    # loses at most one ring of spans; "" = serving trace off
     serve_trace_path="",
+    # slo_objectives: declared serving SLOs, evaluated per finished
+    # request by obs/slo_alerts.py into fast/slow-window burn rates
+    # (hbnlp_slo_burn_rate{objective,window} + the /healthz "alerts"
+    # block), e.g. {"ttft_p95_s": 2.0, "error_rate": 0.01}.  Keys are
+    # "error_rate" (value = the error budget itself) or "<metric>_p<NN>_s"
+    # with metric in ttft/e2e/queue_wait (value = the latency threshold;
+    # error budget = 1 - NN/100); {} = SLO alerting off
+    slo_objectives={},
+    # flight_buffer_spans: span capacity of the serving flight recorder's
+    # ring (obs/flight.py): recent spans + last-N request trails + metric
+    # snapshots held in bounded memory, written as a self-contained
+    # incident bundle to <model_path>/diagnostics/ when a trigger fires
+    # (flight_dump_triggers); also caps the serve_trace_path tracer and
+    # arms its rolling-segment rotation; 0 = flight recorder off
+    flight_buffer_spans=4096,
+    # flight_dump_triggers: which events write a flight bundle — any
+    # subset of ("watchdog", "error", "slo", "manual"): watchdog stall,
+    # 5xx response, an SLO burn-rate alert firing, or POST /debugz/dump
+    flight_dump_triggers=("watchdog", "error", "slo", "manual"),
     equal_debugging_items_per_check=16,
     debug_sample=False,
     default_sleep_duration=0.1,
@@ -560,6 +582,35 @@ class Config:
         self.serve_aot_cache_dir = str(self.serve_aot_cache_dir or "")
         self.serve_stream = bool(self.serve_stream)
         self.serve_trace_path = str(self.serve_trace_path or "")
+        if not isinstance(self.slo_objectives, dict):
+            raise ValueError(
+                "slo_objectives must be a dict of objective -> threshold, "
+                'e.g. {"ttft_p95_s": 2.0, "error_rate": 0.01} '
+                "({} = SLO alerting off)")
+        if self.slo_objectives:
+            # surface a typoed objective at config load, not as a silently
+            # never-firing alert; validate_objectives raises ValueError
+            # naming the bad key/threshold
+            from .obs.slo_alerts import validate_objectives
+            self.slo_objectives = validate_objectives(self.slo_objectives)
+        if int(self.flight_buffer_spans) < 0:
+            raise ValueError("flight_buffer_spans must be >= 0 "
+                             "(0 = flight recorder off)")
+        self.flight_buffer_spans = int(self.flight_buffer_spans)
+        if isinstance(self.flight_dump_triggers, str):
+            # a bare string would iterate characters and silently disable
+            # every real trigger — same guard as quant_blocks
+            raise ValueError(
+                "flight_dump_triggers must be a sequence of trigger names, "
+                "not a string")
+        triggers = tuple(str(t) for t in self.flight_dump_triggers)
+        from .obs.flight import DUMP_TRIGGERS
+        bad = [t for t in triggers if t not in DUMP_TRIGGERS]
+        if bad:
+            raise ValueError(
+                f"flight_dump_triggers has unknown trigger(s) {bad}; "
+                f"known: {sorted(DUMP_TRIGGERS)}")
+        self.flight_dump_triggers = triggers
         if self.watchdog_factor < 0:
             raise ValueError("watchdog_factor must be >= 0 "
                              "(0 = watchdog disabled)")

@@ -261,6 +261,9 @@ class _ObsServer(ThreadingHTTPServer):
     #: coordinator, generation) merged into /healthz so ANY scraped
     #: endpoint is self-describing in a multi-host fleet
     identity: typing.Optional[dict] = None
+    #: optional SLO burn-rate summary callable (obs/slo_alerts.py::
+    #: SLOAlerts.summary) merged into /healthz as the ``alerts`` block
+    alerts_probe: typing.Optional[typing.Callable[[], dict]] = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -272,8 +275,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self):
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
+            # content negotiation: the OpenMetrics flavor (exemplars +
+            # ``# EOF``) only on explicit request — the default stays
+            # byte-identical Prometheus 0.0.4 (the fleet parser contract)
+            accept = self.headers.get("Accept", "")
+            openmetrics = ("application/openmetrics-text" in accept
+                           or "openmetrics=1" in query)
+            if openmetrics and hasattr(self.server.registry,
+                                       "render_openmetrics"):
+                body = self.server.registry.render_openmetrics().encode()
+                self._send(200, body, "application/openmetrics-text; "
+                                      "version=1.0.0; charset=utf-8")
+                return
             body = self.server.registry.render().encode()
             self._send(200, body, "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/healthz":
@@ -294,6 +309,14 @@ class _Handler(BaseHTTPRequestHandler):
                     snap["slo"] = probe()
                 except Exception:  # noqa: BLE001 - must not break the probe
                     snap["slo"] = None
+            aprobe = getattr(self.server, "alerts_probe", None)
+            if aprobe is not None:
+                # SLO burn-rate alert state (obs/slo_alerts.py) — the block
+                # graftwatch --check gates on
+                try:
+                    snap["alerts"] = aprobe()
+                except Exception:  # noqa: BLE001 - must not break the probe
+                    snap["alerts"] = None
             status = 503 if snap["status"] == "stalled" else 200
             self._send(status, json.dumps(snap).encode(), "application/json")
         else:
@@ -307,17 +330,22 @@ def start_server(port: int, registry: typing.Optional[MetricsRegistry] = None,
                  health: typing.Optional[Health] = None,
                  host: str = "127.0.0.1",
                  slo_probe: typing.Optional[typing.Callable[[], dict]] = None,
-                 identity: typing.Optional[dict] = None) -> _ObsServer:
+                 identity: typing.Optional[dict] = None,
+                 alerts_probe: typing.Optional[
+                     typing.Callable[[], dict]] = None) -> _ObsServer:
     """Start the exporter on a daemon thread; ``port=0`` binds an ephemeral
     port (read it back from ``server.server_address[1]``).  ``slo_probe``
     (the REST layer's ``ServeSLO.summary``) adds a ``slo`` block to
     /healthz; ``identity`` (obs/fleet.py) adds the self-describing
-    ``identity`` block every fleet-scraped endpoint must carry."""
+    ``identity`` block every fleet-scraped endpoint must carry;
+    ``alerts_probe`` (obs/slo_alerts.py::SLOAlerts.summary) adds the SLO
+    burn-rate ``alerts`` block."""
     server = _ObsServer((host, port), _Handler)
     server.registry = registry if registry is not None else REGISTRY
     server.health = health
     server.slo_probe = slo_probe
     server.identity = identity
+    server.alerts_probe = alerts_probe
     thread = threading.Thread(target=server.serve_forever,
                               name="obs-exporter", daemon=True)
     server._thread = thread
@@ -406,13 +434,17 @@ class Watchdog(threading.Thread):
                  max_pause_s: typing.Optional[float] = None,
                  registry: typing.Optional[MetricsRegistry] = None,
                  extra_fn: typing.Optional[
-                     typing.Callable[[], dict]] = None):
+                     typing.Callable[[], dict]] = None,
+                 flight=None):
         super().__init__(name="obs-watchdog", daemon=True)
         self.health = health
         self.model_path = model_path
         #: optional {section: doc} provider inlined into each stall dump
         #: (Obs wires the fleet straggler summary here)
         self.extra_fn = extra_fn
+        #: optional flight recorder (obs/flight.py): a stall also writes
+        #: an incident bundle when its ``watchdog`` trigger is armed
+        self.flight = flight
         # stall visibility beyond the diagnostics dir: the supervisor and
         # alerting watch this counter on /metrics instead of scraping files
         reg = registry if registry is not None else REGISTRY
@@ -470,6 +502,12 @@ class Watchdog(threading.Thread):
         self.dumps.append(dump_diagnostics(
             self.model_path, h,
             reason=f"watchdog: {why}, last step {step}", extra=extra))
+        if self.flight is not None:
+            try:
+                self.flight.dump("watchdog", extra={"why": why,
+                                                    "last_step": step})
+            except Exception:  # noqa: BLE001 - the text dump already landed
+                pass
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop_evt.set()

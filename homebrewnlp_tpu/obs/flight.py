@@ -1,0 +1,325 @@
+"""Always-on serving flight recorder (docs/observability.md "Flight
+recorder").
+
+Aggregate histograms survive an incident; the evidence that EXPLAINS it
+(the slow request's span trail, the queue depth at the moment it was
+admitted) used to die with the request unless an operator was already
+tracing.  The recorder keeps that evidence in bounded memory at all
+times and pays for persistence only when something goes wrong:
+
+- a SPAN RING — the serving :class:`~homebrewnlp_tpu.obs.spans.SpanTracer`
+  capped at ``flight_buffer_spans`` events (the recorder snapshots it at
+  dump time; it never copies spans on the hot path);
+- REQUEST TRAILS — the last N finished :class:`RequestRecord` summaries
+  (timestamps, derived latencies, status, correlation id);
+- METRIC SNAPSHOTS — the registry's rendered text, captured at most once
+  per ``snapshot_interval_s`` on the request path and again at dump time.
+
+A TRIGGER (``flight_dump_triggers``: watchdog stall, 5xx response, SLO
+burn-rate alert, or manual ``POST /debugz/dump``) writes a self-contained
+incident bundle — spans, trails, snapshots, config hash, identity — to
+``<model_path>/diagnostics/flight_<ts>_<seq>.json``, rate-limited per
+reason so a 5xx storm produces one bundle, not thousands.
+
+TAIL-BASED SAMPLING: requests slower than the rolling p99 of recent e2e
+latencies keep their full trail flagged ``tail`` and are attached as
+OpenMetrics exemplars on the serve latency histograms
+(``obs/registry.py``) — the default Prometheus rendering is byte-
+identical whether or not exemplars exist; only the OpenMetrics flavor
+shows them.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import time
+import typing
+
+from ..sync import make_lock
+from .registry import sample_quantile
+
+#: every trigger reason a bundle can cite; ``flight_dump_triggers``
+#: entries are validated against this set at config load
+DUMP_TRIGGERS = ("watchdog", "error", "slo", "manual")
+
+#: bundle schema marker checked by :func:`validate_bundle`
+BUNDLE_SCHEMA = "hbnlp-flight-1"
+
+#: top-level keys every bundle must carry (validate_bundle contract)
+BUNDLE_KEYS = ("schema", "reason", "wall_time_s", "identity",
+               "config_hash", "spans", "requests", "metrics")
+
+
+def request_trail(rec) -> dict:
+    """One finished request's full trail as a JSON-ready dict — the
+    record's raw monotonic stamps plus every derived latency, keyed by
+    the propagated correlation id so grep-by-id works across client
+    logs, server logs, and bundles."""
+    trail = {
+        "rid": rec.rid,
+        "xid": getattr(rec, "xid", "") or "",
+        "path": rec.path,
+        "status": rec.status,
+        "queue_depth": rec.queue_depth,
+        "tokens_generated": rec.tokens_generated,
+    }
+    for attr in ("t_arrival", "t_parsed", "t_enqueued", "t_started",
+                 "t_first_token", "t_engine_done", "t_finished"):
+        trail[attr] = getattr(rec, attr)
+    for name, fn in (("e2e_s", rec.e2e_s), ("parse_s", rec.parse_s),
+                     ("queue_wait_s", rec.queue_wait_s),
+                     ("ttft_s", rec.ttft_s), ("prefill_s", rec.prefill_s),
+                     ("decode_s", rec.decode_s), ("engine_s", rec.engine_s),
+                     ("decode_tokens_per_sec", rec.decode_tokens_per_sec)):
+        try:
+            trail[name] = fn()
+        except Exception:  # noqa: BLE001 - a partial record still trails
+            trail[name] = None
+    gaps = rec.itl_gaps()
+    trail["itl_gaps_s"] = [round(g, 6) for g in gaps] if gaps else []
+    return trail
+
+
+def validate_bundle(doc: dict) -> typing.List[str]:
+    """Structural check of an incident bundle (CI and ``graftwatch
+    --dump`` both run it); returns the list of problems, empty = valid."""
+    problems = []
+    if not isinstance(doc, dict):
+        return ["bundle is not a JSON object"]
+    for key in BUNDLE_KEYS:
+        if key not in doc:
+            problems.append(f"missing key {key!r}")
+    if doc.get("schema") != BUNDLE_SCHEMA:
+        problems.append(
+            f"schema {doc.get('schema')!r} != {BUNDLE_SCHEMA!r}")
+    if doc.get("reason") not in DUMP_TRIGGERS:
+        problems.append(f"unknown reason {doc.get('reason')!r}")
+    spans = doc.get("spans")
+    if not isinstance(spans, list):
+        problems.append("spans is not a list")
+    else:
+        for i, s in enumerate(spans):
+            if not isinstance(s, dict) or not {"name", "t0_s",
+                                               "t1_s"} <= set(s):
+                problems.append(f"spans[{i}] lacks name/t0_s/t1_s")
+                break
+    reqs = doc.get("requests")
+    if not isinstance(reqs, list):
+        problems.append("requests is not a list")
+    else:
+        for i, r in enumerate(reqs):
+            if not isinstance(r, dict) or "rid" not in r:
+                problems.append(f"requests[{i}] lacks rid")
+                break
+    if not isinstance(doc.get("metrics"), str):
+        problems.append("metrics is not rendered text")
+    return problems
+
+
+class FlightRecorder:
+    """Bounded always-on evidence ring + trigger-gated bundle writer.
+
+    Thread-safety: REST handler threads call :meth:`observe_request` and
+    :meth:`dump` concurrently (and the watchdog thread may dump); all
+    mutable state sits behind one declared lock.  The span ring itself
+    lives in the shared ``SpanTracer`` (its own declared lock) — the
+    recorder only snapshots it inside :meth:`dump`."""
+
+    def __init__(self, max_spans: int = 4096, max_records: int = 64,
+                 max_snapshots: int = 4,
+                 triggers: typing.Sequence[str] = DUMP_TRIGGERS,
+                 model_path: str = "", config_hash: str = "",
+                 identity: typing.Optional[dict] = None,
+                 registry=None,
+                 tail_window: int = 128, tail_quantile: float = 0.99,
+                 tail_min_samples: int = 16,
+                 snapshot_interval_s: float = 30.0,
+                 min_dump_interval_s: float = 30.0):
+        self._lock = make_lock("obs.flight.FlightRecorder._lock")
+        self.max_spans = int(max_spans)
+        self.triggers = tuple(triggers)
+        self.model_path = str(model_path or "")
+        self.config_hash = str(config_hash or "")
+        self.identity = dict(identity or {})
+        self.registry = registry
+        #: the serving span tracer this recorder snapshots at dump time
+        #: (wired by ``serve/rest.py``; stays None in bare unit tests)
+        self.tracer = None
+        self._records: "collections.deque[dict]" = collections.deque(
+            maxlen=int(max_records))
+        self._snapshots: "collections.deque[dict]" = collections.deque(
+            maxlen=int(max_snapshots))
+        self._e2e: "collections.deque[float]" = collections.deque(
+            maxlen=int(tail_window))
+        self._tail_quantile = float(tail_quantile)
+        self._tail_min = int(tail_min_samples)
+        self._snapshot_interval_s = float(snapshot_interval_s)
+        self._min_dump_interval_s = float(min_dump_interval_s)
+        self._last_snapshot_t = 0.0
+        self._last_dump: typing.Dict[str, float] = {}
+        self._seq = itertools.count(1)
+        self._alerts_probe: typing.Optional[typing.Callable] = None
+        #: bundle paths written this process (newest last)
+        self.dumps: typing.List[str] = []
+
+    def set_alerts_probe(self, fn: typing.Optional[typing.Callable]
+                         ) -> None:
+        """Attach the SLO evaluator's ``summary`` so bundles carry the
+        alert state at the moment of the incident."""
+        with self._lock:
+            self._alerts_probe = fn
+
+    # -- hot path ------------------------------------------------------------
+    def observe_request(self, rec) -> dict:
+        """Retain one finished request's trail; tail-sample it against
+        the rolling p99 and attach exemplars on the serve latency
+        histograms when it qualifies.  Returns the trail (the REST layer
+        reuses it for the ``error`` trigger's bundle extra)."""
+        trail = request_trail(rec)
+        e2e = trail.get("e2e_s")
+        now = time.time()
+        with self._lock:
+            tail = False
+            if e2e is not None:
+                if len(self._e2e) >= self._tail_min:
+                    p = sample_quantile(list(self._e2e),
+                                        self._tail_quantile)
+                    tail = p is not None and e2e >= p
+                self._e2e.append(float(e2e))
+            trail["tail"] = tail
+            self._records.append(trail)
+            snap = (self.registry is not None
+                    and now - self._last_snapshot_t
+                    >= self._snapshot_interval_s)
+            if snap:
+                self._last_snapshot_t = now
+        if snap:
+            self._snapshot_metrics(now)
+        if tail:
+            self._attach_exemplars(trail)
+        return trail
+
+    def _attach_exemplars(self, trail: dict) -> None:
+        if self.registry is None:
+            return
+        labels = {"request_id": trail["xid"] or str(trail["rid"])}
+        for metric, value, kw in (
+                ("hbnlp_serve_request_seconds", trail.get("e2e_s"),
+                 {"path": trail["path"]}),
+                ("hbnlp_serve_ttft_seconds", trail.get("ttft_s"), {})):
+            if value is None:
+                continue
+            hist = self.registry.get(metric)
+            if hist is None or not hasattr(hist, "attach_exemplar"):
+                continue
+            try:
+                hist.attach_exemplar(float(value), labels, **kw)
+            except ValueError:
+                pass  # label mismatch on a foreign registry: skip, don't 500
+
+    def _snapshot_metrics(self, now: float) -> None:
+        try:
+            text = self.registry.render()
+        except Exception:  # noqa: BLE001 - snapshots are best-effort
+            return
+        with self._lock:
+            self._snapshots.append({"wall_time_s": now, "metrics": text})
+
+    # -- dumping -------------------------------------------------------------
+    def wants(self, reason: str) -> bool:
+        return reason in self.triggers
+
+    def dump(self, reason: str,
+             extra: typing.Optional[dict] = None,
+             force: bool = False) -> typing.Optional[str]:
+        """Write an incident bundle for ``reason``; returns its path, or
+        None when the reason is not an armed trigger or the per-reason
+        rate limit holds (``force`` — the manual endpoint — bypasses
+        both)."""
+        now = time.time()
+        if not force:
+            if reason not in self.triggers:
+                return None
+            with self._lock:
+                last = self._last_dump.get(reason, 0.0)
+                if now - last < self._min_dump_interval_s:
+                    return None
+                self._last_dump[reason] = now
+        doc = self.bundle(reason, extra=extra, now=now)
+        out_dir = os.path.join(self.model_path or ".", "diagnostics")
+        os.makedirs(out_dir, exist_ok=True)
+        stamp = time.strftime("%Y%m%d_%H%M%S", time.localtime(now))
+        path = os.path.join(out_dir,
+                            f"flight_{stamp}_{next(self._seq)}.json")
+        try:
+            with open(path, "w") as f:
+                json.dump(doc, f, default=str)
+        except OSError:
+            return None
+        with self._lock:
+            self.dumps.append(path)
+        return path
+
+    def bundle(self, reason: str, extra: typing.Optional[dict] = None,
+               now: typing.Optional[float] = None) -> dict:
+        """The self-contained incident document (also what ``POST
+        /debugz/dump`` returns inline)."""
+        now = time.time() if now is None else now
+        tracer = self.tracer
+        spans = []
+        if tracer is not None:
+            try:
+                spans = tracer.snapshot_events(limit=self.max_spans)
+            except Exception:  # noqa: BLE001 - spans are evidence, not a gate
+                spans = []
+        metrics = ""
+        if self.registry is not None:
+            try:
+                metrics = self.registry.render()
+            except Exception:  # noqa: BLE001
+                metrics = ""
+        with self._lock:
+            requests = list(self._records)
+            snapshots = list(self._snapshots)
+            probe = self._alerts_probe
+        alerts = None
+        if probe is not None:
+            try:
+                alerts = probe()
+            except Exception:  # noqa: BLE001
+                alerts = None
+        doc = {
+            "schema": BUNDLE_SCHEMA,
+            "reason": reason,
+            "wall_time_s": now,
+            "identity": self.identity,
+            "config_hash": self.config_hash,
+            "triggers": list(self.triggers),
+            "spans": spans,
+            "requests": requests,
+            "snapshots": snapshots,
+            "metrics": metrics,
+            "alerts": alerts,
+        }
+        if extra:
+            doc["extra"] = extra
+        return doc
+
+    def status(self) -> dict:
+        """The ``GET /debugz/flight`` payload."""
+        tracer = self.tracer
+        # the tracer count is read BEFORE taking the recorder lock: the
+        # tracer has its own declared lock, and nesting it under ours
+        # would add a lock-order edge no other path needs
+        n_spans = tracer.event_count() if tracer is not None else 0
+        with self._lock:
+            return {
+                "triggers": list(self.triggers),
+                "max_spans": self.max_spans,
+                "n_requests": len(self._records),
+                "n_snapshots": len(self._snapshots),
+                "n_spans": n_spans,
+                "dumps": list(self.dumps),
+            }

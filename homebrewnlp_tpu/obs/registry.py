@@ -24,6 +24,7 @@ import math
 import re
 import sys
 import threading
+import time
 import typing
 
 try:
@@ -40,6 +41,11 @@ except ImportError:  # loaded by file path (tools/supervise.py _load_light)
 
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+#: exemplars retained per histogram (across all children/buckets) — the
+#: flight recorder's tail sampler attaches at most one per (labels,
+#: bucket), and insertion-order eviction bounds the rest
+EXEMPLAR_CAP = 64
 
 # latency-oriented default buckets (seconds), Prometheus-conventional
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
@@ -222,6 +228,12 @@ class _Metric:
             lines.extend(self._render_child(values, child))
         return lines
 
+    def render_openmetrics(self) -> typing.List[str]:
+        """OpenMetrics-flavored family rendering; identical to
+        :meth:`render` except where a subclass has exemplars to attach
+        (histograms)."""
+        return self.render()
+
 
 class _Bound:
     """A metric bound to one label-value combination."""
@@ -355,6 +367,11 @@ class Histogram(_Metric):
                  buckets: typing.Sequence[float] = DEFAULT_BUCKETS):
         super().__init__(registry, name, help_text, labelnames)
         self.buckets = tuple(sorted(float(b) for b in buckets))
+        # OpenMetrics exemplars: {(label-values, bucket_i): (value,
+        # labels, wall_ts)} in insertion order (eviction pops oldest);
+        # NEVER rendered on the default Prometheus path — the fleet
+        # parser's byte-identical contract holds with or without these
+        self._exemplars: typing.Dict[tuple, tuple] = {}
 
     def _make_child(self):
         # per-bucket counts (non-cumulative) + [sum, count]
@@ -414,20 +431,80 @@ class Histogram(_Metric):
                 counts = list(child["counts"])
         return bucket_quantile(self.buckets, counts, q)
 
-    def _render_child(self, values, child):
+    def attach_exemplar(self, value: float, exemplar_labels: dict,
+                        **labels) -> None:
+        """Attach an OpenMetrics exemplar (e.g. ``{"request_id": ...}``)
+        on the bucket ``value`` falls into for the given label
+        combination.  At most one exemplar per (labels, bucket); the
+        histogram keeps at most :data:`EXEMPLAR_CAP` total, evicting the
+        oldest attachment.  Invisible to the default Prometheus
+        rendering — only :meth:`render_openmetrics` shows them."""
+        if set(labels) != set(self.labelnames):
+            raise ValueError(f"{self.name}: expected labels "
+                             f"{self.labelnames}, got {sorted(labels)}")
+        values = tuple(str(labels[n]) for n in self.labelnames)
+        v = float(value)
+        i = len(self.buckets)
+        for j, b in enumerate(self.buckets):
+            if v <= b:
+                i = j
+                break
+        ex = (v, {str(k): str(x) for k, x in exemplar_labels.items()},
+              time.time())
+        with self._registry._lock:
+            key = (values, i)
+            self._exemplars.pop(key, None)  # re-attach moves to newest
+            self._exemplars[key] = ex
+            while len(self._exemplars) > EXEMPLAR_CAP:
+                self._exemplars.pop(next(iter(self._exemplars)))
+
+    def exemplars(self) -> typing.Dict[tuple, tuple]:
+        """Snapshot of attached exemplars (tests + graftwatch)."""
+        with self._registry._lock:
+            return dict(self._exemplars)
+
+    def _render_child(self, values, child, openmetrics: bool = False):
+        with self._registry._lock:
+            exemplars = ({k[1]: v for k, v in self._exemplars.items()
+                          if k[0] == values} if openmetrics else {})
         lines = []
         cum = 0
-        for b, c in zip(self.buckets, child["counts"]):
+        for j, (b, c) in enumerate(zip(self.buckets, child["counts"])):
             cum += c
             labels = _label_str(self.labelnames + ("le",),
                                 values + (_fmt(b),))
-            lines.append(f"{self.name}_bucket{labels} {cum}")
+            line = f"{self.name}_bucket{labels} {cum}"
+            if j in exemplars:
+                ev, elabels, ets = exemplars[j]
+                line += (" # " + _label_str(tuple(elabels),
+                                            tuple(elabels.values()))
+                         + f" {_fmt(ev)} {ets:.3f}")
+            lines.append(line)
         cum += child["counts"][-1]
         labels = _label_str(self.labelnames + ("le",), values + ("+Inf",))
-        lines.append(f"{self.name}_bucket{labels} {cum}")
+        line = f"{self.name}_bucket{labels} {cum}"
+        if len(self.buckets) in exemplars:
+            ev, elabels, ets = exemplars[len(self.buckets)]
+            line += (" # " + _label_str(tuple(elabels),
+                                        tuple(elabels.values()))
+                     + f" {_fmt(ev)} {ets:.3f}")
+        lines.append(line)
         base = _label_str(self.labelnames, values)
         lines.append(f"{self.name}_sum{base} {_fmt(child['sum'])}")
         lines.append(f"{self.name}_count{base} {child['count']}")
+        return lines
+
+    def render_openmetrics(self) -> typing.List[str]:
+        """Family rendering with exemplar suffixes on bucket lines
+        (``... # {request_id="..."} value timestamp``) — the tail-sampled
+        slow-request trails the flight recorder attaches."""
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._registry._lock:
+            items = sorted(self._children.items())
+        for values, child in items:
+            lines.extend(self._render_child(values, child,
+                                            openmetrics=True))
         return lines
 
 
@@ -497,6 +574,21 @@ class MetricsRegistry:
         for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + "\n" if lines else ""
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics-flavored exposition: the same families as
+        :meth:`render` plus exemplar suffixes on histogram bucket lines
+        and the closing ``# EOF`` marker.  Served by the exporter when a
+        scraper asks for ``application/openmetrics-text``; the default
+        rendering stays byte-identical whether exemplars exist or not
+        (the fleet parser's compatibility contract)."""
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        lines: typing.List[str] = []
+        for m in metrics:
+            lines.extend(m.render_openmetrics())
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
 
 
 #: process-default registry: the train loop, feeder, metric drain, and REST

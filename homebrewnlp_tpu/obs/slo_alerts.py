@@ -1,0 +1,267 @@
+"""Multi-window SLO burn-rate alerting (docs/observability.md "SLO
+alerting").
+
+The serving layer measures TTFT/e2e/queue-wait and error rate into
+histograms, but nothing EVALUATED them: a p99 breach was visible only if
+an operator happened to be scraping ``/healthz`` at the time.  This
+module turns the declared objectives (``slo_objectives`` config knob)
+into the standard multi-window burn-rate signal:
+
+- each objective defines a per-request BREACH predicate and an ERROR
+  BUDGET — ``"error_rate": 0.01`` breaches on 5xx with budget 0.01;
+  ``"ttft_p95_s": 2.0`` breaches when TTFT exceeds 2.0 s with budget
+  0.05 (the ``p95`` in the key: 5% of requests may miss);
+- the burn rate over a window is ``breach fraction / budget`` — 1.0
+  means the budget is being spent exactly as fast as it accrues, >1
+  means it is being burned;
+- an alert FIRES when the burn rate exceeds :data:`ALERT_THRESHOLD` in
+  BOTH the fast and the slow window (:data:`WINDOWS`) — the classic
+  Google-SRE shape: the slow window keeps a transient blip from paging,
+  the fast window makes the page reset quickly once the breach stops.
+
+Everything is evaluated lazily on the caller's thread: ``observe()`` is
+called per finished request (the REST handler's ``finally``), burn-rate
+gauges are render-time callbacks on ``hbnlp_slo_burn_rate{objective,
+window}``, and the ``/healthz`` ``alerts`` block re-evaluates on read —
+no evaluator thread exists, so an idle server pays nothing.  Firing
+TRANSITIONS invoke ``on_alert`` (the flight recorder's ``slo`` dump
+trigger) outside the evaluator's lock.  The labelled gauges federate
+through ``obs/fleet.py`` like any other gauge (min/mean/max across
+ranks; 0.0 is a real measurement, so no sentinel entry is needed).
+"""
+from __future__ import annotations
+
+import collections
+import time
+import typing
+
+from ..sync import make_lock
+
+#: metrics a latency objective may target — ``<metric>_p<NN>_s``
+OBJECTIVE_METRICS = ("ttft", "e2e", "queue_wait")
+
+#: (name, seconds) evaluation windows; an alert fires only when the burn
+#: rate exceeds the threshold in EVERY window (fast AND slow)
+WINDOWS = (("fast", 60.0), ("slow", 600.0))
+
+#: burn rate above which an objective's alert fires (in all windows);
+#: 1.0 = the error budget is being spent faster than it accrues
+ALERT_THRESHOLD = 1.0
+
+
+class Objective(typing.NamedTuple):
+    """One parsed SLO: ``breach(status, measurements)`` semantics are
+    derived from the key — see :func:`parse_objective`."""
+
+    key: str
+    kind: str          # "error_rate" | "latency"
+    metric: str        # "" for error_rate, else ttft/e2e/queue_wait
+    threshold: float   # latency bound in seconds, or the error budget
+    budget: float      # error budget as a fraction of requests
+
+
+def parse_objective(key: str, threshold) -> Objective:
+    """Parse one ``slo_objectives`` entry; raises ``ValueError`` naming
+    exactly what is wrong (config load surfaces typos, not silence)."""
+    try:
+        threshold = float(threshold)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"slo_objectives[{key!r}] threshold {threshold!r} is not a "
+            "number") from None
+    if threshold <= 0:
+        raise ValueError(
+            f"slo_objectives[{key!r}]={threshold} must be > 0 "
+            "(a zero budget/bound can never be met)")
+    if key == "error_rate":
+        if threshold >= 1.0:
+            raise ValueError(
+                f"slo_objectives['error_rate']={threshold} must be < 1 "
+                "(it is the error budget as a fraction of requests)")
+        return Objective(key, "error_rate", "", threshold, threshold)
+    parts = key.rsplit("_p", 1)
+    if len(parts) == 2 and parts[1].endswith("_s"):
+        metric, pct = parts[0], parts[1][:-2]
+        if metric in OBJECTIVE_METRICS and pct.isdigit():
+            p = int(pct)
+            if not 0 < p < 100:
+                raise ValueError(
+                    f"slo_objectives[{key!r}]: percentile p{p} must be in "
+                    "(0, 100)")
+            return Objective(key, "latency", metric, threshold,
+                             1.0 - p / 100.0)
+    raise ValueError(
+        f"slo_objectives key {key!r} is not a known objective: use "
+        "'error_rate' or '<metric>_p<NN>_s' with metric in "
+        f"{'/'.join(OBJECTIVE_METRICS)} (e.g. 'ttft_p95_s')")
+
+
+def validate_objectives(objectives: dict) -> dict:
+    """Config-load validation hook: parse every entry, return the
+    normalized ``{key: float(threshold)}`` dict."""
+    return {k: parse_objective(k, v).threshold
+            for k, v in objectives.items()}
+
+
+def _breached(ob: Objective, status: int,
+              values: typing.Dict[str, typing.Optional[float]]
+              ) -> typing.Optional[bool]:
+    """Whether one finished request breached ``ob`` — None means the
+    request does not count toward this objective's window total (a
+    successful request that never reached the measured milestone, e.g. a
+    zero-token completion with no TTFT)."""
+    if ob.kind == "error_rate":
+        return status >= 500
+    v = values.get(ob.metric)
+    if v is None:
+        # failed before the milestone: a 5xx with no TTFT is a breach;
+        # a 2xx with no stamp is simply not a sample
+        return True if status >= 500 else None
+    return v > ob.threshold
+
+
+class SLOAlerts:
+    """Per-request breach bookkeeping + lazy multi-window burn rates.
+
+    Thread-safety: ``observe`` runs on REST handler threads and the
+    burn-rate gauge callbacks run on the exporter's render thread, so
+    all state is guarded by one declared lock.  ``on_alert`` (and any
+    other callback) is invoked OUTSIDE the lock."""
+
+    def __init__(self, objectives: dict,
+                 registry=None,
+                 windows: typing.Sequence[tuple] = WINDOWS,
+                 threshold: float = ALERT_THRESHOLD,
+                 on_alert: typing.Optional[typing.Callable] = None):
+        self._lock = make_lock("obs.slo_alerts.SLOAlerts._lock")
+        self.objectives = tuple(parse_objective(k, v)
+                                for k, v in sorted(objectives.items()))
+        self.windows = tuple((str(n), float(s)) for n, s in windows)
+        self.threshold = float(threshold)
+        self._horizon_s = max(s for _, s in self.windows)
+        #: (wall_s, status, {metric: value}) per finished request,
+        #: pruned to the slow window on every touch — bounded by traffic
+        #: over the horizon, never by uptime
+        self._events: "collections.deque[tuple]" = collections.deque()
+        self._firing: typing.Dict[str, float] = {}  # key -> since wall_s
+        self._on_alert = on_alert
+        if registry is not None:
+            g = registry.gauge(
+                "hbnlp_slo_burn_rate",
+                "error-budget burn rate per declared objective and window "
+                "(window breach fraction / budget; >1 = budget burning "
+                "faster than it accrues)",
+                labelnames=("objective", "window"))
+            for ob in self.objectives:
+                for wname, _ in self.windows:
+                    g.labels(objective=ob.key, window=wname).set_function(
+                        self._gauge_fn(ob.key, wname))
+
+    def _gauge_fn(self, key: str, window: str) -> typing.Callable:
+        return lambda: self.burn_rates().get(key, {}).get(window, 0.0)
+
+    # -- ingestion -----------------------------------------------------------
+    def observe(self, status: int,
+                ttft_s: typing.Optional[float] = None,
+                e2e_s: typing.Optional[float] = None,
+                queue_wait_s: typing.Optional[float] = None,
+                now: typing.Optional[float] = None) -> None:
+        """Record one finished request and re-evaluate firing edges."""
+        now = time.time() if now is None else now
+        values = {"ttft": ttft_s, "e2e": e2e_s, "queue_wait": queue_wait_s}
+        with self._lock:
+            self._events.append((now, int(status), values))
+            self._prune(now)
+            fired = self._transitions(now)
+        for key, info in fired:
+            if self._on_alert is not None:
+                try:
+                    self._on_alert(key, info)
+                except Exception:  # noqa: BLE001 - alerting must not 500 serving
+                    pass
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self._horizon_s
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+    # -- evaluation ----------------------------------------------------------
+    def _rates_locked(self, now: float) -> typing.Dict[str, dict]:
+        out: typing.Dict[str, dict] = {}
+        for ob in self.objectives:
+            per = {}
+            for wname, wsec in self.windows:
+                total = breached = 0
+                for t, status, values in self._events:
+                    if t < now - wsec:
+                        continue
+                    b = _breached(ob, status, values)
+                    if b is None:
+                        continue
+                    total += 1
+                    breached += bool(b)
+                per[wname] = ((breached / total) / ob.budget
+                              if total else 0.0)
+            out[ob.key] = per
+        return out
+
+    def _transitions(self, now: float) -> typing.List[tuple]:
+        """Update firing state; returns the objectives that JUST fired
+        (rising edge) as ``(key, info)`` for the on_alert callback."""
+        rates = self._rates_locked(now)
+        fired = []
+        for ob in self.objectives:
+            per = rates[ob.key]
+            hot = all(per[w] > self.threshold for w, _ in self.windows)
+            if hot and ob.key not in self._firing:
+                self._firing[ob.key] = now
+                fired.append((ob.key, {"objective": ob.key,
+                                       "burn_rates": dict(per),
+                                       "threshold": ob.threshold,
+                                       "budget": ob.budget,
+                                       "since_s": now}))
+            elif not hot and ob.key in self._firing:
+                del self._firing[ob.key]
+        return fired
+
+    def burn_rates(self, now: typing.Optional[float] = None
+                   ) -> typing.Dict[str, dict]:
+        """``{objective: {window: burn_rate}}`` right now (0.0 with no
+        samples in the window — no traffic burns no budget)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._prune(now)
+            return self._rates_locked(now)
+
+    def alerts(self, now: typing.Optional[float] = None
+               ) -> typing.List[dict]:
+        """Per-objective alert rows for the ``/healthz`` ``alerts``
+        block; re-evaluates transitions so an alert CLEARS as its
+        windows drain even with no new traffic."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._prune(now)
+            self._transitions(now)
+            rates = self._rates_locked(now)
+            firing = dict(self._firing)
+        rows = []
+        for ob in self.objectives:
+            rows.append({
+                "objective": ob.key,
+                "threshold": ob.threshold,
+                "budget": ob.budget,
+                "burn_rates": {w: round(r, 6)
+                               for w, r in rates[ob.key].items()},
+                "firing": ob.key in firing,
+                "since_s": firing.get(ob.key),
+            })
+        return rows
+
+    def summary(self, now: typing.Optional[float] = None) -> dict:
+        """The ``/healthz`` payload: alert rows + the firing subset."""
+        rows = self.alerts(now)
+        return {"threshold": self.threshold,
+                "windows": {n: s for n, s in self.windows},
+                "objectives": [r["objective"] for r in rows],
+                "firing": [r["objective"] for r in rows if r["firing"]],
+                "alerts": rows}

@@ -161,6 +161,30 @@ class SpanTracer:
             self._totals[name] = self._totals.get(name, 0.0) + (t1 - t0)
 
     # -- export --------------------------------------------------------------
+    def event_count(self) -> int:
+        """Spans currently held in the ring (the rotation trigger)."""
+        with self._lock:
+            return len(self._events)
+
+    def snapshot_events(self, limit: typing.Optional[int] = None
+                        ) -> typing.List[dict]:
+        """The most recent ``limit`` spans as JSON-ready dicts anchored to
+        WALL-CLOCK seconds (``t0_s``/``t1_s``) — the flight recorder's
+        bundle format, directly comparable across processes without the
+        per-tracer perf_counter epoch."""
+        with self._lock:
+            events = list(self._events)
+            names = dict(self._thread_names)
+            wall = self._wall_epoch
+        if limit is not None:
+            events = events[-limit:]
+        return [{"name": name,
+                 "t0_s": round(wall + t0, 6),
+                 "t1_s": round(wall + t1, 6),
+                 "track": names.get(tid, str(tid)),
+                 "args": {k: str(v) for k, v in args.items()}}
+                for name, t0, t1, tid, args in events]
+
     def chrome_events(self) -> typing.List[dict]:
         """Chrome trace-event dicts: complete ('X') events plus thread/process
         name metadata ('M') events.  Timestamps are microseconds from tracer
@@ -185,19 +209,40 @@ class SpanTracer:
             out.append(ev)
         return out
 
+    def chrome_trace(self) -> dict:
+        """The full Perfetto-loadable document as an in-memory dict —
+        what :meth:`export` writes, also served live by the REST layer's
+        ``GET /debugz/trace`` so ``graftload --trace-out`` can merge
+        server spans without filesystem access to the server."""
+        with self._lock:
+            dropped = self._recorded - len(self._events)
+        return {"traceEvents": self.chrome_events(),
+                "displayTimeUnit": "ms",
+                "otherData": {"wall_epoch": self._wall_epoch,
+                              "pid": self._pid,
+                              "dropped_events": dropped}}
+
     def export(self, path: str) -> str:
         """Write the Perfetto-loadable trace JSON; returns the path."""
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with self._lock:
-            dropped = self._recorded - len(self._events)
-        doc = {"traceEvents": self.chrome_events(),
-               "displayTimeUnit": "ms",
-               "otherData": {"wall_epoch": self._wall_epoch,
-                             "pid": self._pid,
-                             "dropped_events": dropped}}
+        doc = self.chrome_trace()
         with open(path, "w") as f:
             json.dump(doc, f)
         return path
+
+    def rotate(self, path: str) -> str:
+        """Export the current ring to ``path`` and CLEAR it (track/thread
+        names and phase totals survive, so later segments keep their
+        swimlane labels and bench sums stay exact).  The serving engine
+        rotates whenever the ring fills, so a crash loses at most one
+        ring of spans instead of the whole trace."""
+        out = self.export(path)
+        with self._lock:
+            self._events.clear()
+            # the exported spans were persisted, not dropped: reset the
+            # drop accounting so later segments report only real ring loss
+            self._recorded = 0
+        return out
 
     def phase_totals(self) -> typing.Dict[str, float]:
         """Total seconds per span name — the flat per-phase breakdown bench.py

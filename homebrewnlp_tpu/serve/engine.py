@@ -466,9 +466,16 @@ class BatchEngine:
         # close(), alongside the training trace's format
         self.tracer = None
         self._trace_path = str(getattr(cfg, "serve_trace_path", "") or "")
+        # flight_buffer_spans caps the ring AND arms rotation: when the
+        # ring fills, the full segment rolls to <path>.NNN.json instead of
+        # silently evicting — a crash loses at most one ring of spans
+        self._trace_cap = int(getattr(cfg, "flight_buffer_spans", 0) or 0)
+        self._trace_seq = 0
+        self.trace_segments: typing.List[str] = []
         if self._trace_path:
             from ..obs.spans import SpanTracer
-            self.tracer = SpanTracer()
+            self.tracer = (SpanTracer(max_events=self._trace_cap)
+                           if self._trace_cap else SpanTracer())
         self._rid = 0
         self._pad_rng = np.random.default_rng(cfg.data_seed)
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -686,7 +693,7 @@ class BatchEngine:
         starves a big one already at the head).
 
         ``prefill_segs`` collects each prefill dispatch's
-        ``(t0, t1, lane, rid)`` host segment; ``stall[0]`` accumulates
+        ``(t0, t1, lane, rid, xid)`` host segment; ``stall[0]`` accumulates
         stalled-lane-seconds — the monolithic path's BLOCKING prefill wall
         times the lanes that held active requests while the scheduler
         thread was pinned (docs/observability.md).  The chunked path never
@@ -779,7 +786,8 @@ class BatchEngine:
             self._fail_admission(req, e)
             return
         t_p1 = time.perf_counter()
-        prefill_segs.append((t_p0, t_p1, lane, req.rid))
+        prefill_segs.append((t_p0, t_p1, lane, req.rid,
+                             rec.xid if rec is not None else ""))
         stall[0] += (t_p1 - t_p0) * n_stalled
         self._lane_req[lane] = req
         self._arm_lane(req, lane)
@@ -835,7 +843,8 @@ class BatchEngine:
             self._fail_admission(req, e)
             return
         t_c1 = time.perf_counter()
-        prefill_segs.append((t_c0, t_c1, lane, req.rid))
+        prefill_segs.append((t_c0, t_c1, lane, req.rid,
+                             req.rec.xid if req.rec is not None else ""))
         req.next_chunk_row += self._chunk_rows
         if req.next_chunk_row >= req.prefill_rows:
             self._prefill_fifo.pop(0)
@@ -966,6 +975,8 @@ class BatchEngine:
             args = {"rid": req.rid}
             if rec is not None:
                 args["request"] = rec.rid
+                if rec.xid:
+                    args["xid"] = rec.xid
             self.tracer.add("occupied", req.t_admitted, time.perf_counter(),
                             track=f"lane{lane}", **args)
         self._lane_req[lane] = None
@@ -1023,7 +1034,7 @@ class BatchEngine:
         wall = t_end - t0
         if wall <= 0 or not segs:
             return
-        prefill_s = sum(t1 - t0_ for t0_, t1, _, _ in prefill_segs)
+        prefill_s = sum(t1 - t0_ for t0_, t1, *_ in prefill_segs)
         phases = {name: 0.0 for name in slo.STEP_PHASES}
         for name, s0, s1 in segs:
             phases[name] = phases.get(name, 0.0) + (s1 - s0)
@@ -1041,10 +1052,31 @@ class BatchEngine:
             tracer.add("engine/step", t0, t_end, active=n_active)
             for name, s0, s1 in segs:
                 tracer.add(f"engine/{name}", s0, s1)
-            for s0, s1, lane, rid in prefill_segs:
-                tracer.add("engine/prefill", s0, s1, rid=rid)
+            for s0, s1, lane, rid, xid in prefill_segs:
+                args = {"rid": rid}
+                if xid:
+                    args["xid"] = xid
+                tracer.add("engine/prefill", s0, s1, **args)
                 tracer.add("prefilling", s0, s1, track=f"lane{lane}",
-                           rid=rid)
+                           **args)
+            if (self._trace_path and self._trace_cap
+                    and tracer.event_count() >= self._trace_cap):
+                self._rotate_trace()
+
+    def _rotate_trace(self) -> None:
+        """Roll the filled span ring out to the next ``<path>.NNN.json``
+        segment and clear it: the capped serving trace persists in rolling
+        segments instead of silently evicting its oldest spans, so a crash
+        loses at most one ring (docs/observability.md "Request tracing").
+        ``close()``'s final :meth:`export_trace` still writes the base
+        path with whatever the last partial ring holds."""
+        base, ext = os.path.splitext(self._trace_path)
+        self._trace_seq += 1
+        path = f"{base}.{self._trace_seq:03d}{ext or '.json'}"
+        try:
+            self.trace_segments.append(self.tracer.rotate(path))
+        except OSError:
+            pass  # tracing is evidence, not a gate
 
     def _pool_deleted(self) -> bool:
         """Whether a donated call consumed the pooled device state without

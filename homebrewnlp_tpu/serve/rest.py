@@ -16,6 +16,7 @@ import queue
 import threading
 import time
 import typing
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -49,6 +50,21 @@ def request_metrics(registry=None):
 def _sanitize_tokens(tokens: typing.Sequence[int], vocab: int) -> typing.List[int]:
     # the reference clamps out-of-vocab ids (rest_api.py:42-53)
     return [min(max(int(t), 0), vocab - 1) for t in tokens]
+
+
+def _request_xid(headers) -> str:
+    """Resolve the request's correlation id: the client's ``X-Request-Id``
+    if present, else the trace-id field of a W3C ``traceparent`` header,
+    else a fresh server-generated id.  Capped so a hostile header cannot
+    bloat logs/spans; the id is echoed back on every response."""
+    xid = (headers.get("X-Request-Id") or "").strip()
+    if not xid:
+        parts = (headers.get("traceparent") or "").strip().split("-")
+        if len(parts) >= 2 and parts[1] and parts[1].strip("0"):
+            xid = parts[1]
+    if not xid:
+        xid = uuid.uuid4().hex[:16]
+    return xid[:128]
 
 
 class RestAPI:
@@ -314,16 +330,87 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
         wrapper.set_step_observer(serve_slo.observe_step)
     if wrapper is not None and hasattr(wrapper, "lane_count"):
         serve_slo.set_lane_count(wrapper.lane_count())
-    engine_tracer = getattr(getattr(api, "engine", None), "tracer", None)
-    if engine_tracer is not None:
-        serve_slo.tracer = engine_tracer
+    # -- tracing + flight recorder + SLO alerting (docs/observability.md
+    # "Request tracing" / "Flight recorder" / "SLO alerting").  One shared
+    # SpanTracer carries request trails, engine phases, and lane timelines:
+    # the engine's own (serve_trace_path) when it made one, else a fresh
+    # ring sized by flight_buffer_spans handed TO the engine so its spans
+    # land in the same trace GET /debugz/trace serves.
+    cap = (int(getattr(cfg, "flight_buffer_spans", 0) or 0)
+           if cfg is not None else 0)
+    engine = getattr(api, "engine", None)
+    tracer = getattr(engine, "tracer", None)
+    if tracer is None and cap > 0:
+        tracer = spans.SpanTracer(max_events=cap)
+        if engine is not None and hasattr(engine, "tracer"):
+            # the scheduler thread only READS this attribute; assignment
+            # happens here, before any request reaches the engine
+            engine.tracer = tracer
+    if tracer is not None:
+        serve_slo.tracer = tracer
+    flight = None
+    alerts = None
+    if cap > 0 and cfg is not None:
+        from ..obs import fleet
+        from ..obs.flight import FlightRecorder
+        from ..train.metrics import config_hash
+        try:
+            chash = config_hash(cfg)
+        except Exception:  # noqa: BLE001 - hash is evidence, not a gate
+            chash = ""
+        flight = FlightRecorder(
+            max_spans=cap,
+            triggers=tuple(getattr(cfg, "flight_dump_triggers",
+                                   ("watchdog", "error", "slo", "manual"))),
+            model_path=str(getattr(cfg, "model_path", "") or ""),
+            config_hash=chash,
+            identity=fleet.identity(cfg),
+            registry=registry if registry is not None else REGISTRY)
+        flight.tracer = tracer
+    objectives = (dict(getattr(cfg, "slo_objectives", {}) or {})
+                  if cfg is not None else {})
+    if objectives:
+        from ..obs.slo_alerts import SLOAlerts
+        on_alert = None
+        if flight is not None and flight.wants("slo"):
+            def on_alert(key, info, _flight=flight):
+                _flight.dump("slo", extra={"alert": info})
+        alerts = SLOAlerts(objectives,
+                           registry=(registry if registry is not None
+                                     else REGISTRY), on_alert=on_alert)
+        if flight is not None:
+            flight.set_alerts_probe(alerts.summary)
 
     class Handler(BaseHTTPRequestHandler):
+        #: in-flight record for the correlation-header hook (end_headers);
+        #: reset per request — the handler instance outlives one request
+        _rec = None
+        _wall_recv = 0.0
+
+        def end_headers(self):
+            # one choke point every response path funnels through
+            # (send_error included): echo the correlation id + the wall
+            # clocks graftload pairs into its clock-offset estimate
+            rec = self._rec
+            if rec is not None and rec.xid:
+                self.send_header("X-Request-Id", rec.xid)
+                self.send_header("X-Server-Recv-S",
+                                 f"{self._wall_recv:.6f}")
+                self.send_header("X-Server-Send-S", f"{time.time():.6f}")
+            super().end_headers()
+
         def do_POST(self):
+            if self.path.rstrip("/") == "/debugz/dump":
+                self._rec = None
+                self._debugz_dump()
+                return
+            self._wall_recv = time.time()
             name = self.path.strip("/")
             known = name in endpoints
             label = f"/{name}" if known else "other"
             rec = serve_slo.begin(label)
+            rec.xid = _request_xid(self.headers)
+            self._rec = rec
             prev = slo_mod.set_current(rec)
             status = 500
             try:
@@ -381,8 +468,68 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                                  status=str(status)).inc()
                 req_latency.labels(path=label).observe(dt)
                 serve_slo.finish(rec, status)
-                LOG.debug("request id=%d method=POST path=%s status=%d "
-                          "latency_ms=%.1f", rec.rid, label, status, dt * 1e3)
+                if flight is not None:
+                    try:
+                        trail = flight.observe_request(rec)
+                        if status >= 500 and flight.wants("error"):
+                            flight.dump("error",
+                                        extra={"request": trail})
+                    except Exception:  # noqa: BLE001 - evidence, not a gate
+                        pass
+                if alerts is not None:
+                    try:
+                        alerts.observe(status=status, ttft_s=rec.ttft_s(),
+                                       e2e_s=rec.e2e_s(),
+                                       queue_wait_s=rec.queue_wait_s())
+                    except Exception:  # noqa: BLE001 - alerting must not 500
+                        pass
+                LOG.debug("request id=%d xid=%s method=POST path=%s "
+                          "status=%d latency_ms=%.1f", rec.rid,
+                          rec.xid or "-", label, status, dt * 1e3)
+
+        def _send_json(self, status: int, payload: dict) -> None:
+            data = json.dumps(payload, default=str).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _debugz_dump(self) -> None:
+            """``POST /debugz/dump``: force a manual incident bundle to
+            disk and return it inline (``graftwatch --dump`` validates the
+            inline copy without filesystem access to the server)."""
+            if flight is None:
+                self.send_error(404, "flight recorder disabled "
+                                     "(flight_buffer_spans=0)")
+                return
+            from ..obs import flight as flight_mod
+            path = flight.dump("manual", force=True)
+            doc = flight.bundle("manual")
+            self._send_json(200, {
+                "path": path, "bundle": doc,
+                "problems": flight_mod.validate_bundle(doc)})
+
+        def do_GET(self):
+            # debug surfaces only — /metrics and /healthz live on the obs
+            # exporter's port; these need the live tracer/recorder closure
+            self._rec = None
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path == "/debugz/trace":
+                if tracer is None:
+                    self.send_error(404, "no span tracer (set "
+                                         "flight_buffer_spans or "
+                                         "serve_trace_path)")
+                    return
+                self._send_json(200, tracer.chrome_trace())
+            elif path == "/debugz/flight":
+                if flight is None:
+                    self.send_error(404, "flight recorder disabled "
+                                         "(flight_buffer_spans=0)")
+                    return
+                self._send_json(200, flight.status())
+            else:
+                self.send_error(404)
 
         def _stream_sse(self, stream_fn, body: dict, name: str) -> int:
             """Drain a streaming endpoint as Server-Sent Events.  The
@@ -407,13 +554,16 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                     for event in gen:
                         self._sse_event(event)
                 except OSError as e:  # client went away mid-stream
-                    LOG.debug("SSE client disconnected: %s", e)
+                    LOG.debug("SSE client disconnected: xid=%s %s",
+                              self._rec.xid or "-" if self._rec else "-", e)
                 except Exception as e:  # noqa: BLE001 - headers are out
                     try:
                         self._sse_event(
                             {"error": f"{type(e).__name__}: {e}"[:200]})
                     except OSError:  # disconnected while failing: give up
-                        LOG.debug("SSE client gone before error event")
+                        LOG.debug("SSE client gone before error event: "
+                                  "xid=%s",
+                                  self._rec.xid or "-" if self._rec else "-")
             return 200
 
         def _sse_event(self, event: dict) -> None:
@@ -428,6 +578,9 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
 
     server = _ApiServer((host, port), Handler)
     server.slo = serve_slo  # tests/bench read summaries off the live server
+    server.flight = flight  # incident bundles / debugz surfaces
+    server.alerts = alerts  # SLO burn-rate evaluator (None w/o objectives)
+    server.tracer = tracer  # the shared serving span ring
     server._slo_probe = slo_probe
     server._kv_probe = kv_probe
     server._lane_probe = lane_probe
@@ -442,7 +595,9 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
             server._obs_server = obs_exporter.start_server(
                 eff_obs, registry=registry if registry is not None
                 else REGISTRY, slo_probe=serve_slo.summary,
-                identity=fleet.identity(cfg))
+                identity=fleet.identity(cfg),
+                alerts_probe=(alerts.summary if alerts is not None
+                              else None))
         except OSError:
             server.server_close()  # don't leak the bound REST socket
             raise

@@ -95,13 +95,17 @@ class RequestRecord:
     each field has exactly one writer, and ``mark_first_token`` keeps the
     FIRST stamp (the JAX callback contract is at-most-once anyway)."""
 
-    __slots__ = ("rid", "path", "t_arrival", "t_parsed", "t_enqueued",
+    __slots__ = ("rid", "xid", "path", "t_arrival", "t_parsed", "t_enqueued",
                  "t_started", "t_first_token", "t_engine_done", "t_finished",
                  "queue_depth", "tokens_generated", "status", "token_times",
                  "_lock")
 
     def __init__(self, rid: int, path: str = ""):
         self.rid = rid
+        #: correlation id (the client's X-Request-Id, or server-generated):
+        #: threads through log lines, span trails, and flight bundles so
+        #: one grep follows a request across client and server evidence
+        self.xid = ""
         self.path = path
         self.t_arrival = time.perf_counter()
         self.t_parsed: typing.Optional[float] = None
@@ -567,6 +571,8 @@ class ServeSLO:
         one parent serve/request span + one child per phase that has both
         stamps, all tagged with the request id."""
         tag = {"id": rec.rid, "path": rec.path, "status": rec.status}
+        if rec.xid:
+            tag["xid"] = rec.xid
         phases = (("serve/request", rec.t_arrival, rec.t_finished),
                   ("serve/parse", rec.t_arrival, rec.t_parsed),
                   ("serve/queue_wait", rec.t_enqueued, rec.t_started),

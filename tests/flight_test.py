@@ -1,0 +1,430 @@
+"""Flight-recorder / SLO-alerting / request-tracing tests
+(docs/observability.md "Flight recorder" / "SLO alerting" / "Request
+tracing"): objective parsing, burn-rate properties (monotone in breach
+fraction, window independence), exemplar cap + render byte-identity,
+bundle schema round-trips, dump rate limiting, tail sampling, the
+client/server clock-offset estimator + merged Chrome traces, graftwatch's
+check verdict, and a live end-to-end SLO-breach incident."""
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from homebrewnlp_tpu.models import init_params
+from homebrewnlp_tpu.obs.flight import (BUNDLE_SCHEMA, FlightRecorder,
+                                        request_trail, validate_bundle)
+from homebrewnlp_tpu.obs.registry import EXEMPLAR_CAP, MetricsRegistry
+from homebrewnlp_tpu.obs.slo_alerts import (ALERT_THRESHOLD, SLOAlerts,
+                                            parse_objective,
+                                            validate_objectives)
+from homebrewnlp_tpu.obs.spans import SpanTracer
+from homebrewnlp_tpu.serve import serve
+from homebrewnlp_tpu.serve.slo import RequestRecord
+from homebrewnlp_tpu.utils import random_text_batch
+
+from .backend import mixer_config
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import graftload  # noqa: E402
+import graftwatch  # noqa: E402
+
+
+def _small_cfg(**over):
+    base = dict(depth=1, sequence_length=12, heads=2, features_per_head=16,
+                vocab_size=32, train_batch_size=1,
+                initial_autoregressive_position=4, sampling_temperature=0.0,
+                use_autoregressive_sampling=True)
+    base.update(over)
+    return mixer_config(**base)
+
+
+# -- objective parsing --------------------------------------------------------
+
+def test_parse_objective_latency_and_error_rate():
+    ob = parse_objective("ttft_p95_s", 2.0)
+    assert (ob.kind, ob.metric, ob.threshold) == ("latency", "ttft", 2.0)
+    assert ob.budget == pytest.approx(0.05)
+    ob = parse_objective("error_rate", 0.01)
+    assert (ob.kind, ob.budget) == ("error_rate", 0.01)
+
+
+@pytest.mark.parametrize("key,value", [
+    ("ttft_p95_s", 0.0),          # non-positive bound
+    ("ttft_p95_s", "fast"),       # not a number
+    ("error_rate", 1.5),          # budget is a fraction
+    ("ttft_p0_s", 1.0),           # percentile out of (0, 100)
+    ("loss_p95_s", 1.0),          # unknown metric
+    ("ttft_p95", 1.0),            # missing the _s unit suffix
+])
+def test_parse_objective_rejects(key, value):
+    with pytest.raises(ValueError):
+        parse_objective(key, value)
+
+
+def test_validate_objectives_normalizes():
+    out = validate_objectives({"e2e_p99_s": "3", "error_rate": 0.05})
+    assert out == {"e2e_p99_s": 3.0, "error_rate": 0.05}
+
+
+def test_config_rejects_bad_objectives_and_triggers():
+    with pytest.raises(ValueError):
+        _small_cfg(slo_objectives={"bogus_key": 1.0})
+    with pytest.raises(ValueError):
+        _small_cfg(flight_dump_triggers="slo")  # bare string, not a list
+    with pytest.raises(ValueError):
+        _small_cfg(flight_dump_triggers=["slo", "nonsense"])
+
+
+# -- burn-rate properties -----------------------------------------------------
+
+def _burn(n_total, n_breach, now=1000.0, window="fast"):
+    al = SLOAlerts({"ttft_p95_s": 1.0})
+    for i in range(n_total):
+        ttft = 2.0 if i < n_breach else 0.1
+        al.observe(status=200, ttft_s=ttft, now=now)
+    return al.burn_rates(now=now)["ttft_p95_s"][window]
+
+
+def test_burn_rate_monotone_in_breach_fraction():
+    # property: more breaches in the same window can only raise the burn
+    n = 20
+    rates = [_burn(n, k) for k in range(n + 1)]
+    assert rates == sorted(rates)
+    assert rates[0] == 0.0
+    # all-breach: fraction 1.0 over budget 0.05 -> burn 20x
+    assert rates[-1] == pytest.approx(1.0 / 0.05)
+
+
+def test_burn_rate_window_independence():
+    # an old breach burst sits inside the slow window but OUTSIDE the
+    # fast one: the fast rate must not see it
+    al = SLOAlerts({"ttft_p95_s": 1.0})
+    now = 10_000.0
+    for _ in range(10):
+        al.observe(status=200, ttft_s=9.0, now=now - 300.0)  # slow only
+    for _ in range(10):
+        al.observe(status=200, ttft_s=0.1, now=now)          # both windows
+    rates = al.burn_rates(now=now)["ttft_p95_s"]
+    assert rates["fast"] == 0.0
+    assert rates["slow"] == pytest.approx((10 / 20) / 0.05)
+
+
+def test_alert_fires_only_when_both_windows_burn():
+    al = SLOAlerts({"ttft_p95_s": 1.0})
+    now = 10_000.0
+    # breaches only in the slow window: no alert (fast window is clean)
+    for _ in range(5):
+        al.observe(status=200, ttft_s=9.0, now=now - 300.0)
+    al.observe(status=200, ttft_s=0.1, now=now)
+    assert al.summary(now=now)["firing"] == []
+    # breach NOW too: both windows hot -> rising edge fires
+    for _ in range(5):
+        al.observe(status=200, ttft_s=9.0, now=now)
+    assert al.summary(now=now)["firing"] == ["ttft_p95_s"]
+    # windows drain -> the alert clears without new traffic
+    assert al.summary(now=now + 3600.0)["firing"] == []
+
+
+def test_error_rate_objective_counts_5xx_and_missing_milestones():
+    al = SLOAlerts({"error_rate": 0.5, "ttft_p95_s": 1.0})
+    now = 1000.0
+    al.observe(status=500, now=now)          # 5xx, no TTFT stamp
+    al.observe(status=200, now=now)          # 2xx, never reached TTFT
+    al.observe(status=200, ttft_s=0.1, now=now)
+    rates = al.burn_rates(now=now)
+    # error_rate: 1 of 3 breached over budget .5
+    assert rates["error_rate"]["fast"] == pytest.approx((1 / 3) / 0.5)
+    # latency: the 5xx-without-stamp is a breach, the stampless 2xx is
+    # NOT a sample -> 1 of 2
+    assert rates["ttft_p95_s"]["fast"] == pytest.approx((1 / 2) / 0.05)
+
+
+def test_on_alert_rising_edge_only():
+    fired = []
+    al = SLOAlerts({"ttft_p95_s": 1.0},
+                   on_alert=lambda k, info: fired.append(k))
+    now = 1000.0
+    for _ in range(3):
+        al.observe(status=200, ttft_s=9.0, now=now)
+    assert fired == ["ttft_p95_s"]  # one edge, not one per observe
+    assert al.burn_rates(now=now)["ttft_p95_s"]["fast"] > ALERT_THRESHOLD
+
+
+def test_burn_rate_gauge_registered():
+    reg = MetricsRegistry()
+    SLOAlerts({"ttft_p95_s": 1.0}, registry=reg).observe(
+        status=200, ttft_s=9.0, now=1000.0)
+    text = reg.render()
+    assert 'hbnlp_slo_burn_rate{objective="ttft_p95_s",window="fast"}' in text
+
+
+# -- exemplars ----------------------------------------------------------------
+
+def test_exemplar_cap_and_render_byte_identity():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_ex_seconds", "x", buckets=(1.0, 2.0))
+    for v in (0.5, 1.5, 3.0):
+        h.observe(v)
+    before = reg.render()
+    for i in range(EXEMPLAR_CAP + 40):
+        h.attach_exemplar(0.5 + (i % 3), {"request_id": f"r{i}"})
+    assert len(h.exemplars()) <= EXEMPLAR_CAP
+    # the default Prometheus 0.0.4 render must not change by a byte
+    assert reg.render() == before
+    om = reg.render_openmetrics()
+    assert om.rstrip().endswith("# EOF")
+    assert 'request_id="r' in om  # exemplar suffix made it out
+
+
+# -- bundles + recorder -------------------------------------------------------
+
+def _finished_record(rid=1, xid="t-0001", status=200):
+    rec = RequestRecord(rid, path="/token_completion")
+    rec.xid = xid
+    rec.mark_parsed()
+    rec.mark_enqueued(queue_depth=0)
+    rec.mark_started()
+    rec.mark_first_token()
+    rec.mark_engine_done()
+    rec.tokens_generated = 3
+    rec.mark_finished(status)
+    return rec
+
+
+def test_request_trail_carries_xid_and_latencies():
+    trail = request_trail(_finished_record())
+    assert trail["xid"] == "t-0001"
+    assert trail["status"] == 200
+    assert trail["e2e_s"] >= 0.0
+    assert trail["ttft_s"] is not None
+
+
+def test_validate_bundle_catches_damage():
+    fr = FlightRecorder(max_spans=8, model_path="")
+    doc = fr.bundle("manual")
+    assert doc["schema"] == BUNDLE_SCHEMA
+    assert validate_bundle(doc) == []
+    bad = dict(doc)
+    del bad["spans"]
+    bad["schema"] = "nope"
+    problems = validate_bundle(bad)
+    assert any("spans" in p for p in problems)
+    assert any("schema" in p for p in problems)
+    assert validate_bundle([]) == ["bundle is not a JSON object"]
+
+
+def test_recorder_ring_is_bounded():
+    fr = FlightRecorder(max_records=4)
+    for i in range(10):
+        fr.observe_request(_finished_record(rid=i, xid=f"t-{i:04d}"))
+    doc = fr.bundle("manual")
+    assert len(doc["requests"]) == 4
+    assert doc["requests"][-1]["xid"] == "t-0009"  # newest kept
+
+
+def test_dump_rate_limit_and_trigger_gate(tmp_path):
+    fr = FlightRecorder(model_path=str(tmp_path),
+                        triggers=("error",), min_dump_interval_s=3600.0)
+    assert fr.dump("slo") is None            # not an armed trigger
+    p1 = fr.dump("error")
+    assert p1 and os.path.exists(p1)
+    assert fr.dump("error") is None          # rate-limited
+    p2 = fr.dump("error", force=True)        # manual endpoint bypasses
+    assert p2 and p2 != p1
+    assert validate_bundle(json.load(open(p1))) == []
+    assert fr.dumps == [p1, p2]
+
+
+def test_tail_sampling_attaches_exemplar():
+    reg = MetricsRegistry()
+    from homebrewnlp_tpu.serve.slo import ServeSLO
+    ServeSLO(reg)  # registers the serve histograms exemplars land on
+    fr = FlightRecorder(registry=reg, tail_min_samples=4)
+    for i in range(8):
+        fr.observe_request(_finished_record(rid=i))
+    slow = _finished_record(rid=99, xid="t-slow")
+    slow.t_finished = slow.t_arrival + 100.0  # way past rolling p99
+    trail = fr.observe_request(slow)
+    assert trail["tail"] is True
+    h = reg.get("hbnlp_serve_request_seconds")
+    assert any(lbl.get("request_id") == "t-slow"
+               for _, lbl, _ in h.exemplars().values())
+
+
+def test_engine_trace_rotation_writes_segments(tmp_path):
+    # a tiny span ring under real traffic: the serve_trace_path export
+    # must roll to numbered segments instead of silently dropping spans,
+    # and close() still writes the base path with the final partial ring
+    base = str(tmp_path / "serve.trace.json")
+    cfg = _small_cfg(serve_max_batch=2, serve_trace_path=base,
+                     flight_buffer_spans=32)
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    from homebrewnlp_tpu.serve.engine import BatchEngine
+    eng = BatchEngine(cfg, params)
+    try:
+        for _ in range(4):
+            eng.complete_tokens([1, 2, 3], 0.0, 4)
+    finally:
+        eng.close()
+    assert eng.trace_segments, "span ring filled but never rotated"
+    assert eng.trace_segments[0].endswith(".001.json")
+    for seg_path in eng.trace_segments:
+        assert json.load(open(seg_path))["traceEvents"]
+    assert os.path.exists(base)
+
+
+def test_span_tracer_rotate_clears_ring(tmp_path):
+    tr = SpanTracer(max_events=16)
+    with tr.span("x"):
+        pass
+    assert tr.event_count() == 1
+    out = str(tmp_path / "seg.json")
+    assert tr.rotate(out) == out
+    assert tr.event_count() == 0
+    doc = json.load(open(out))
+    assert any(e.get("name") == "x" for e in doc["traceEvents"])
+
+
+# -- clock offset + merged traces ---------------------------------------------
+
+def _stamp_rec(i, c0, off, up_s, down_s):
+    # client sends at c0; server clock = client + off; legs up_s/down_s
+    s0 = c0 + up_s + off
+    s1 = s0 + 0.01
+    c1 = s1 - off + down_s
+    return {"id": i, "xid": f"x-{i:04d}", "status": 200,
+            "c_send_wall_s": c0, "s_recv_wall_s": s0, "s_send_wall_s": s1,
+            "c_hdr_wall_s": c1, "c_done_wall_s": c1 + 0.001, "e2e_s": 0.02}
+
+
+def test_estimate_offset_recovers_symmetric_offset():
+    recs = [_stamp_rec(i, 100.0 + i, off=5.0, up_s=0.004, down_s=0.004)
+            for i in range(6)]
+    est = graftload.estimate_offset(recs)
+    assert est["n_pairs"] == 6
+    # symmetric legs: the NTP estimator is exact
+    assert est["offset_s"] == pytest.approx(5.0, abs=1e-6)
+    assert est["bound_s"] >= 0.0
+
+
+def test_estimate_offset_bound_covers_asymmetry():
+    # one-sided delay: the estimate is off by (down-up)/2, which the
+    # half-round-trip term in the bound must cover
+    recs = [_stamp_rec(i, 100.0 + i, off=5.0, up_s=0.0, down_s=0.02)
+            for i in range(4)]
+    est = graftload.estimate_offset(recs)
+    assert abs(est["offset_s"] - 5.0) <= est["bound_s"]
+    assert graftload.estimate_offset([{"id": 0}]) is None  # no stamp quad
+
+
+def test_merge_traces_rebases_server_onto_client_clock():
+    recs = [_stamp_rec(i, 100.0 + i, off=5.0, up_s=0.002, down_s=0.002)
+            for i in range(3)]
+    server_doc = {"otherData": {"wall_epoch": 104.9},  # == client 99.9
+                  "traceEvents": [
+                      {"name": "serve/request", "ph": "X", "pid": 9,
+                       "tid": 1, "ts": 150_000.0, "dur": 1000.0,
+                       "args": {"xid": "x-0000"}}]}
+    doc = graftload.merge_traces(recs, server_doc)
+    other = doc["otherData"]
+    assert other["n_client_requests"] == 3
+    assert other["n_server_events"] == 1
+    client0 = next(e for e in doc["traceEvents"]
+                   if e["name"] == "client/request"
+                   and e["args"]["xid"] == "x-0000")
+    server0 = next(e for e in doc["traceEvents"] if e["pid"] == 1)
+    # server epoch 104.9 is client 99.9; +0.15s puts the span at client
+    # 100.05 — 50ms after the client span opened at origin 100.0
+    assert client0["ts"] == pytest.approx(0.0, abs=1.0)
+    assert server0["ts"] == pytest.approx(50_000.0, abs=1e4)
+    assert other["clock_offset"]["offset_s"] == pytest.approx(5.0, abs=1e-3)
+
+
+# -- bench ratchet ------------------------------------------------------------
+
+def test_serve_baseline_flight_overhead_gate():
+    import bench
+    base = {"e2e_p50_s": 0.1}
+    # the cap is absolute: a fat baseline does not license a fat row
+    gate, ok = bench.evaluate_serve_baseline(
+        {"e2e_p50_s": 0.1, "flight_overhead_frac": 0.002}, base)
+    assert ok and gate["flight_overhead_frac"]["pass"]
+    gate, ok = bench.evaluate_serve_baseline(
+        {"e2e_p50_s": 0.1, "flight_overhead_frac": 0.02}, base)
+    assert not ok and not gate["flight_overhead_frac"]["pass"]
+    # a row without the figure is not gated on it
+    gate, ok = bench.evaluate_serve_baseline({"e2e_p50_s": 0.1}, base)
+    assert ok and "flight_overhead_frac" not in gate
+
+
+# -- graftwatch ---------------------------------------------------------------
+
+def test_graftwatch_verdict():
+    ok, reasons = graftwatch.verdict({"healthz": {"status": "ok"}})
+    assert ok and reasons == []
+    ok, reasons = graftwatch.verdict(
+        {"healthz": {"status": "stalled",
+                     "alerts": {"firing": ["ttft_p95_s"]}}})
+    assert not ok
+    assert len(reasons) == 2
+
+
+# -- live end-to-end: a deliberate SLO breach ---------------------------------
+
+def test_e2e_breach_fires_alert_and_dumps_flight_bundle(tmp_path):
+    cfg = _small_cfg(model_path=str(tmp_path / "m"),
+                     slo_objectives={"ttft_p95_s": 1e-6},  # unmeetable
+                     flight_buffer_spans=512)
+    params, _ = init_params(cfg, random_text_batch(cfg))
+    reg = MetricsRegistry()
+    server = serve(cfg, params, port=0, background=True, registry=reg,
+                   obs_port=0)
+    try:
+        port = server.server_address[1]
+        url = f"http://127.0.0.1:{port}"
+        murl = f"http://127.0.0.1:{server._obs_server.server_address[1]}"
+        trace_path = str(tmp_path / "merged.json")
+        report = graftload.drive(url, metrics_url=murl, n_requests=4,
+                                 concurrency=2, response_len=4,
+                                 temperature=0.0, seed=7,
+                                 trace_out=trace_path)
+        assert report["client"]["n_ok"] == 4
+        # merged trace: both arms of one request id on one timebase
+        doc = json.load(open(trace_path))
+        xids = {e["args"]["xid"] for e in doc["traceEvents"]
+                if e.get("pid") == 0 and e["name"] == "client/request"}
+        assert xids and all(x.startswith("gl7-") for x in xids)
+        assert any(e.get("pid") == 1
+                   and e.get("args", {}).get("xid") in xids
+                   for e in doc["traceEvents"])
+        assert doc["otherData"]["clock_offset"]["bound_s"] < 5.0
+        # the unmeetable objective fires on /healthz ...
+        with urllib.request.urlopen(murl + "/healthz", timeout=10) as r:
+            hz = json.loads(r.read())
+        assert hz["alerts"]["firing"] == ["ttft_p95_s"]
+        # ... flips graftwatch --check nonzero ...
+        assert graftwatch.main(["--metrics-url", murl, "--check"]) == 1
+        # ... and auto-wrote an slo-trigger bundle holding a breaching
+        # request's full trail under the propagated request id
+        diag = os.path.join(cfg.model_path, "diagnostics")
+        bundles = [json.load(open(os.path.join(diag, f)))
+                   for f in sorted(os.listdir(diag))]
+        slo_bundles = [b for b in bundles if b["reason"] == "slo"]
+        assert slo_bundles
+        for b in slo_bundles:
+            assert validate_bundle(b) == []
+        assert any(r.get("xid", "").startswith("gl7-")
+                   for b in slo_bundles for r in b["requests"])
+        # manual dump via graftwatch: fetch, validate, write locally
+        out = str(tmp_path / "incident.json")
+        assert graftwatch.main(["--metrics-url", murl, "--url", url,
+                                "--dump", out]) == 0
+        local = json.load(open(out))
+        assert validate_bundle(local) == []
+    finally:
+        server.shutdown()
+        server.server_close()

@@ -106,12 +106,20 @@ def make_corpus(seed: int, n: int, vocab: int = 256, min_len: int = 4,
     return out
 
 
-def _post(url: str, body: dict, timeout_s: float) -> typing.Tuple[int, dict]:
+def _post(url: str, body: dict, timeout_s: float,
+          headers: typing.Optional[dict] = None
+          ) -> typing.Tuple[int, dict, typing.Any, float]:
+    """POST JSON; returns ``(status, payload, response headers, wall clock
+    at header arrival)`` — the response headers echo the server's
+    correlation id + wall stamps (``X-Server-Recv-S``/``X-Server-Send-S``),
+    the raw material of the client/server clock-offset estimate."""
     data = json.dumps(body).encode()
-    req = urllib.request.Request(
-        url, data=data, headers={"Content-Type": "application/json"})
+    hdr = {"Content-Type": "application/json"}
+    hdr.update(headers or {})
+    req = urllib.request.Request(url, data=data, headers=hdr)
     with urllib.request.urlopen(req, timeout=timeout_s) as r:
-        return r.status, json.loads(r.read() or b"{}")
+        hdr_wall = time.time()
+        return r.status, json.loads(r.read() or b"{}"), r.headers, hdr_wall
 
 
 def read_sse(fp) -> typing.Iterator[typing.Tuple[float, dict]]:
@@ -124,20 +132,28 @@ def read_sse(fp) -> typing.Iterator[typing.Tuple[float, dict]]:
             yield time.perf_counter(), json.loads(line[6:])
 
 
-def _post_stream(url: str, body: dict, timeout_s: float
-                 ) -> typing.Tuple[int, dict, typing.List[float]]:
+def _post_stream(url: str, body: dict, timeout_s: float,
+                 headers: typing.Optional[dict] = None
+                 ) -> typing.Tuple[int, dict, typing.List[float],
+                                   typing.Any, float]:
     """POST with ``stream: true`` and drain the SSE response.  Returns
-    ``(status, final event, chunk arrival times)`` — the final event
-    carries the buffered-equivalent ``completion``; the arrival times
-    (token-chunk events only, the final event excluded) are the client
-    arm of the ITL reconciliation."""
+    ``(status, final event, chunk arrival times, response headers, wall
+    clock at header arrival)`` — the final event carries the
+    buffered-equivalent ``completion``; the arrival times (token-chunk
+    events only, the final event excluded) are the client arm of the ITL
+    reconciliation.  The header-arrival wall stamp (NOT stream-drain
+    completion) pairs with the server's ``X-Server-Send-S`` header
+    emission in the clock-offset estimate."""
     data = json.dumps(dict(body, stream=True)).encode()
-    req = urllib.request.Request(
-        url, data=data, headers={"Content-Type": "application/json"})
+    hdr = {"Content-Type": "application/json"}
+    hdr.update(headers or {})
+    req = urllib.request.Request(url, data=data, headers=hdr)
     final: dict = {}
     times: typing.List[float] = []
     with urllib.request.urlopen(req, timeout=timeout_s) as r:
         status = r.status
+        hdrs = r.headers
+        hdr_wall = time.time()
         ctype = r.headers.get("Content-Type", "")
         if not ctype.startswith("text/event-stream"):
             # a serve_stream=false (or pre-streaming) server answers
@@ -153,7 +169,7 @@ def _post_stream(url: str, body: dict, timeout_s: float
                 raise RuntimeError(f"mid-stream error: {event['error']}")
             else:
                 times.append(t)
-    return status, final, times
+    return status, final, times, hdrs, hdr_wall
 
 
 def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
@@ -161,7 +177,7 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
              rate: typing.Optional[float] = None, ramp_s: float = 0.0,
              response_len: int = 16, temperature: float = 1.0,
              timeout_s: float = 300.0, trace_interval_s: float = 0.05,
-             stream: bool = False
+             stream: bool = False, xid_prefix: str = "gl"
              ) -> typing.Tuple[typing.List[dict], typing.List[list], float,
                                bool]:
     """Fire ``n_requests`` at ``url``/token_completion; returns
@@ -176,7 +192,14 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
     ``stream=True`` sends ``stream: true`` and drains each response as
     SSE: records gain ``ttft_s`` (first chunk arrival, the client's own
     clock) and ``itl_gaps`` (deltas between consecutive chunk arrivals) —
-    the client arm of the token-level reconciliation."""
+    the client arm of the token-level reconciliation.
+
+    Every request carries a deterministic ``X-Request-Id``
+    (``<xid_prefix>-<i>``) the server echoes and threads through its log
+    lines, span trails, and flight bundles; records keep the id plus the
+    client/server wall stamps (``c_send_wall_s``/``c_hdr_wall_s`` and the
+    echoed ``s_recv_wall_s``/``s_send_wall_s``) that
+    :func:`estimate_offset` turns into one merged-trace timebase."""
     endpoint = url.rstrip("/") + "/token_completion"
     lock = threading.Lock()
     records: typing.List[dict] = []
@@ -191,33 +214,54 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
                 trace.append([round(time.perf_counter() - t_start, 4),
                               inflight[0]])
 
+    def _server_stamps(rec: dict, hdrs) -> None:
+        for key, hname in (("s_recv_wall_s", "X-Server-Recv-S"),
+                           ("s_send_wall_s", "X-Server-Send-S")):
+            v = hdrs.get(hname)
+            if v is not None:
+                try:
+                    rec[key] = float(v)
+                except ValueError:
+                    pass
+
     def one(i: int) -> None:
         prompt = list(corpus[i % len(corpus)])
-        rec = {"id": i, "prompt_len": len(prompt),
+        xid = f"{xid_prefix}-{i:04d}"
+        rec = {"id": i, "xid": xid, "prompt_len": len(prompt),
                "t_send_s": round(time.perf_counter() - t_start, 6),
                "status": 0, "tokens_generated": 0}
         with lock:
             inflight[0] += 1
+        rec["c_send_wall_s"] = time.time()
         t0 = time.perf_counter()
         try:
             body = {"prompt": prompt, "temperature": temperature,
                     "response_len": response_len}
+            req_hdrs = {"X-Request-Id": xid}
             if stream:
-                status, out, chunk_ts = _post_stream(endpoint, body,
-                                                     timeout_s)
+                status, out, chunk_ts, hdrs, hdr_wall = _post_stream(
+                    endpoint, body, timeout_s, headers=req_hdrs)
                 if chunk_ts:
                     rec["ttft_s"] = round(chunk_ts[0] - t0, 6)
                     rec["itl_gaps"] = [
                         round(chunk_ts[i] - chunk_ts[i - 1], 6)
                         for i in range(1, len(chunk_ts))]
             else:
-                status, out = _post(endpoint, body, timeout_s)
+                status, out, hdrs, hdr_wall = _post(endpoint, body,
+                                                    timeout_s,
+                                                    headers=req_hdrs)
+            rec["c_hdr_wall_s"] = hdr_wall
+            _server_stamps(rec, hdrs)
             rec["status"] = status
             comp = out.get("completion")
             if isinstance(comp, list):
                 rec["tokens_generated"] = max(0, len(comp) - len(prompt))
         except urllib.error.HTTPError as e:
             rec["status"] = e.code
+            # a rejection still echoes the correlation headers — its
+            # clock pair is as good as a 200's
+            rec["c_hdr_wall_s"] = time.time()
+            _server_stamps(rec, e.headers)
             retry = e.headers.get("Retry-After")
             if retry is not None:
                 rec["retry_after_s"] = float(retry)
@@ -226,6 +270,7 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
             rec["error"] = f"{type(e).__name__}: {e}"[:200]
         finally:
             rec["e2e_s"] = round(time.perf_counter() - t0, 6)
+            rec["c_done_wall_s"] = time.time()
             with lock:
                 inflight[0] -= 1
                 records.append(rec)
@@ -510,8 +555,8 @@ def check_ok(report: dict, max_error_rate: float = 0.0) -> bool:
 
 # -- per-request log ----------------------------------------------------------
 
-LOG_FIELDS = ("id", "t_send_s", "e2e_s", "ttft_s", "status", "prompt_len",
-              "tokens_generated", "retry_after_s", "error")
+LOG_FIELDS = ("id", "xid", "t_send_s", "e2e_s", "ttft_s", "status",
+              "prompt_len", "tokens_generated", "retry_after_s", "error")
 
 
 def write_log(records: typing.Sequence[dict], path: str,
@@ -540,6 +585,106 @@ def fetch_metrics(metrics_url: str, timeout_s: float = 10.0) -> str:
         return r.read().decode()
 
 
+# -- merged client/server tracing ---------------------------------------------
+
+
+def estimate_offset(records: typing.Sequence[dict]
+                    ) -> typing.Optional[dict]:
+    """Client/server clock offset from the per-request echoed wall stamps,
+    the NTP idea applied to request/response pairs (same barrier-matching
+    estimator shape as ``obs.fleet.estimate_offsets``):
+
+    per request, ``off = ((s_recv - c_send) + (s_send - c_hdr)) / 2``
+    where ``c_hdr`` is the client's header-arrival stamp — the client-side
+    event that pairs with the server's ``X-Server-Send-S`` emission.
+
+    Returns ``{"offset_s", "bound_s", "n_pairs"}`` with ``server_wall =
+    client_wall + offset_s``.  ``bound_s`` is an honest error bar: the
+    worst residual across requests plus the worst half round-trip
+    asymmetry ``((c_hdr - c_send) - (s_send - s_recv)) / 2`` — the offset
+    cannot be pinned tighter than the network legs it rode on.  None when
+    no request carried a complete stamp quad."""
+    offs, halves = [], []
+    for r in records:
+        stamps = [r.get(k) for k in ("c_send_wall_s", "s_recv_wall_s",
+                                     "s_send_wall_s", "c_hdr_wall_s")]
+        if any(s is None for s in stamps):
+            continue
+        c0, s0, s1, c1 = stamps
+        offs.append(((s0 - c0) + (s1 - c1)) / 2.0)
+        halves.append(max(0.0, ((c1 - c0) - (s1 - s0)) / 2.0))
+    if not offs:
+        return None
+    mean = sum(offs) / len(offs)
+    bound = max(abs(o - mean) for o in offs) + max(halves)
+    return {"offset_s": round(mean, 6), "bound_s": round(bound, 6),
+            "n_pairs": len(offs)}
+
+
+def fetch_trace(url: str, timeout_s: float = 10.0) -> dict:
+    """GET the server's live Chrome-trace document (``/debugz/trace`` on
+    the REST port — serve/rest.py exposes the engine's span ring)."""
+    with urllib.request.urlopen(url.rstrip("/") + "/debugz/trace",
+                                timeout=timeout_s) as r:
+        return json.loads(r.read().decode())
+
+
+def merge_traces(records: typing.Sequence[dict],
+                 server_doc: typing.Optional[dict] = None) -> dict:
+    """One Chrome/Perfetto document holding both arms of each request:
+    the client's send->done span (pid 0) and the server's queue/prefill/
+    decode/emit spans (pid 1) on a single timebase.
+
+    Server events keep their relative ``ts`` but the whole process is
+    shifted onto the client's wall clock via :func:`estimate_offset`; the
+    applied offset and its error bound land in ``otherData`` so a reader
+    knows how far to trust cross-process edge alignment."""
+    clock = estimate_offset(records)
+    off = clock["offset_s"] if clock else 0.0
+    sent = [r for r in records if r.get("c_send_wall_s") is not None]
+    origin = min((r["c_send_wall_s"] for r in sent), default=0.0)
+    events: typing.List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": "graftload client"}},
+        {"name": "thread_name", "ph": "M", "pid": 0, "tid": 0,
+         "args": {"name": "requests"}},
+    ]
+    for r in sent:
+        c0, c_done = r["c_send_wall_s"], r.get("c_done_wall_s")
+        if c_done is None:
+            c_done = c0 + float(r.get("e2e_s") or 0.0)
+        args = {"xid": r.get("xid", ""), "status": r.get("status")}
+        if r.get("error"):
+            args["error"] = r["error"]
+        events.append({"name": "client/request", "ph": "X", "pid": 0,
+                       "tid": 0, "ts": (c0 - origin) * 1e6,
+                       "dur": max(0.0, c_done - c0) * 1e6, "args": args})
+        if r.get("ttft_s") is not None:
+            events.append({"name": "client/ttft", "ph": "X", "pid": 0,
+                           "tid": 0, "ts": (c0 - origin) * 1e6,
+                           "dur": float(r["ttft_s"]) * 1e6,
+                           "args": {"xid": r.get("xid", "")}})
+    n_server = 0
+    if server_doc:
+        s_epoch = float((server_doc.get("otherData") or {})
+                        .get("wall_epoch", 0.0))
+        # server ts are relative to its own epoch; correct the epoch onto
+        # the client clock, then rebase onto this doc's origin
+        shift = (s_epoch - off - origin) * 1e6
+        for ev in server_doc.get("traceEvents", ()):
+            ev = dict(ev, pid=1)
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift
+            events.append(ev)
+            n_server += 1
+    other = {"origin_wall_s": round(origin, 6),
+             "n_client_requests": len(sent), "n_server_events": n_server}
+    if clock:
+        other["clock_offset"] = clock
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": other}
+
+
 def drive(url: str, metrics_url: typing.Optional[str] = None,
           n_requests: int = 20, concurrency: int = 4, mode: str = "closed",
           rate: typing.Optional[float] = None, ramp_s: float = 0.0,
@@ -549,17 +694,21 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
           log_path: typing.Optional[str] = None,
           log_format: typing.Optional[str] = None,
           stream: bool = False, long_frac: float = 0.0,
-          long_len: int = 0) -> dict:
+          long_len: int = 0,
+          trace_out: typing.Optional[str] = None) -> dict:
     """One full run: corpus -> load -> client report -> server scrape ->
     reconciliation.  The importable entry bench.py and the tests share.
     ``long_frac``/``long_len`` thread through to :func:`make_corpus` (the
-    mixed prompt-length stall scenario)."""
+    mixed prompt-length stall scenario).  ``trace_out`` fetches the
+    server's span ring after the run and writes the merged client+server
+    Chrome trace there (see :func:`merge_traces`)."""
     corpus = make_corpus(seed, max(8, n_requests), vocab, min_prompt,
                          max_prompt, long_frac=long_frac, long_len=long_len)
     records, trace, duration, truncated = run_load(
         url, corpus, n_requests, concurrency=concurrency, mode=mode,
         rate=rate, ramp_s=ramp_s, response_len=response_len,
-        temperature=temperature, timeout_s=timeout_s, stream=stream)
+        temperature=temperature, timeout_s=timeout_s, stream=stream,
+        xid_prefix=f"gl{seed:x}")
     report = {"url": url, "mode": mode, "concurrency": concurrency,
               "rate": rate, "seed": seed, "response_len": response_len,
               "stream": bool(stream),
@@ -575,6 +724,20 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
             report["reconcile"] = reconcile_report(report["client"], text)
         except Exception as e:  # noqa: BLE001 - scrape is best-effort
             report["server"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    if trace_out:
+        server_doc = None
+        try:
+            server_doc = fetch_trace(url)
+        except Exception as e:  # noqa: BLE001 - a server without a span
+            # ring (flight_buffer_spans=0, no serve_trace_path) 404s here;
+            # the client-only trace is still worth writing
+            report["trace_error"] = f"{type(e).__name__}: {e}"[:200]
+        merged = merge_traces(records, server_doc)
+        with open(trace_out, "w") as f:
+            json.dump(merged, f)
+        report["trace"] = {"path": trace_out,
+                           **{k: v for k, v in merged["otherData"].items()
+                              if k != "origin_wall_s"}}
     return report
 
 
@@ -613,6 +776,10 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                          "client-side TTFT + inter-token latency (adds the "
                          "itl/ttft reconciliation arms)")
     ap.add_argument("--log", default="", help="per-request log (.jsonl/.csv)")
+    ap.add_argument("--trace-out", default="",
+                    help="write a merged client+server Chrome trace here "
+                         "(fetches the server's /debugz/trace span ring and "
+                         "rebases it onto the client clock)")
     ap.add_argument("--json", action="store_true",
                     help="print the full report as one JSON document")
     ap.add_argument("--check", action="store_true",
@@ -631,7 +798,8 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                        temperature=args.temperature,
                        timeout_s=args.timeout_s, log_path=args.log or None,
                        stream=args.stream, long_frac=args.long_frac,
-                       long_len=args.long_len)
+                       long_len=args.long_len,
+                       trace_out=args.trace_out or None)
     except (OSError, ValueError) as e:
         print(f"graftload: {e}", file=sys.stderr)
         return 2
@@ -657,6 +825,8 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                   "(decode-loop wall lost to blocking admission prefill)")
         if "reconcile" in report:
             print("reconcile: " + json.dumps(report["reconcile"]))
+        if "trace" in report:
+            print("trace: " + json.dumps(report["trace"]))
     if args.check:
         return 0 if check_ok(report, args.max_error_rate) else 1
     return 0

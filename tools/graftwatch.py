@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""graftwatch: live serving-health watcher — SLO burn rates, alert state,
+flight-recorder bundles — over a running server's observability surface.
+
+Tails the obs exporter (``/healthz`` + ``/metrics``) and the REST debug
+endpoints (``/debugz/flight``, ``POST /debugz/dump``) that serve/rest.py
+exposes, and renders the operator's one-glance view: per-objective SLO
+burn rates across the fast/slow windows (obs/slo_alerts.py), which alerts
+are FIRING, request/error throughput deltas between scrapes, and the
+flight recorder's ring occupancy.  The on-call loop in one command
+instead of four curls.
+
+Modes:
+  one-shot   scrape once, print the table (default); ``--json`` emits the
+             raw snapshot document instead
+  --watch    rescrape every ``--interval`` seconds; rates (req/s, err/s)
+             come from counter DELTAS between consecutive scrapes, so the
+             numbers are the live rate, not the lifetime average
+  --check    CI/probe gate: exit 1 when any SLO alert is firing or the
+             server reports itself stalled, 0 when healthy
+  --dump     ask the server for a flight bundle (``POST /debugz/dump``),
+             validate it against the bundle schema
+             (obs/flight.py ``validate_bundle``), and write it to the
+             given local path — incident capture from the operator's seat
+
+Usage:
+  python tools/graftwatch.py --metrics-url http://127.0.0.1:9090
+  python tools/graftwatch.py --metrics-url ... --url http://127.0.0.1:8000 \
+      --watch --interval 5
+  python tools/graftwatch.py --metrics-url ... --check
+  python tools/graftwatch.py --url ... --dump incident.json
+
+Exit codes: 0 ok; 1 when ``--check`` finds a firing alert / stall, or a
+``--dump`` bundle fails validation; 2 usage/connection errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import typing
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from homebrewnlp_tpu.obs.flight import validate_bundle  # noqa: E402
+
+
+def _get_json(url: str, timeout_s: float = 10.0) -> dict:
+    req = urllib.request.Request(url)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        # /healthz answers 503 WITH a body when stalled — that body is the
+        # signal, not a transport failure
+        body = e.read().decode()
+        try:
+            return json.loads(body)
+        except ValueError:
+            raise e
+
+
+def _get_text(url: str, timeout_s: float = 10.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return r.read().decode()
+
+
+def parse_counters(metrics_text: str
+                   ) -> typing.Dict[str, typing.List[tuple]]:
+    """{sample name: [(labels, value), ...]} via the repo's one prom-text
+    parser (graftload re-exports the same view)."""
+    import graftload
+    return graftload.parse_prom(metrics_text)
+
+
+def scrape(metrics_url: typing.Optional[str],
+           rest_url: typing.Optional[str],
+           timeout_s: float = 10.0) -> dict:
+    """One snapshot: healthz (status + alerts block), burn-rate gauges +
+    request counters from /metrics, and the flight recorder's own status
+    (``/debugz/flight`` on the REST port).  Every section is best-effort
+    except the first URL that was explicitly given — a watcher that can't
+    reach anything it was pointed at should fail loudly, not render an
+    empty table."""
+    snap: dict = {"wall_time_s": time.time()}
+    if metrics_url:
+        base = metrics_url.rstrip("/")
+        snap["healthz"] = _get_json(base + "/healthz", timeout_s)
+        metrics = parse_counters(_get_text(base + "/metrics", timeout_s))
+        snap["burn_rates"] = [
+            {"objective": labels.get("objective", "?"),
+             "window": labels.get("window", "?"), "rate": value}
+            for labels, value in metrics.get("hbnlp_slo_burn_rate", [])]
+        snap["requests_total"] = sum(
+            v for _, v in metrics.get("hbnlp_serve_requests_total", []))
+        snap["errors_total"] = sum(
+            v for labels, v in metrics.get("hbnlp_serve_requests_total", [])
+            if labels.get("status", "").startswith("5"))
+        for labels, v in metrics.get("hbnlp_serve_inflight", []):
+            snap["inflight"] = v
+    if rest_url:
+        try:
+            snap["flight"] = _get_json(
+                rest_url.rstrip("/") + "/debugz/flight", timeout_s)
+        except Exception as e:  # noqa: BLE001 - recorder may be off
+            snap["flight"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return snap
+
+
+def deltas(prev: dict, cur: dict) -> dict:
+    """Scrape-to-scrape rates: req/s and err/s from counter deltas.  The
+    honest live rate — lifetime counters average away the incident."""
+    dt = cur["wall_time_s"] - prev["wall_time_s"]
+    if dt <= 0:
+        return {}
+    out = {}
+    for key, name in (("requests_total", "req_per_s"),
+                      ("errors_total", "err_per_s")):
+        a, b = prev.get(key), cur.get(key)
+        if a is not None and b is not None:
+            out[name] = round(max(0.0, b - a) / dt, 3)
+    return out
+
+
+def verdict(snap: dict) -> typing.Tuple[bool, typing.List[str]]:
+    """The ``--check`` gate as a pure function: (ok, reasons).  Fails on
+    any firing SLO alert or a stalled server; a missing alerts block
+    (no objectives configured) is healthy, not unknown."""
+    reasons = []
+    hz = snap.get("healthz") or {}
+    if hz.get("status") == "stalled":
+        reasons.append("server reports status=stalled")
+    alerts = hz.get("alerts") or {}
+    for key in alerts.get("firing", ()):
+        reasons.append(f"SLO alert firing: {key}")
+    return not reasons, reasons
+
+
+def fetch_dump(rest_url: str, out_path: str, timeout_s: float = 30.0
+               ) -> typing.Tuple[dict, typing.List[str]]:
+    """POST /debugz/dump, validate the returned bundle, write it locally.
+    Returns ``(response document, validation problems)``."""
+    req = urllib.request.Request(
+        rest_url.rstrip("/") + "/debugz/dump", data=b"{}",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        doc = json.loads(r.read().decode())
+    bundle = doc.get("bundle") or {}
+    problems = list(doc.get("problems") or ()) or validate_bundle(bundle)
+    with open(out_path, "w") as f:
+        json.dump(bundle, f, sort_keys=True)
+    return doc, problems
+
+
+def render(snap: dict, rates: typing.Optional[dict] = None) -> str:
+    """Human one-glance block: status line, burn-rate table, flight ring."""
+    lines = []
+    hz = snap.get("healthz") or {}
+    status = hz.get("status", "?")
+    head = f"status={status}"
+    if snap.get("inflight") is not None:
+        head += f" inflight={int(snap['inflight'])}"
+    if snap.get("requests_total") is not None:
+        head += (f" requests={int(snap['requests_total'])}"
+                 f" errors={int(snap.get('errors_total') or 0)}")
+    if rates:
+        head += "".join(f" {k}={v}" for k, v in sorted(rates.items()))
+    lines.append(head)
+    alerts = hz.get("alerts") or {}
+    for row in alerts.get("alerts", ()):
+        burns = " ".join(f"{w}={r}" for w, r in
+                         sorted((row.get("burn_rates") or {}).items()))
+        state = "FIRING" if row.get("firing") else "ok"
+        lines.append(f"  slo {row['objective']:<16} {state:<6} {burns}")
+    if not alerts.get("alerts"):
+        for row in snap.get("burn_rates", ()):
+            lines.append(f"  burn {row['objective']}/{row['window']}: "
+                         f"{row['rate']}")
+    fl = snap.get("flight")
+    if isinstance(fl, dict) and "error" not in fl:
+        lines.append(f"  flight: spans={fl.get('n_spans')} "
+                     f"requests={fl.get('n_requests')} "
+                     f"dumps={len(fl.get('dumps') or ())}")
+    return "\n".join(lines)
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--metrics-url", default="",
+                    help="obs exporter base URL (/healthz + /metrics)")
+    ap.add_argument("--url", default="",
+                    help="REST server base URL (/debugz/flight, --dump)")
+    ap.add_argument("--watch", action="store_true",
+                    help="rescrape every --interval seconds until ^C")
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument("--count", type=int, default=0,
+                    help="with --watch: stop after N scrapes (0 = forever)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when any SLO alert fires or the server "
+                         "is stalled")
+    ap.add_argument("--dump", default="",
+                    help="fetch + validate a flight bundle, write it here "
+                         "(needs --url)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw snapshot as one JSON document")
+    args = ap.parse_args(argv)
+    if not args.metrics_url and not args.url:
+        print("graftwatch: need --metrics-url and/or --url",
+              file=sys.stderr)
+        return 2
+    if args.dump and not args.url:
+        print("graftwatch: --dump needs --url", file=sys.stderr)
+        return 2
+    try:
+        if args.dump:
+            doc, problems = fetch_dump(args.url, args.dump)
+            print(f"bundle -> {args.dump} (server path: "
+                  f"{doc.get('path')})")
+            for p in problems:
+                print(f"  INVALID: {p}", file=sys.stderr)
+            if problems:
+                return 1
+        prev = None
+        n = 0
+        while True:
+            snap = scrape(args.metrics_url or None, args.url or None)
+            rates = deltas(prev, snap) if prev else None
+            if args.json:
+                print(json.dumps(dict(snap, rates=rates or {}),
+                                 sort_keys=True))
+            else:
+                print(render(snap, rates))
+            n += 1
+            if not args.watch or (args.count and n >= args.count):
+                break
+            prev = snap
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    except (OSError, ValueError) as e:
+        print(f"graftwatch: {e}", file=sys.stderr)
+        return 2
+    if args.check:
+        ok, reasons = verdict(snap)
+        for r in reasons:
+            print(f"CHECK FAILED: {r}", file=sys.stderr)
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
