@@ -438,6 +438,34 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # subset of ("watchdog", "error", "slo", "manual"): watchdog stall,
     # 5xx response, an SLO burn-rate alert firing, or POST /debugz/dump
     flight_dump_triggers=("watchdog", "error", "slo", "manual"),
+    # multi-replica serving (docs/reliability.md "Serving resilience").
+    # serve_replicas: engine replica processes tools/graftserve.py spawns
+    # behind the health-aware router; 1 = a single replica (the router is
+    # still useful for drain/failover semantics, but optional)
+    serve_replicas=1,
+    # router_port: >0 runs the health-aware replica router
+    # (serve/router.py) on this port in front of the replica set;
+    # 0 = no router (clients hit a replica directly)
+    router_port=0,
+    # router_health_interval_s: seconds between the router's /healthz
+    # polls of each replica — a replica reporting stalled, draining,
+    # firing SLO alerts, or a full KV pool is shed to healthy peers
+    router_health_interval_s=1.0,
+    # router_health_timeout_s: per-poll HTTP timeout; a wedged healthz
+    # endpoint (the replica:wedge_healthz chaos action) reads as
+    # unhealthy after this long instead of hanging the health watcher
+    router_health_timeout_s=2.0,
+    # router_failover_retries: additional replicas tried after a replica
+    # death (connection refused, 5xx, or a mid-stream disconnect BEFORE
+    # the first SSE token), preserving the client's X-Request-Id; once
+    # any response byte has been forwarded, retries are never attempted
+    # (at-most-once delivery past the first token)
+    router_failover_retries=1,
+    # serve_watchdog_min_stall_s: floor of the serving decode-loop
+    # watchdog's stall threshold (watchdog_factor x the EMA scheduler
+    # iteration time, never below this floor) — the serving twin of the
+    # train watchdog; armed only when watchdog_factor > 0
+    serve_watchdog_min_stall_s=1.0,
     equal_debugging_items_per_check=16,
     debug_sample=False,
     default_sleep_duration=0.1,
@@ -611,6 +639,30 @@ class Config:
                 f"flight_dump_triggers has unknown trigger(s) {bad}; "
                 f"known: {sorted(DUMP_TRIGGERS)}")
         self.flight_dump_triggers = triggers
+        if int(self.serve_replicas) < 1:
+            raise ValueError("serve_replicas must be >= 1 "
+                             "(the number of engine replica processes)")
+        self.serve_replicas = int(self.serve_replicas)
+        if int(self.router_port) < 0:
+            raise ValueError("router_port must be >= 0 (0 = no router)")
+        self.router_port = int(self.router_port)
+        if float(self.router_health_interval_s) <= 0:
+            raise ValueError("router_health_interval_s must be > 0 "
+                             "(seconds between replica /healthz polls)")
+        self.router_health_interval_s = float(self.router_health_interval_s)
+        if float(self.router_health_timeout_s) <= 0:
+            raise ValueError("router_health_timeout_s must be > 0 "
+                             "(per-poll HTTP timeout)")
+        self.router_health_timeout_s = float(self.router_health_timeout_s)
+        if int(self.router_failover_retries) < 0:
+            raise ValueError("router_failover_retries must be >= 0 "
+                             "(extra replicas tried before giving up)")
+        self.router_failover_retries = int(self.router_failover_retries)
+        if float(self.serve_watchdog_min_stall_s) <= 0:
+            raise ValueError("serve_watchdog_min_stall_s must be > 0 "
+                             "(the decode-loop stall threshold floor)")
+        self.serve_watchdog_min_stall_s = float(
+            self.serve_watchdog_min_stall_s)
         if self.watchdog_factor < 0:
             raise ValueError("watchdog_factor must be >= 0 "
                              "(0 = watchdog disabled)")

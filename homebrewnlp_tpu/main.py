@@ -32,6 +32,14 @@ def parse_args(argv: typing.Optional[typing.Sequence[str]] = None):
                    help="override cfg.web_workers (reference src/main.py:60)")
     p.add_argument("--debug_grad", action="store_true")
     p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--obs_port", type=int, default=None,
+                   help="web_api: /metrics + /healthz exporter port "
+                        "(overrides cfg.obs_port; the replica router "
+                        "health-gates on this endpoint)")
+    p.add_argument("--grace_deadline_s", type=float, default=30.0,
+                   help="web_api: SIGTERM graceful-drain bound — finish "
+                        "in-flight streams for at most this long before "
+                        "exiting (docs/reliability.md)")
     p.add_argument("--profile", type=str, default="",
                    help="directory for a jax.profiler trace of a few "
                         "steady-state train steps (upgrade over the "
@@ -644,9 +652,40 @@ def query(cfg, args) -> None:
 
 
 def web_api(cfg, args) -> None:
+    import signal
+    import threading
+
     from .serve import serve as rest_serve
-    print(f"serving on :{args.port}")
-    rest_serve(cfg, _params_for_serving(cfg), port=args.port)
+    print(f"serving on :{args.port}", flush=True)
+    server = rest_serve(cfg, _params_for_serving(cfg), port=args.port,
+                        obs_port=getattr(args, "obs_port", None),
+                        background=True)
+    grace = float(getattr(args, "grace_deadline_s", 30.0))
+    stopped = threading.Event()
+
+    def _drain_bg():
+        server.drain(grace)
+        stopped.set()
+
+    def _on_sigterm(signum, frame):
+        # drain off the signal frame: drain() blocks on in-flight streams
+        # then shutdown()s, neither of which belongs in a handler
+        threading.Thread(target=_drain_bg, daemon=True,
+                         name="drain").start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:  # not the main thread (embedded/test use)
+        pass
+    try:
+        # serve_forever runs on the background thread; park here until a
+        # SIGTERM drain stops the server
+        while not stopped.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:
+        server.drain(grace)
+    finally:
+        server.server_close()
 
 
 def debug(cfg, args) -> None:

@@ -11,7 +11,7 @@ Grammar (``cfg.fault_plan`` or the ``HBNLP_FAULT_PLAN`` env var)::
 
     plan    := entry (';' entry)*
     entry   := [site ':'] action '@' trigger
-    trigger := ['step'] integer          # "step25" == "25"
+    trigger := ['step' | 'req'] integer  # "step25" == "25", "req5" == "5"
 
 An entry without a site rides the ``step`` site (so ``sigterm@step25`` reads
 naturally).  Each rule fires **once**.  Sites instrumented today:
@@ -36,6 +36,22 @@ naturally).  Each rule fires **once**.  Sites instrumented today:
 - ``coordinator`` — per update, polled via :func:`take` against the global
                     step (``coordinator:drop@step5`` simulates losing the
                     jax.distributed coordinator mid-run)
+- ``serve_step``  — per continuous-batching scheduler iteration that has
+                    work (serve/engine.py), polled via :func:`take`; the
+                    loop implements the action: ``serve_step:fail@N``
+                    raises into the fail-everything path (in-flight
+                    requests 500, the engine keeps serving),
+                    ``serve_step:stall@N`` wedges the loop long enough to
+                    trip the decode-loop watchdog (``HBNLP_SERVE_STALL_S``
+                    overrides the default 2 s sleep)
+- ``replica``     — per completion request in the REST handler
+                    (serve/rest.py), polled via :func:`take`:
+                    ``replica:die@reqN`` hard-kills the serving process at
+                    its Nth completion request (``os._exit`` — connection
+                    reset mid-request, exactly what the router's failover
+                    must absorb); ``replica:wedge_healthz@N`` wedges the
+                    obs exporter's /healthz so the router's poll timeout,
+                    not a clean 503, has to catch it
 
 Actions:
 
@@ -54,6 +70,12 @@ Actions:
 - ``drop``    — caller-implemented (``take`` sites only): the train loop's
                 distributed poll (reliability/dist.py::check_peers) raises
                 ``CoordinatorLost`` — ``coordinator:drop@step5``
+- ``stall``   — caller-implemented (``take`` sites only): the serving
+                scheduler loop sleeps past its watchdog threshold
+                (``serve_step:stall@N``)
+- ``wedge_healthz`` — caller-implemented (``take`` sites only): the
+                serving health snapshot hangs so /healthz stops answering
+                (``replica:wedge_healthz@N``)
 
 Example: ``fault_plan="ckpt_write:fail@2;feeder:die@step10;sigterm@step25"``
 fails the 2nd checkpoint write once (retried), kills the feeder thread at
@@ -72,7 +94,8 @@ from ..sync import make_lock
 
 LOG = logging.getLogger("homebrewnlp_tpu.reliability.faults")
 
-ACTIONS = ("fail", "die", "sigterm", "sigint", "corrupt", "nan", "drop")
+ACTIONS = ("fail", "die", "sigterm", "sigint", "corrupt", "nan", "drop",
+           "stall", "wedge_healthz")
 #: bare actions (no explicit site) ride the train-step site
 DEFAULT_SITE = "step"
 
@@ -112,13 +135,15 @@ def parse_plan(spec: typing.Optional[str]) -> typing.List[FaultRule]:
                              "[site:]action@trigger")
         left, trigger = entry.rsplit("@", 1)
         trigger = trigger.strip()
-        if trigger.startswith("step"):
-            trigger = trigger[len("step"):]
+        for prefix in ("step", "req"):  # "die@step10" / "replica:die@req5"
+            if trigger.startswith(prefix):
+                trigger = trigger[len(prefix):]
+                break
         try:
             at = int(trigger)
         except ValueError:
             raise ValueError(f"fault plan entry {entry!r}: trigger must be "
-                             "an integer (optionally 'step'-prefixed)")
+                             "an integer (optionally 'step'/'req'-prefixed)")
         if ":" in left:
             site, action = (p.strip() for p in left.split(":", 1))
         else:
@@ -226,7 +251,7 @@ class FaultPlan:
 
     def _execute(self, rule: FaultRule, path: typing.Optional[str]) -> None:
         LOG.warning("fault injection: firing %s", rule)
-        if rule.action in ("nan", "drop"):
+        if rule.action in ("nan", "drop", "stall", "wedge_healthz"):
             # caller-implemented actions reached through hit() instead of
             # take(): nothing to execute here
             LOG.error("rule %s: %r is caller-implemented (take()); "

@@ -29,7 +29,23 @@ import random
 import time
 import typing
 
-from ..obs.registry import REGISTRY, MetricsRegistry
+try:
+    from ..obs.registry import REGISTRY, MetricsRegistry
+except ImportError:  # loaded by file path (tools/graftserve.py _load_light)
+    import importlib.util as _ilu
+    import os as _os
+    import sys as _sys
+    _reg = (_sys.modules.get("homebrewnlp_tpu.obs.registry")
+            or _sys.modules.get("hbnlp_obs_registry"))
+    if _reg is None:
+        _spec = _ilu.spec_from_file_location(
+            "hbnlp_obs_registry",
+            _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                          _os.pardir, "obs", "registry.py"))
+        _reg = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_reg)
+        _sys.modules["hbnlp_obs_registry"] = _reg
+    REGISTRY, MetricsRegistry = _reg.REGISTRY, _reg.MetricsRegistry
 
 LOG = logging.getLogger("homebrewnlp_tpu.reliability")
 

@@ -54,9 +54,10 @@ from ..config import Config
 from ..data.feed import TEXT_AXES
 from ..infer import kv_cache as kvc
 from ..infer.sampler import _fire_first_token, _gumbel_argmax_lanes
+from ..reliability import faults
 from ..sync import make_condition
 from . import slo
-from .interface import (QueueDeadlineExceeded, _RowStream,
+from .interface import (QueueDeadlineExceeded, RequestCancelled, _RowStream,
                         effective_truncation, tokenizer_for)
 
 #: bump when the executable calling convention changes (AOT cache keying).
@@ -459,6 +460,9 @@ class BatchEngine:
         self._closed = False
         self._batch_observer: typing.Optional[typing.Callable] = None
         self._step_observer: typing.Optional[typing.Callable] = None
+        # decode-loop watchdog feed (slo.EngineHealth): the loop stamps
+        # iteration start/end so /healthz can report a wedged scheduler
+        self._health = None
         # serving trace (docs/observability.md "Streaming and inter-token
         # latency"): decode-loop phase spans on the scheduler thread's
         # track plus one virtual track per lane (prefilling/occupied with
@@ -569,6 +573,14 @@ class BatchEngine:
         latency")."""
         with self._cv:
             self._step_observer = fn
+
+    def set_health(self, health) -> None:
+        """Attach the decode-loop liveness probe (``slo.EngineHealth``):
+        the scheduler stamps each iteration that has work, so a wedged
+        dispatch flips ``/healthz`` to stalled while an idle loop stays
+        healthy."""
+        with self._cv:
+            self._health = health
 
     def submit(self, prompt: typing.Sequence[int], temperature: float,
                max_tokens: typing.Optional[int],
@@ -714,6 +726,10 @@ class BatchEngine:
             for r in dropped:
                 if r.sink is not None:  # cancelled before admission: the
                     r.sink.put(None)    # stream ends with just the sentinel
+                try:  # unblock a fetcher that didn't initiate the cancel
+                    r.out.put_nowait(("err", RequestCancelled(r.rid)))
+                except queue.Full:
+                    pass  # deadline-cancel already consumed its slot
             with self._cv:
                 if not self._queue:
                     return
@@ -1001,6 +1017,10 @@ class BatchEngine:
                     self._cv.wait(timeout=0.5)
                 if self._closed and self.active_lanes() == 0 and not self._queue:
                     return
+            with self._cv:
+                health = self._health
+            if health is not None:
+                health.iteration_started()
             t0 = time.perf_counter()
             segs: typing.List[tuple] = []  # contiguous (name, t0, t1)
             prefill_segs: typing.List[tuple] = []
@@ -1008,6 +1028,8 @@ class BatchEngine:
             stepped = False
             n_active = 0
             try:
+                self._chaos_serve_step()
+                self._reap_cancelled()
                 self._admit(prefill_segs, stall)
                 if self._prefill_fifo:
                     self._advance_prefill(prefill_segs)
@@ -1018,9 +1040,67 @@ class BatchEngine:
                     stepped = True
             except Exception as e:  # noqa: BLE001 - fail every in-flight req
                 self._fail_all(e)
+                if health is not None:
+                    health.iteration_completed(time.perf_counter() - t0)
                 continue
             self._report_iteration(t0, segs, prefill_segs, stall[0],
                                    n_active, stepped)
+            if health is not None:
+                health.iteration_completed(time.perf_counter() - t0)
+
+    def _chaos_serve_step(self) -> None:
+        """Poll the ``serve_step`` fault site once per iteration that has
+        work (reliability/faults.py; take-only — the actions need loop
+        context): ``stall`` wedges THIS iteration past the watchdog bound
+        (``HBNLP_SERVE_STALL_S`` overrides the 2 s default — drills hold
+        the stall long enough for a router poll to observe it), ``fail``
+        raises into the loop's fail-everything path."""
+        for action in faults.take("serve_step"):
+            if action == "stall":
+                time.sleep(float(os.environ.get("HBNLP_SERVE_STALL_S",
+                                                "2.0")))
+            elif action == "fail":
+                raise faults.FaultInjectedIOError(
+                    "injected serve_step failure (chaos)")
+
+    def _reap_cancelled(self) -> None:
+        """Free lanes whose client walked away (SSE disconnect → the REST
+        handler set ``req.cancelled``): release the lane and its KV blocks
+        for queued work instead of decoding an abandoned stream to
+        completion.  Mid-chunked-prefill lanes leave the FIFO too.  The
+        result queue gets :class:`RequestCancelled` so any thread still
+        blocked in ``fetch()`` unblocks."""
+        reaped: typing.List[tuple] = []
+        for lane, req in enumerate(self._lane_req):
+            if req is None or not req.cancelled.is_set():
+                continue
+            if lane in self._prefill_fifo:
+                self._prefill_fifo.remove(lane)
+            generated = max(0, int(self._pos_h[lane])
+                            - max(req.prompt_rows - 1, 0))
+            self._lane_req[lane] = None
+            self._end_row[lane] = 0
+            if req.tag:
+                slo.unregister_first_token(req.tag)
+                self._tags[lane] = 0
+            self.allocator.free(req.rid)
+            reaped.append((lane, req, generated))
+        for lane, req, generated in reaped:
+            if req.rstream is not None:
+                req.rstream.close()
+            elif req.sink is not None:
+                req.sink.put(None)
+            if req.rec is not None:
+                req.rec.mark_engine_done()
+            if self.tracer is not None and req.t_admitted is not None:
+                self.tracer.add("occupied", req.t_admitted,
+                                time.perf_counter(), track=f"lane{lane}",
+                                rid=req.rid, cancelled=True)
+            try:
+                req.out.put_nowait(("err",
+                                    RequestCancelled(req.rid, generated)))
+            except queue.Full:
+                pass
 
     def _report_iteration(self, t0: float, segs: typing.List[tuple],
                           prefill_segs: typing.List[tuple],
@@ -1155,6 +1235,9 @@ class BatchInterface:
     def set_step_observer(self, fn) -> None:
         self.engine.set_step_observer(fn)
 
+    def set_health(self, health) -> None:
+        self.engine.set_health(health)
+
     def lane_count(self) -> int:
         """Concurrent drain width (serve_max_batch) — Retry-After pricing
         divides the backlog by it (``ServeSLO.set_lane_count``)."""
@@ -1174,6 +1257,9 @@ class BatchInterface:
         def fetch():
             return self.engine.fetch(req)
 
+        # client-abandonment hook (SSE disconnect): the scheduler's reap
+        # pass frees the lane + KV blocks at the next iteration
+        fetch.cancel = req.cancelled.set
         return fetch if asynchronous else fetch()
 
     def close(self) -> None:

@@ -49,6 +49,21 @@ class QueueDeadlineExceeded(RuntimeError):
         super().__init__(msg)
 
 
+class RequestCancelled(RuntimeError):
+    """The client abandoned this completion (SSE disconnect mid-stream,
+    or an explicit ``fetch.cancel()``): the scheduler reaped the lane and
+    freed its KV blocks instead of decoding to completion
+    (docs/reliability.md "Serving resilience").  Raised from ``fetch()``
+    so any thread still blocked on the result unblocks promptly."""
+
+    def __init__(self, rid: int, generated: int = 0):
+        self.rid = int(rid)
+        self.generated = int(generated)
+        super().__init__(
+            f"request rid={rid} cancelled by client after "
+            f"{generated} generated row(s); lane and KV blocks reclaimed")
+
+
 class ByteTokenizer:
     def encode(self, text: str) -> typing.List[int]:
         return list(text.encode("utf-8", errors="replace"))
@@ -541,6 +556,16 @@ class InterfaceWrapper:
                 raise value
             return value
 
+        def cancel():
+            # honored while queued (a worker drops cancelled jobs unrun);
+            # a started job finishes its serialized engine call — this
+            # wrapper decodes one request at a time, so there is no lane
+            # or KV pool to reclaim early (BatchInterface has the real
+            # mid-decode reap)
+            job.cancelled.set()
+            self._retire(job)
+
+        fetch.cancel = cancel
         return fetch if asynchronous else fetch()
 
     def close(self):

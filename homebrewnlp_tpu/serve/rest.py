@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import queue
 import threading
 import time
@@ -25,6 +26,7 @@ from ..config import Config
 from ..obs import exporter as obs_exporter
 from ..obs import spans
 from ..obs.registry import REGISTRY
+from ..reliability import faults
 from . import slo as slo_mod
 from .interface import (CompletionEngine, InterfaceWrapper,
                         QueueDeadlineExceeded)
@@ -50,6 +52,29 @@ def request_metrics(registry=None):
 def _sanitize_tokens(tokens: typing.Sequence[int], vocab: int) -> typing.List[int]:
     # the reference clamps out-of-vocab ids (rest_api.py:42-53)
     return [min(max(int(t), 0), vocab - 1) for t in tokens]
+
+
+class _SseStream:
+    """Iterator facade over a streaming generator carrying the abandon
+    hook: a generator cannot take attributes, so this thin wrapper holds
+    the engine-side ``fetch.cancel`` for the SSE writer — on client
+    disconnect the handler calls :meth:`cancel` and the scheduler's reap
+    pass frees the lane + KV blocks instead of decoding the abandoned
+    stream to completion (docs/reliability.md "Serving resilience")."""
+
+    def __init__(self, it, cancel=None):
+        self._it = it
+        self._cancel = cancel
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._it)
+
+    def cancel(self) -> None:
+        if self._cancel is not None:
+            self._cancel()
 
 
 def _request_xid(headers) -> str:
@@ -211,7 +236,7 @@ class RestAPI:
                           out[prompt_len:])} if decode_text
                      else {"completion": out.tolist()})
             yield dict(final, done=True, **echo)
-        return gen()
+        return _SseStream(gen(), getattr(fetch, "cancel", None))
 
     def token_completion_stream(self, body: dict):
         toks = _sanitize_tokens(body.get("prompt", body.get("tokens", [])),
@@ -243,6 +268,35 @@ class _ApiServer(ThreadingHTTPServer):
     _kv_probe = None
     _lane_probe = None
     _batch_wrapper = None
+    _watchdog = None
+    #: graceful-drain latch (docs/reliability.md "Serving resilience"):
+    #: once set, new completion POSTs answer 503 while in-flight streams
+    #: run to completion — flipped by drain(), read lock-free in do_POST
+    #: (a stale read only delays the refusal by one request)
+    draining = False
+    health = None
+
+    def drain(self, grace_deadline_s: float = 30.0) -> bool:
+        """Graceful drain state machine: (1) stop admitting — the latch
+        above 503s new completions and ``/healthz`` flips to ``draining``
+        so the router sheds this replica; (2) finish in-flight streams,
+        bounded by ``grace_deadline_s``; (3) stop serving.  Returns True
+        when every in-flight request finished inside the grace window
+        (zero 5xx to drained clients), False when the deadline cut the
+        wait short.  Call from any thread EXCEPT a handler thread
+        (``shutdown()`` would deadlock waiting on serve_forever)."""
+        self.draining = True
+        if self.health is not None:
+            self.health.set_draining(True)
+        deadline = time.monotonic() + max(0.0, float(grace_deadline_s))
+        clean = True
+        while self.slo.inflight() > 0:
+            if time.monotonic() >= deadline:
+                clean = False
+                break
+            time.sleep(0.05)
+        self.shutdown()
+        return clean
 
     def shutdown(self):
         super().shutdown()
@@ -256,6 +310,9 @@ class _ApiServer(ThreadingHTTPServer):
         obs, self._obs_server = self._obs_server, None
         if obs is not None:
             obs_exporter.stop_server(obs)
+        wd, self._watchdog = self._watchdog, None
+        if wd is not None:
+            wd.stop()
         probe, self._slo_probe = self._slo_probe, None
         if probe is not None:
             self.slo.clear_queue_probe(probe)
@@ -380,6 +437,30 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                                      else REGISTRY), on_alert=on_alert)
         if flight is not None:
             flight.set_alerts_probe(alerts.summary)
+    # -- replica liveness (docs/reliability.md "Serving resilience"):
+    # EngineHealth turns the scheduler's iteration stamps into the
+    # /healthz status the router health-gates on — stalled (503: a decode
+    # iteration outlived watchdog_factor x its EMA), draining (SIGTERM
+    # grace drain), or ok.  The serialized InterfaceWrapper path carries
+    # no iteration stamps, so its health only ever reports ok/draining.
+    health = None
+    watchdog = None
+    # no wrapper (stub APIs) → no liveness to attest: /healthz stays
+    # "metrics-only" rather than claiming an engine is alive
+    if cfg is not None and wrapper is not None:
+        health = slo_mod.EngineHealth(
+            factor=float(getattr(cfg, "watchdog_factor", 0.0) or 0.0),
+            min_stall_s=float(getattr(cfg, "serve_watchdog_min_stall_s",
+                                      1.0)))
+        if wrapper is not None and hasattr(wrapper, "set_health"):
+            wrapper.set_health(health)
+            if health.factor > 0:
+                # the watchdog thread only pays for evidence (stall
+                # counter + flight bundle); detection is EngineHealth's
+                watchdog = slo_mod.ServeWatchdog(
+                    health, flight=flight,
+                    registry=registry if registry is not None else REGISTRY)
+                watchdog.start()
 
     class Handler(BaseHTTPRequestHandler):
         #: in-flight record for the correlation-header hook (end_headers);
@@ -418,6 +499,37 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                     status = 404
                     self.send_error(404)
                     return
+                if name in ("token_completion", "completion"):
+                    if getattr(self.server, "draining", False):
+                        # graceful drain: in-flight streams finish, new
+                        # completions get a clean retryable refusal — the
+                        # router stopped sending here the moment /healthz
+                        # flipped to draining, so this only catches the
+                        # poll-gap race (and a racer's 503 lands before any
+                        # body byte, squarely in the failover window)
+                        status = 503
+                        payload = json.dumps(
+                            {"error": "draining: replica is shutting down",
+                             "retry_after_s": 1.0}).encode()
+                        self.send_response(503)
+                        self.send_header("Retry-After", "1")
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                        return
+                    # chaos (reliability/faults.py `replica` site, polled
+                    # once per completion request): `die` hard-kills this
+                    # replica mid-request — the router observes the dropped
+                    # connection; `wedge_healthz` hangs the health snapshot
+                    # so only the router's poll TIMEOUT can catch it
+                    for action in faults.take("replica"):
+                        if action == "die":
+                            os._exit(1)
+                        elif (action == "wedge_healthz"
+                              and health is not None):
+                            health.wedge()
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(length) or b"{}")
@@ -554,6 +666,13 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                     for event in gen:
                         self._sse_event(event)
                 except OSError as e:  # client went away mid-stream
+                    # reclaim promptly: flag the request cancelled so the
+                    # scheduler's next reap pass frees the lane and its KV
+                    # blocks for queued work instead of decoding the
+                    # abandoned stream to completion
+                    cancel = getattr(gen, "cancel", None)
+                    if cancel is not None:
+                        cancel()
                     LOG.debug("SSE client disconnected: xid=%s %s",
                               self._rec.xid or "-" if self._rec else "-", e)
                 except Exception as e:  # noqa: BLE001 - headers are out
@@ -581,6 +700,8 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
     server.flight = flight  # incident bundles / debugz surfaces
     server.alerts = alerts  # SLO burn-rate evaluator (None w/o objectives)
     server.tracer = tracer  # the shared serving span ring
+    server.health = health  # replica liveness (router health gate + drain)
+    server._watchdog = watchdog
     server._slo_probe = slo_probe
     server._kv_probe = kv_probe
     server._lane_probe = lane_probe
@@ -594,7 +715,8 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
             from ..obs import fleet
             server._obs_server = obs_exporter.start_server(
                 eff_obs, registry=registry if registry is not None
-                else REGISTRY, slo_probe=serve_slo.summary,
+                else REGISTRY, health=health,
+                slo_probe=serve_slo.summary,
                 identity=fleet.identity(cfg),
                 alerts_probe=(alerts.summary if alerts is not None
                               else None))

@@ -1,0 +1,666 @@
+"""Health-aware replica router: one HTTP front over N engine replicas.
+
+The serving scale-out story (ROADMAP "heavy traffic"; docs/reliability.md
+"Serving resilience"): clients talk to ONE port; behind it the router
+load-balances completion requests over a replica set, health-gated by each
+replica's ``/healthz`` (the obs exporter's slo + alerts blocks):
+
+- **shed** — a replica reporting ``stalled`` (decode-loop watchdog) or
+  ``draining`` (SIGTERM grace drain), or whose health poll times out or
+  errors, receives no new requests until a poll succeeds again; a replica
+  with firing SLO alerts or a FULL KV pool is *degraded* — used only when
+  no fully-healthy peer remains.
+- **failover** — replica death observed by the router (connection refused,
+  a 5xx answer, or the connection dropping before the FIRST response body
+  byte) transparently retries the request on a healthy peer, preserving
+  the client's ``X-Request-Id`` so the merged trace shows the failed and
+  the retried attempt under a single id.  Once the first body byte has
+  been relayed the stream is committed: the router NEVER retries past
+  that point (at-most-once delivery past the first SSE token — a re-run
+  could resample a divergent completion and the client has already seen
+  the prefix).
+- **drain** — SIGTERM starts the graceful exit: stop admitting (new
+  completions answer 503), finish relaying in-flight streams bounded by
+  ``grace_deadline_s``, then stop.
+
+Stdlib-only, in the ``tools/supervise.py`` house style: loadable by file
+path (graftserve) with import fallbacks for the sync shim and the metrics
+registry.  Router metrics (docs/observability.md):
+``hbnlp_router_requests_total{replica,outcome}``,
+``hbnlp_router_failovers_total``, ``hbnlp_router_replicas_healthy``.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import http.client
+import json
+import logging
+import signal
+import threading
+import time
+import typing
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+try:
+    from ..sync import make_lock
+except ImportError:  # loaded by file path (tools/graftserve.py _load_light)
+    import sys as _sys
+    _sync = (_sys.modules.get("homebrewnlp_tpu.sync")
+             or _sys.modules.get("hbnlp_sync"))
+    if _sync is not None:
+        make_lock = _sync.make_lock
+    else:
+        def make_lock(name):
+            return threading.Lock()
+
+try:
+    from ..obs.registry import REGISTRY, MetricsRegistry
+except ImportError:  # standalone: load the registry next to this file
+    import importlib.util as _ilu
+    import os as _os
+    import sys as _sys
+    _reg = (_sys.modules.get("homebrewnlp_tpu.obs.registry")
+            or _sys.modules.get("hbnlp_obs_registry"))
+    if _reg is None:
+        _spec = _ilu.spec_from_file_location(
+            "hbnlp_obs_registry",
+            _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                          _os.pardir, "obs", "registry.py"))
+        _reg = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_reg)
+        _sys.modules["hbnlp_obs_registry"] = _reg
+    REGISTRY, MetricsRegistry = _reg.REGISTRY, _reg.MetricsRegistry
+
+LOG = logging.getLogger("homebrewnlp_tpu.serve.router")
+
+#: response-body relay unit; read1 returns whatever the socket has, so SSE
+#: events relay at token cadence, never buffered up to this size
+CHUNK = 8192
+
+#: request paths eligible for proxying + failover (the engine's POST
+#: surface); anything else 404s at the router
+PROXY_POSTS = ("encode", "decode", "check_tokens", "token_completion",
+               "completion", "debugz/dump")
+#: paths the drain latch refuses (stop ADMITTING means stop accepting new
+#: completions; cheap tokenizer calls keep working for in-flight clients)
+ADMIT_PATHS = ("token_completion", "completion")
+
+
+def router_metrics(registry=None):
+    reg = registry if registry is not None else REGISTRY
+    return (
+        reg.counter("hbnlp_router_requests_total",
+                    "proxied request attempts by replica and outcome",
+                    labelnames=("replica", "outcome")),
+        reg.counter("hbnlp_router_failovers_total",
+                    "requests transparently retried on another replica"),
+        reg.gauge("hbnlp_router_replicas_healthy",
+                  "replicas currently eligible for new requests"),
+    )
+
+
+class Replica:
+    """One backend: the serving URL requests proxy to and the obs URL
+    whose ``/healthz`` gates routing (separate ports on one process)."""
+
+    def __init__(self, url: str, obs_url: str = "", name: str = ""):
+        self.url = url.rstrip("/")
+        self.obs_url = (obs_url or url).rstrip("/")
+        parsed = urllib.parse.urlsplit(self.url)
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or 80
+        self.name = name or f"{self.host}:{self.port}"
+
+    def __repr__(self):
+        return f"Replica({self.name})"
+
+
+class ReplicaState:
+    """Router-side view of one replica.  All mutable fields are guarded by
+    the owning Router's ``_lock`` (graftsync-declared)."""
+
+    def __init__(self, replica: Replica):
+        self.replica = replica
+        self.healthy = False       # eligible for new requests
+        self.degraded = False      # reachable but kv-full / alerts firing
+        self.reason = "unpolled"
+        self.inflight = 0
+        self.last_poll_s = 0.0
+        self.snapshot: typing.Optional[dict] = None
+
+
+def classify_health(status: int, snap: typing.Optional[dict]
+                    ) -> typing.Tuple[str, str]:
+    """Map one health poll to a routing tier.
+
+    Returns ``(tier, reason)`` with tier one of ``ok`` (route here),
+    ``degraded`` (route only when no ok peer remains: the replica answers
+    but its KV pool is exhausted or an SLO alert is firing), or ``down``
+    (shed entirely: stalled, draining, or unparseable).  Pure function —
+    the unit tests drive it straight from canned snapshots."""
+    if snap is None or not isinstance(snap, dict):
+        return "down", f"unparseable healthz (HTTP {status})"
+    hstat = str(snap.get("status", ""))
+    if hstat == "stalled" or status == 503:
+        return "down", "stalled"
+    if hstat == "draining":
+        return "down", "draining"
+    if status != 200:
+        return "down", f"healthz HTTP {status}"
+    alerts = snap.get("alerts") or {}
+    firing = alerts.get("firing") or []
+    if firing:
+        return "degraded", "alerts firing: " + ",".join(
+            str(f) for f in firing)[:120]
+    slo = snap.get("slo") or {}
+    kv_free = slo.get("kv_blocks_free")
+    if kv_free is not None and int(kv_free) <= 0:
+        return "degraded", "kv pool exhausted"
+    return "ok", "ok"
+
+
+class Router:
+    """Routing brain + health watcher, independent of the HTTP front (the
+    unit tests drive :meth:`pick` / :meth:`observe_poll` directly)."""
+
+    def __init__(self, replicas: typing.Sequence[Replica],
+                 health_interval_s: float = 1.0,
+                 health_timeout_s: float = 2.0,
+                 failover_retries: int = 1,
+                 registry: typing.Optional[MetricsRegistry] = None):
+        self.replicas = [ReplicaState(r) for r in replicas]
+        self.health_interval_s = float(health_interval_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self.failover_retries = int(failover_retries)
+        self._lock = make_lock("serve.router.Router._lock")
+        self._rr = 0  # round-robin tie-break cursor
+        self.draining = False
+        self._stop = threading.Event()
+        self._threads: typing.List[threading.Thread] = []
+        self.registry = registry if registry is not None else REGISTRY
+        (self.m_requests, self.m_failovers,
+         self.m_healthy) = router_metrics(registry)
+        self.m_healthy.set(0.0)
+        #: router-side attempt log, merged into GET /debugz/trace so a
+        #: failed attempt survives even when its replica died with its
+        #: span ring (bounded ring; drops oldest)
+        self._attempts: "collections.deque[dict]" = collections.deque(
+            maxlen=4096)
+
+    # -- health watching -----------------------------------------------------
+    def start_health_watch(self) -> None:
+        for i, state in enumerate(self.replicas):
+            t = threading.Thread(target=self._watch, args=(state,),
+                                 daemon=True, name=f"router-health-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.health_timeout_s + 1.0)
+
+    def _watch(self, state: ReplicaState) -> None:
+        # poll immediately, then on the interval: a replica set is usable
+        # the moment its healthz answers, not one interval later
+        while True:
+            self.poll_replica(state)
+            if self._stop.wait(self.health_interval_s):
+                return
+
+    def poll_replica(self, state: ReplicaState) -> None:
+        """One health poll: GET the replica's ``/healthz`` bounded by
+        ``health_timeout_s`` (a WEDGED healthz — `replica:wedge_healthz`
+        chaos — only ever fails by this timeout) and apply the tiering."""
+        url = state.replica.obs_url + "/healthz"
+        status, snap = 0, None
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=self.health_timeout_s) as resp:
+                status = resp.status
+                snap = json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            status = e.code
+            try:
+                snap = json.loads(e.read().decode() or "{}")
+            except (ValueError, OSError):
+                snap = None
+        except Exception as e:  # noqa: BLE001 - conn refused/timeout/reset
+            self.observe_poll(state, "down", f"{type(e).__name__}: {e}"[:120],
+                              None)
+            return
+        tier, reason = classify_health(status, snap)
+        self.observe_poll(state, tier, reason, snap)
+
+    def observe_poll(self, state: ReplicaState, tier: str, reason: str,
+                     snap: typing.Optional[dict]) -> None:
+        with self._lock:
+            was = (state.healthy, state.degraded)
+            state.healthy = tier == "ok"
+            state.degraded = tier == "degraded"
+            state.reason = reason
+            state.snapshot = snap
+            state.last_poll_s = time.monotonic()
+            healthy_n = sum(1 for s in self.replicas if s.healthy)
+        self.m_healthy.set(float(healthy_n))
+        if was != (state.healthy, state.degraded):
+            LOG.info("replica %s -> %s (%s)", state.replica.name, tier,
+                     reason)
+
+    def mark_down(self, state: ReplicaState, reason: str) -> None:
+        """Request-path demotion: an attempt just failed on this replica,
+        so stop routing to it NOW — the next successful poll restores it."""
+        self.observe_poll(state, "down", reason, None)
+
+    # -- selection -----------------------------------------------------------
+    def pick(self, tried: typing.Collection[ReplicaState] = ()
+             ) -> typing.Optional[ReplicaState]:
+        """Least-inflight healthy replica not in ``tried`` (round-robin
+        tie-break); degraded replicas only when no healthy one remains.
+        Increments the pick's inflight count — pair with :meth:`release`."""
+        with self._lock:
+            for pool in (
+                    [s for s in self.replicas
+                     if s.healthy and s not in tried],
+                    [s for s in self.replicas
+                     if s.degraded and s not in tried]):
+                if not pool:
+                    continue
+                low = min(s.inflight for s in pool)
+                candidates = [s for s in pool if s.inflight == low]
+                choice = candidates[self._rr % len(candidates)]
+                self._rr += 1
+                choice.inflight += 1
+                return choice
+            return None
+
+    def release(self, state: ReplicaState) -> None:
+        with self._lock:
+            state.inflight = max(0, state.inflight - 1)
+
+    # -- bookkeeping ---------------------------------------------------------
+    def note_attempt(self, replica_name: str, outcome: str, xid: str,
+                     path: str, t0: float, attempt: int) -> None:
+        self.m_requests.labels(replica=replica_name, outcome=outcome).inc()
+        now = time.perf_counter()
+        self._attempts.append({
+            "name": f"router/{outcome}", "ph": "X", "pid": 0,
+            "tid": threading.get_ident() % 10_000,
+            "ts": t0 * 1e6, "dur": max(0.0, (now - t0) * 1e6),
+            "args": {"xid": xid, "replica": replica_name, "path": path,
+                     "attempt": attempt, "outcome": outcome}})
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "status": "draining" if self.draining else "ok",
+                "healthy": sum(1 for s in self.replicas if s.healthy),
+                "replicas": {
+                    s.replica.name: {
+                        "url": s.replica.url,
+                        "healthy": s.healthy,
+                        "degraded": s.degraded,
+                        "reason": s.reason,
+                        "inflight": s.inflight,
+                    } for s in self.replicas}}
+
+    def merged_trace(self, timeout_s: float = 5.0) -> dict:
+        """Fetch every live replica's ``/debugz/trace`` and merge under
+        one timeline: replica i's events get pid ``i + 1``; the router's
+        own attempt log is pid 0 — so a failed attempt and its failover
+        retry appear under one ``xid`` even when the failed replica took
+        its span ring down with it."""
+        events: typing.List[dict] = [
+            {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+             "args": {"name": "router"}}]
+        with self._lock:
+            events.extend(dict(e) for e in self._attempts)
+            states = list(self.replicas)
+        for i, state in enumerate(states):
+            pid = i + 1
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0,
+                           "args": {"name": state.replica.name}})
+            try:
+                with urllib.request.urlopen(
+                        state.replica.url + "/debugz/trace",
+                        timeout=timeout_s) as resp:
+                    doc = json.loads(resp.read().decode() or "{}")
+            except Exception:  # noqa: BLE001 - dead replica: keep merging
+                continue
+            for ev in doc.get("traceEvents", []):
+                ev = dict(ev)
+                ev["pid"] = pid
+                events.append(ev)
+        return {"traceEvents": events}
+
+
+class _RouterServer(ThreadingHTTPServer):
+    daemon_threads = True
+    router: Router = None
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        #: proxied requests currently being relayed (drain gates on zero)
+        self._inflight = 0
+        self._inflight_lock = make_lock(
+            "serve.router._RouterServer._inflight_lock")
+
+    def track(self, delta: int) -> None:
+        with self._inflight_lock:
+            self._inflight += delta
+
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def drain(self, grace_deadline_s: float = 30.0) -> bool:
+        """Graceful drain (docs/reliability.md): stop admitting — new
+        completions answer 503 and ``/healthz`` flips to draining — then
+        wait for in-flight relays bounded by ``grace_deadline_s``, stop
+        the health watchers, and stop serving.  True iff every in-flight
+        stream finished inside the window."""
+        self.router.draining = True
+        deadline = time.monotonic() + max(0.0, float(grace_deadline_s))
+        clean = True
+        while self.inflight() > 0:
+            if time.monotonic() >= deadline:
+                clean = False
+                break
+            time.sleep(0.05)
+        self.router.stop()
+        self.shutdown()
+        return clean
+
+
+def _filtered_headers(headers, drop=("host", "connection", "keep-alive",
+                                     "transfer-encoding",
+                                     "content-length")) -> dict:
+    return {k: v for k, v in headers.items() if k.lower() not in drop}
+
+
+def serve_router(router: Router, host: str = "127.0.0.1", port: int = 0,
+                 background: bool = False) -> _RouterServer:
+    """Start the HTTP front: POSTs proxy with health-gated failover; GET
+    ``/metrics`` renders the router registry, ``/healthz`` the replica
+    table, ``/debugz/trace`` the merged timeline."""
+    registry_ref = router.registry
+
+    class Handler(BaseHTTPRequestHandler):
+
+        # -- GET surfaces ----------------------------------------------------
+        def do_GET(self):
+            path = self.path.split("?", 1)[0].strip("/")
+            if path == "metrics":
+                reg = registry_ref if registry_ref is not None else REGISTRY
+                body = reg.render().encode()
+                self._reply(200, body,
+                            "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "healthz":
+                doc = router.status()
+                code = 200 if doc["healthy"] > 0 else 503
+                self._reply(code, json.dumps(doc).encode(),
+                            "application/json")
+            elif path == "debugz/trace":
+                self._reply(200, json.dumps(router.merged_trace()).encode(),
+                            "application/json")
+            else:
+                self.send_error(404)
+
+        def _reply(self, status: int, body: bytes, ctype: str,
+                   extra: typing.Optional[dict] = None) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        # -- proxy -----------------------------------------------------------
+        def do_POST(self):
+            path = self.path.split("?", 1)[0].strip("/")
+            if path not in PROXY_POSTS:
+                self.send_error(404)
+                return
+            if router.draining and path in ADMIT_PATHS:
+                self._reply(503, json.dumps(
+                    {"error": "draining: router is shutting down",
+                     "retry_after_s": 1.0}).encode(),
+                    "application/json", {"Retry-After": "1"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            xid = (self.headers.get("X-Request-Id") or "").strip()
+            fwd = _filtered_headers(self.headers)
+            if not xid:
+                # mint here so EVERY attempt — including a failed one the
+                # replica logged before dying — shares one correlation id
+                xid = uuid.uuid4().hex[:16]
+            fwd["X-Request-Id"] = xid
+            self.server.track(+1)
+            try:
+                self._proxy(path, body, fwd, xid)
+            finally:
+                self.server.track(-1)
+
+        def _proxy(self, path: str, body: bytes, fwd: dict,
+                   xid: str) -> None:
+            tried: typing.List[ReplicaState] = []
+            attempts = 1 + max(0, router.failover_retries)
+            for attempt in range(attempts):
+                state = router.pick(tried)
+                if state is None:
+                    break
+                tried.append(state)
+                name = state.replica.name
+                t0 = time.perf_counter()
+                committed = False
+                try:
+                    committed, retryable, reason = self._relay(
+                        state, path, body, fwd,
+                        last=(attempt == attempts - 1))
+                except Exception as e:  # noqa: BLE001 - relay internals
+                    retryable = not committed
+                    reason = f"{type(e).__name__}: {e}"[:160]
+                if reason is None:
+                    router.note_attempt(name, "ok", xid, path, t0,
+                                        attempt)
+                    router.release(state)
+                    return
+                router.release(state)
+                if committed:
+                    # at-most-once past the first relayed byte: the client
+                    # saw a prefix; a retry could resample a DIFFERENT
+                    # completion under the same id.  Truncate instead.
+                    router.note_attempt(name, "truncated", xid, path, t0,
+                                        attempt)
+                    LOG.warning("replica %s died mid-stream (%s) xid=%s: "
+                                "committed, not retrying", name, reason,
+                                xid)
+                    try:
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+                    self.close_connection = True
+                    return
+                router.mark_down(state, f"request failed: {reason}")
+                if not retryable or attempt == attempts - 1:
+                    router.note_attempt(name, "error", xid, path, t0,
+                                        attempt)
+                    self._reply(502, json.dumps(
+                        {"error": f"replica {name} failed: {reason}",
+                         "xid": xid}).encode(), "application/json",
+                        {"X-Request-Id": xid})
+                    return
+                router.note_attempt(name, "failover", xid, path, t0,
+                                    attempt)
+                router.m_failovers.inc()
+                LOG.info("failover xid=%s path=/%s: %s failed pre-byte "
+                         "(%s), retrying", xid, path, name, reason)
+            self._reply(503, json.dumps(
+                {"error": "no healthy replica", "xid": xid,
+                 "retry_after_s": router.health_interval_s}).encode(),
+                "application/json",
+                {"Retry-After": "1", "X-Request-Id": xid})
+
+        def _relay(self, state: ReplicaState, path: str, body: bytes,
+                   fwd: dict, last: bool):
+            """One proxied attempt.  Returns ``(committed, retryable,
+            reason)`` — ``reason None`` means success.  Nothing reaches
+            the client socket until the backend's status line, headers,
+            AND first body chunk are in hand: every pre-commit failure
+            (refused, 5xx, EOF before the first SSE token) stays
+            failover-eligible."""
+            rep = state.replica
+            conn = http.client.HTTPConnection(rep.host, rep.port,
+                                              timeout=None)
+            try:
+                headers = dict(fwd)
+                headers["Content-Length"] = str(len(body))
+                headers["Connection"] = "close"
+                try:
+                    conn.request("POST", "/" + path, body=body,
+                                 headers=headers)
+                    resp = conn.getresponse()
+                except OSError as e:
+                    return False, True, f"connect/send: {e}"[:160]
+                except http.client.HTTPException as e:
+                    return False, True, f"bad response: {e}"[:160]
+                if resp.status >= 500 and not last:
+                    # a shed/draining 503 or crashed-handler 500 lands
+                    # BEFORE any body byte: route around it (the last
+                    # attempt relays it so the client sees the real error)
+                    return False, True, f"HTTP {resp.status}"
+                ctype = resp.getheader("Content-Type", "")
+                is_sse = "text/event-stream" in ctype
+                try:
+                    first = resp.read1(CHUNK)
+                except (OSError, http.client.HTTPException) as e:
+                    return False, True, f"pre-byte EOF: {e}"[:160]
+                if is_sse and first == b"":
+                    # the replica primes the first token BEFORE sending
+                    # 200, so an empty SSE body means it died in between
+                    return False, True, "pre-byte EOF (empty SSE)"
+                # ---- commit: from here on, at-most-once (a retry could
+                # resample a DIFFERENT completion under the same id, and
+                # the client may already hold a prefix) ----
+                clen = resp.getheader("Content-Length")
+                try:
+                    self.send_response(resp.status)
+                    hop = ("connection", "keep-alive", "transfer-encoding",
+                           "content-length")
+                    for k, v in resp.getheaders():
+                        if k.lower() not in hop:
+                            self.send_header(k, v)
+                    if clen is not None:
+                        self.send_header("Content-Length", clen)
+                    self.send_header("X-Replica", rep.name)
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.wfile.write(first)
+                    self.wfile.flush()
+                    while True:
+                        try:  # backend-side death is truncation, not a
+                            chunk = resp.read1(CHUNK)  # client disconnect
+                        except (OSError,
+                                http.client.HTTPException) as e:
+                            return True, False, f"mid-stream: {e}"[:160]
+                        if not chunk:
+                            break
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+                except OSError:
+                    # CLIENT went away: close the backend connection so
+                    # the replica's SSE writer hits its own OSError and
+                    # cancels the request (lane + KV blocks reclaimed).
+                    # Committed from the router's view either way — there
+                    # is no client left to retry for.
+                    conn.close()
+                    return True, False, None
+                except http.client.HTTPException as e:
+                    return True, False, f"mid-stream: {e}"[:160]
+                mid_eof = (clen is not None
+                           and resp.length not in (0, None))
+                if mid_eof:
+                    return True, False, "mid-stream EOF"
+                return True, False, None
+            finally:
+                conn.close()
+
+        def log_message(self, fmt, *args):
+            LOG.debug("router %s %s", self.address_string(), fmt % args)
+
+    server = _RouterServer((host, port), Handler)
+    server.router = router
+    router.start_health_watch()
+    if background:
+        thread = threading.Thread(target=server.serve_forever, daemon=True,
+                                  name="router")
+        thread.start()
+        return server
+    try:
+        server.serve_forever()
+    finally:
+        router.stop()
+    return server
+
+
+def _parse_replica(spec: str, index: int) -> Replica:
+    """``URL[,OBS_URL]`` → Replica (graftserve/CLI spec format)."""
+    parts = spec.split(",")
+    url = parts[0]
+    obs = parts[1] if len(parts) > 1 else ""
+    return Replica(url, obs, name=f"replica{index}")
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        description="health-aware router over engine replicas")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="URL[,OBS_URL]", required=False,
+                   help="replica serving URL + optional obs (/healthz) URL;"
+                        " repeatable")
+    p.add_argument("--health-interval-s", type=float, default=1.0)
+    p.add_argument("--health-timeout-s", type=float, default=2.0)
+    p.add_argument("--failover-retries", type=int, default=1)
+    p.add_argument("--grace-deadline-s", type=float, default=30.0)
+    args = p.parse_args(argv)
+    if not args.replica:
+        p.error("at least one --replica is required")
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    router = Router([_parse_replica(s, i)
+                     for i, s in enumerate(args.replica)],
+                    health_interval_s=args.health_interval_s,
+                    health_timeout_s=args.health_timeout_s,
+                    failover_retries=args.failover_retries)
+    server = serve_router(router, host=args.host, port=args.port,
+                          background=True)
+    LOG.info("router on %s:%d over %d replica(s)", args.host,
+             server.server_address[1], len(router.replicas))
+    done = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        threading.Thread(
+            target=lambda: (server.drain(args.grace_deadline_s),
+                            done.set()),
+            daemon=True, name="router-drain").start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    signal.signal(signal.SIGINT, _on_sigterm)
+    while not done.wait(timeout=1.0):
+        pass
+    server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

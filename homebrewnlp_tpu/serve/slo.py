@@ -667,3 +667,165 @@ class ServeSLO:
             "kv_blocks_free": self.kv_blocks_free() if have_kv else None,
             "lane_occupancy": self.lane_occupancy() if have_lane else None,
         }
+
+
+class EngineHealth:
+    """Serving liveness for the obs exporter's ``/healthz`` (the serving
+    twin of the train ``obs/exporter.Health``, docs/reliability.md
+    "Serving resilience").
+
+    The continuous-batching scheduler stamps every loop iteration
+    (:meth:`iteration_started` when it picks up work,
+    :meth:`iteration_completed` when the iteration's books close); a
+    STALL is an iteration that began and then outlived
+    ``watchdog_factor`` x the EMA iteration time (floored at
+    ``min_stall_s``) without completing — a wedged decode dispatch, a
+    dead device, an injected ``serve_step:stall``.  An IDLE engine (the
+    loop parked on its condition variable between requests) never reads
+    as stalled: only an iteration in flight can be late.
+
+    ``snapshot()`` is the exporter's health payload: ``status`` is
+    ``stalled`` (healthz answers 503 — the router routes around this
+    replica), ``draining`` (SIGTERM grace drain in progress: healthy for
+    in-flight clients, shed by the router), or ``ok``.  ``wedge()`` is
+    the ``replica:wedge_healthz`` chaos hook — the snapshot hangs, so
+    the router's poll TIMEOUT, not a clean error, has to catch it."""
+
+    #: how long a wedged snapshot hangs (bounded so teardown paths and
+    #: tests never wait forever; far past any sane health-poll timeout)
+    WEDGE_S = 600.0
+
+    def __init__(self, factor: float = 0.0, min_stall_s: float = 1.0,
+                 ema_alpha: float = 0.2):
+        self.factor = float(factor)
+        self.min_stall_s = float(min_stall_s)
+        self.ema_alpha = float(ema_alpha)
+        self._lock = make_lock("serve.slo.EngineHealth._lock")
+        self._ema_s: typing.Optional[float] = None
+        self._t_begin: typing.Optional[float] = None
+        self._iterations = 0
+        self._draining = False
+        self._wedged = False
+
+    # -- scheduler-thread stamps ---------------------------------------------
+    def iteration_started(self) -> None:
+        with self._lock:
+            self._t_begin = time.monotonic()
+
+    def iteration_completed(self, wall_s: float) -> None:
+        with self._lock:
+            self._t_begin = None
+            self._iterations += 1
+            a = self.ema_alpha
+            self._ema_s = (wall_s if self._ema_s is None
+                           else (1 - a) * self._ema_s + a * wall_s)
+
+    # -- state flips (handler / drain threads) -------------------------------
+    def set_draining(self, draining: bool = True) -> None:
+        with self._lock:
+            self._draining = bool(draining)
+
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def wedge(self) -> None:
+        """Arm the ``replica:wedge_healthz`` chaos action: every
+        subsequent :meth:`snapshot` hangs for :data:`WEDGE_S` seconds."""
+        with self._lock:
+            self._wedged = True
+
+    # -- readers (exporter / watchdog threads) -------------------------------
+    def stall_threshold_s(self) -> typing.Optional[float]:
+        """The current late-iteration bound, or None while the watchdog
+        is unarmed (``factor`` 0) or no iteration has completed yet (no
+        cadence to scale — the floor alone bounds the first one)."""
+        if self.factor <= 0:
+            return None
+        with self._lock:
+            ema = self._ema_s
+        if ema is None:
+            return self.min_stall_s
+        return max(self.factor * ema, self.min_stall_s)
+
+    def stalled(self) -> typing.Optional[float]:
+        """Seconds the in-flight iteration is overdue, or None when
+        healthy (no iteration in flight, or still under the bound)."""
+        bound = self.stall_threshold_s()
+        if bound is None:
+            return None
+        with self._lock:
+            t0 = self._t_begin
+        if t0 is None:
+            return None
+        late = time.monotonic() - t0
+        return late if late > bound else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            wedged = self._wedged
+        if wedged:
+            time.sleep(self.WEDGE_S)
+        late = self.stalled()
+        bound = self.stall_threshold_s()  # before _lock: it takes _lock too
+        with self._lock:
+            status = ("stalled" if late is not None
+                      else ("draining" if self._draining else "ok"))
+            return {
+                "status": status,
+                "iterations": self._iterations,
+                "ema_iteration_s": self._ema_s,
+                "stall_threshold_s": bound,
+                "overdue_s": late,
+                "watchdog_factor": self.factor,
+            }
+
+
+class ServeWatchdog(threading.Thread):
+    """Poll :class:`EngineHealth` and fire ONCE per stall: count
+    ``hbnlp_serve_watchdog_stalls_total`` and write a flight-recorder
+    bundle (``reason="watchdog"``) carrying the overdue iteration's
+    numbers — then re-arm only after the loop recovers, so a long wedge
+    produces one bundle, not one per poll.  Detection itself lives in
+    ``EngineHealth.stalled()`` (healthz flips 503 with no thread in the
+    loop); this thread only pays for the evidence."""
+
+    def __init__(self, health: EngineHealth, flight=None,
+                 registry: typing.Optional[MetricsRegistry] = None,
+                 poll_s: float = 0.25):
+        super().__init__(daemon=True, name="serve-watchdog")
+        self.health = health
+        self.flight = flight
+        self.poll_s = float(poll_s)
+        reg = registry if registry is not None else REGISTRY
+        self._stalls = reg.counter(
+            "hbnlp_serve_watchdog_stalls_total",
+            "decode-loop stalls the serving watchdog detected")
+        self._armed = True
+        # NB: must not be named _stop -- Thread.join() calls the
+        # private Thread._stop() method this would shadow
+        self._halt = threading.Event()
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        while not self._halt.wait(self.poll_s):
+            late = self.health.stalled()
+            if late is None:
+                self._armed = True
+                continue
+            if not self._armed:
+                continue
+            self._armed = False
+            self._stalls.inc()
+            if self.flight is not None and self.flight.wants("watchdog"):
+                try:
+                    self.flight.dump("watchdog", extra={
+                        "why": "decode-loop stall",
+                        "overdue_s": late,
+                        "health": {k: v for k, v in
+                                   self.health.snapshot().items()
+                                   if k != "status"}})
+                except Exception:  # noqa: BLE001 - evidence, not a gate
+                    pass

@@ -177,7 +177,8 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
              rate: typing.Optional[float] = None, ramp_s: float = 0.0,
              response_len: int = 16, temperature: float = 1.0,
              timeout_s: float = 300.0, trace_interval_s: float = 0.05,
-             stream: bool = False, xid_prefix: str = "gl"
+             stream: bool = False, xid_prefix: str = "gl",
+             targets: typing.Optional[typing.Sequence[str]] = None
              ) -> typing.Tuple[typing.List[dict], typing.List[list], float,
                                bool]:
     """Fire ``n_requests`` at ``url``/token_completion; returns
@@ -188,6 +189,14 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
     the join budget (per-worker request share x ``timeout_s``) — the
     records then cover only part of the run and must not be treated as a
     complete measurement (drive/check/bench all refuse to).
+
+    ``targets`` overrides ``url`` with several base URLs round-robined by
+    request index — either the replica set itself or (the common case) a
+    single router URL (``serve/router.py``).  Each record carries the
+    target it was sent to and a ``replica`` attribution: the ``X-Replica``
+    response header when the target sets one (the router names the replica
+    that actually COMMITTED the response, surviving transparent failover),
+    else the target URL itself.
 
     ``stream=True`` sends ``stream: true`` and drains each response as
     SSE: records gain ``ttft_s`` (first chunk arrival, the client's own
@@ -200,7 +209,7 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
     client/server wall stamps (``c_send_wall_s``/``c_hdr_wall_s`` and the
     echoed ``s_recv_wall_s``/``s_send_wall_s``) that
     :func:`estimate_offset` turns into one merged-trace timebase."""
-    endpoint = url.rstrip("/") + "/token_completion"
+    bases = [u.rstrip("/") for u in (targets if targets else (url,))]
     lock = threading.Lock()
     records: typing.List[dict] = []
     inflight = [0]
@@ -215,6 +224,9 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
                               inflight[0]])
 
     def _server_stamps(rec: dict, hdrs) -> None:
+        rep = hdrs.get("X-Replica")
+        if rep:  # router attribution: the replica that committed the bytes
+            rec["replica"] = rep
         for key, hname in (("s_recv_wall_s", "X-Server-Recv-S"),
                            ("s_send_wall_s", "X-Server-Send-S")):
             v = hdrs.get(hname)
@@ -227,9 +239,12 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
     def one(i: int) -> None:
         prompt = list(corpus[i % len(corpus)])
         xid = f"{xid_prefix}-{i:04d}"
+        base = bases[i % len(bases)]
+        endpoint = base + "/token_completion"
         rec = {"id": i, "xid": xid, "prompt_len": len(prompt),
                "t_send_s": round(time.perf_counter() - t_start, 6),
-               "status": 0, "tokens_generated": 0}
+               "status": 0, "tokens_generated": 0,
+               "target": base, "replica": base}
         with lock:
             inflight[0] += 1
         rec["c_send_wall_s"] = time.time()
@@ -346,6 +361,13 @@ def client_report(records: typing.Sequence[dict],
     ok = [r for r in records if r.get("status") == 200]
     tokens = sum(int(r.get("tokens_generated") or 0) for r in ok)
     n = len(records)
+    per_replica: typing.Dict[str, dict] = {}
+    for r in records:
+        row = per_replica.setdefault(str(r.get("replica") or
+                                         r.get("target") or "?"),
+                                     {"requests": 0, "ok": 0})
+        row["requests"] += 1
+        row["ok"] += int(r.get("status") == 200)
     thin = max(1, len(trace) // 200)  # bound the trace the report embeds
     ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
     gaps = [g for r in ok for g in (r.get("itl_gaps") or ())]
@@ -368,6 +390,11 @@ def client_report(records: typing.Sequence[dict],
         "goodput_tok_s": (round(tokens / duration_s, 2)
                           if duration_s > 0 else None),
         "e2e_s": _pcts([r["e2e_s"] for r in ok]),
+        "per_replica": per_replica,
+        # peak concurrent in-flight over the run — the chaos-tolerance
+        # budget: killing a replica can cost at most the requests that
+        # were in flight at the kill (check_ok chaos_tolerant=True)
+        "peak_inflight": max((int(p[1]) for p in trace), default=0),
         "inflight_trace": [list(p) for p in trace[::thin]],
     }
 
@@ -473,6 +500,68 @@ def server_report(metrics_text: str) -> dict:
     return out
 
 
+def _router_counters(metrics_text: str) -> typing.Tuple[
+        float, typing.Dict[typing.Tuple[str, str], float],
+        typing.Optional[float]]:
+    """(failovers_total, {(replica, outcome): count}, replicas_healthy)
+    from a router /metrics scrape (``serve/router.py`` owns the series)."""
+    metrics = parse_prom(metrics_text)
+    failovers = sum(v for _, v in
+                    metrics.get("hbnlp_router_failovers_total", []))
+    requests: typing.Dict[typing.Tuple[str, str], float] = {}
+    for labels, v in metrics.get("hbnlp_router_requests_total", []):
+        key = (labels.get("replica", "?"), labels.get("outcome", "?"))
+        requests[key] = requests.get(key, 0.0) + v
+    healthy = None
+    for _, v in metrics.get("hbnlp_router_replicas_healthy", []):
+        healthy = v
+    return failovers, requests, healthy
+
+
+def router_report(before_text: str, after_text: str,
+                  client_per_replica: typing.Optional[dict] = None) -> dict:
+    """Router-side arm of the fleet reconciliation: per-replica attempt
+    counts by outcome (ok / failover / truncated / error) as RUN DELTAS
+    between two /metrics scrapes bracketing the load, so a long-lived
+    router's prior traffic cannot pollute the comparison.
+
+    The ``failover`` column is reconciled against
+    ``hbnlp_router_failovers_total`` (the two are incremented on the same
+    code path — disagreement means a counter bug), and when the client's
+    own per-replica attribution (``X-Replica`` headers) is supplied, its
+    200-count per replica is checked against the router's ``ok`` outcome
+    for the same replica: the router only stamps the header on the attempt
+    that committed, so the two views must agree exactly on clean AND
+    chaotic runs alike."""
+    f0, r0, _ = _router_counters(before_text)
+    f1, r1, healthy = _router_counters(after_text)
+    per_replica: typing.Dict[str, dict] = {}
+    for (replica, outcome), v in r1.items():
+        d = v - r0.get((replica, outcome), 0.0)
+        if d:
+            per_replica.setdefault(replica, {})[outcome] = int(d)
+    failovers = int(f1 - f0)
+    column_sum = sum(row.get("failover", 0) for row in per_replica.values())
+    out: dict = {"failovers": failovers,
+                 "per_replica": per_replica,
+                 "failover_column_consistent": column_sum == failovers}
+    if healthy is not None:
+        out["replicas_healthy"] = healthy
+    if client_per_replica is not None:
+        mismatches = {}
+        names = set(per_replica) | {k for k, v in client_per_replica.items()
+                                    if v.get("ok")}
+        for name in sorted(names):
+            c_ok = int((client_per_replica.get(name) or {}).get("ok", 0))
+            s_ok = int((per_replica.get(name) or {}).get("ok", 0))
+            if c_ok != s_ok:
+                mismatches[name] = {"client_ok": c_ok, "router_ok": s_ok}
+        out["client_ok_matches_router"] = not mismatches
+        if mismatches:
+            out["mismatches"] = mismatches
+    return out
+
+
 def reconcile_report(client: dict, metrics_text: str) -> dict:
     """Client p50 e2e vs the server's own e2e histogram, inside the
     documented tolerance (module docstring), plus the serialization
@@ -530,17 +619,34 @@ def reconcile_report(client: dict, metrics_text: str) -> dict:
     return out
 
 
-def check_ok(report: dict, max_error_rate: float = 0.0) -> bool:
+def check_ok(report: dict, max_error_rate: float = 0.0,
+             chaos_tolerant: bool = False) -> bool:
     """The ``--check`` verdict as a pure function: the error rate must be
     within ``max_error_rate``, and the reconciliation must either agree
     within tolerance or have been skipped *because of* that tolerated
     non-zero error rate (reconcile_report is defined over clean runs only).
     Any other skip — no metrics URL, missing p50 — still fails, as does a
-    truncated run (run_load abandoned a live worker: partial records)."""
+    truncated run (run_load abandoned a live worker: partial records).
+
+    ``chaos_tolerant=True`` is the verdict for a CHAOS drill (a replica
+    killed mid-run behind the router): instead of an error-RATE bound it
+    accepts an error COUNT of at most the peak concurrent in-flight depth
+    (``client.peak_inflight``) — killing a replica can cost at most the
+    requests that were in flight at the kill (pre-first-byte ones fail
+    over transparently; committed ones are at-most-once and may truncate)
+    — and requires at least one success (the fleet recovered).  The
+    latency reconciliation is not consulted: it is defined over clean
+    runs, and a chaos run is by design not one.  Truncation still fails —
+    a partial measurement proves nothing about recovery."""
     rec = report.get("reconcile", {})
     client = report.get("client") or {}
     if client.get("truncated"):
         return False
+    if chaos_tolerant:
+        n = int(client.get("n_requests") or 0)
+        n_ok = int(client.get("n_ok") or 0)
+        peak = int(client.get("peak_inflight") or 0)
+        return n > 0 and n_ok >= 1 and (n - n_ok) <= peak
     err = client.get("error_rate")
     err_ok = err is not None and err <= max_error_rate
     rec_ok = (rec.get("within_tolerance", False)
@@ -555,8 +661,9 @@ def check_ok(report: dict, max_error_rate: float = 0.0) -> bool:
 
 # -- per-request log ----------------------------------------------------------
 
-LOG_FIELDS = ("id", "xid", "t_send_s", "e2e_s", "ttft_s", "status",
-              "prompt_len", "tokens_generated", "retry_after_s", "error")
+LOG_FIELDS = ("id", "xid", "replica", "t_send_s", "e2e_s", "ttft_s",
+              "status", "prompt_len", "tokens_generated", "retry_after_s",
+              "error")
 
 
 def write_log(records: typing.Sequence[dict], path: str,
@@ -633,7 +740,10 @@ def merge_traces(records: typing.Sequence[dict],
                  server_doc: typing.Optional[dict] = None) -> dict:
     """One Chrome/Perfetto document holding both arms of each request:
     the client's send->done span (pid 0) and the server's queue/prefill/
-    decode/emit spans (pid 1) on a single timebase.
+    decode/emit spans (pid 1+) on a single timebase.  A single-process
+    server doc lands on pid 1 exactly as before; a multi-process doc (the
+    router's merged router+replicas trace, ``serve/router.py``) keeps its
+    processes distinct, shifted up so pid 0 stays the client.
 
     Server events keep their relative ``ts`` but the whole process is
     shifted onto the client's wall clock via :func:`estimate_offset`; the
@@ -671,8 +781,11 @@ def merge_traces(records: typing.Sequence[dict],
         # server ts are relative to its own epoch; correct the epoch onto
         # the client clock, then rebase onto this doc's origin
         shift = (s_epoch - off - origin) * 1e6
+        s_pids = sorted({int(ev.get("pid", 0))
+                         for ev in server_doc.get("traceEvents", ())})
+        remap = {p: i + 1 for i, p in enumerate(s_pids)}
         for ev in server_doc.get("traceEvents", ()):
-            ev = dict(ev, pid=1)
+            ev = dict(ev, pid=remap[int(ev.get("pid", 0))])
             if "ts" in ev:
                 ev["ts"] = ev["ts"] + shift
             events.append(ev)
@@ -695,26 +808,51 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
           log_format: typing.Optional[str] = None,
           stream: bool = False, long_frac: float = 0.0,
           long_len: int = 0,
-          trace_out: typing.Optional[str] = None) -> dict:
+          trace_out: typing.Optional[str] = None,
+          targets: typing.Optional[typing.Sequence[str]] = None,
+          router_metrics_url: typing.Optional[str] = None) -> dict:
     """One full run: corpus -> load -> client report -> server scrape ->
     reconciliation.  The importable entry bench.py and the tests share.
     ``long_frac``/``long_len`` thread through to :func:`make_corpus` (the
     mixed prompt-length stall scenario).  ``trace_out`` fetches the
     server's span ring after the run and writes the merged client+server
-    Chrome trace there (see :func:`merge_traces`)."""
+    Chrome trace there (see :func:`merge_traces`).  ``targets`` round-
+    robins requests over several base URLs (or a router, see
+    :func:`run_load`); ``router_metrics_url`` brackets the run with two
+    router /metrics scrapes and adds the :func:`router_report` fleet arm
+    (per-replica outcome deltas + failover-column reconciliation)."""
     corpus = make_corpus(seed, max(8, n_requests), vocab, min_prompt,
                          max_prompt, long_frac=long_frac, long_len=long_len)
+    router_before, router_err = None, ""
+    if router_metrics_url:
+        try:
+            router_before = fetch_metrics(router_metrics_url)
+        except Exception as e:  # noqa: BLE001 - scrape is best-effort
+            router_before = None
+            router_err = f"{type(e).__name__}: {e}"[:200]
     records, trace, duration, truncated = run_load(
         url, corpus, n_requests, concurrency=concurrency, mode=mode,
         rate=rate, ramp_s=ramp_s, response_len=response_len,
         temperature=temperature, timeout_s=timeout_s, stream=stream,
-        xid_prefix=f"gl{seed:x}")
+        xid_prefix=f"gl{seed:x}", targets=targets)
     report = {"url": url, "mode": mode, "concurrency": concurrency,
               "rate": rate, "seed": seed, "response_len": response_len,
               "stream": bool(stream),
               "long_frac": float(long_frac), "long_len": int(long_len),
               "client": client_report(records, trace, duration,
                                       truncated=truncated)}
+    if targets:
+        report["targets"] = [u.rstrip("/") for u in targets]
+    if router_metrics_url:
+        try:
+            router_after = fetch_metrics(router_metrics_url)
+            if router_before is None:
+                raise RuntimeError(f"pre-run scrape failed: {router_err}")
+            report["router"] = router_report(
+                router_before, router_after,
+                client_per_replica=report["client"].get("per_replica"))
+        except Exception as e:  # noqa: BLE001 - scrape is best-effort
+            report["router"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     if log_path:
         report["log_path"] = write_log(records, log_path, log_format)
     if metrics_url:
@@ -743,10 +881,21 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
 
 def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
-    ap.add_argument("--url", required=True, help="REST server base URL")
+    ap.add_argument("--url", default="", help="REST server base URL")
+    ap.add_argument("--target", action="append", default=None,
+                    help="base URL to drive; repeatable — several replica "
+                         "URLs round-robin by request index, one router "
+                         "URL (tools/graftserve.py front door) exercises "
+                         "health-gated routing + failover.  Replaces "
+                         "--url when given")
     ap.add_argument("--metrics-url", default="",
                     help="obs exporter base URL (enables the server report "
                          "+ reconciliation)")
+    ap.add_argument("--router-metrics-url", default="",
+                    help="router base URL to scrape /metrics from before "
+                         "and after the run (per-replica outcome deltas + "
+                         "failover-column reconciliation); defaults to the "
+                         "single --target when one is given")
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
     ap.add_argument("--requests", type=int, default=20)
     ap.add_argument("--concurrency", type=int, default=4,
@@ -786,9 +935,22 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                     help="exit 1 unless reconciliation agrees and the error "
                          "rate is within --max-error-rate")
     ap.add_argument("--max-error-rate", type=float, default=0.0)
+    ap.add_argument("--chaos-tolerant", action="store_true",
+                    help="chaos-drill --check verdict: accept an error "
+                         "COUNT up to the peak in-flight depth (what a "
+                         "replica kill can cost) instead of the clean-run "
+                         "error-rate/reconciliation gates")
     args = ap.parse_args(argv)
+    targets = [u for u in (args.target or []) if u]
+    if not args.url and not targets:
+        print("graftload: one of --url / --target is required",
+              file=sys.stderr)
+        return 2
+    url = args.url or targets[0]
+    router_metrics = args.router_metrics_url or (
+        targets[0] if len(targets) == 1 else "")
     try:
-        report = drive(args.url, metrics_url=args.metrics_url or None,
+        report = drive(url, metrics_url=args.metrics_url or None,
                        n_requests=args.requests,
                        concurrency=args.concurrency, mode=args.mode,
                        rate=args.rate, ramp_s=args.ramp_s, seed=args.seed,
@@ -799,7 +961,9 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                        timeout_s=args.timeout_s, log_path=args.log or None,
                        stream=args.stream, long_frac=args.long_frac,
                        long_len=args.long_len,
-                       trace_out=args.trace_out or None)
+                       trace_out=args.trace_out or None,
+                       targets=targets or None,
+                       router_metrics_url=router_metrics or None)
     except (OSError, ValueError) as e:
         print(f"graftload: {e}", file=sys.stderr)
         return 2
@@ -823,12 +987,26 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
         if stall_frac is not None:
             print(f"prefill_stall_fraction: {stall_frac} "
                   "(decode-loop wall lost to blocking admission prefill)")
+        per_replica = c.get("per_replica") or {}
+        if len(per_replica) > 1 or "router" in report:
+            router_rows = (report.get("router") or {}).get("per_replica", {})
+            print("replica        requests  ok  failover")
+            for name in sorted(set(per_replica) | set(router_rows)):
+                crow = per_replica.get(name) or {}
+                fo = (router_rows.get(name) or {}).get("failover", 0)
+                print(f"{name:<14} {crow.get('requests', 0):>8}  "
+                      f"{crow.get('ok', 0):>2}  {fo:>8}")
+        if "router" in report:
+            print("router: " + json.dumps(
+                {k: v for k, v in report["router"].items()
+                 if k != "per_replica"}))
         if "reconcile" in report:
             print("reconcile: " + json.dumps(report["reconcile"]))
         if "trace" in report:
             print("trace: " + json.dumps(report["trace"]))
     if args.check:
-        return 0 if check_ok(report, args.max_error_rate) else 1
+        return 0 if check_ok(report, args.max_error_rate,
+                             chaos_tolerant=args.chaos_tolerant) else 1
     return 0
 
 
