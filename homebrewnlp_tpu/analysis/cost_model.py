@@ -417,6 +417,25 @@ def _roofline(cfg, flops_dev: float, traffic: float, comm: CommModel,
     return max(ranked, key=ranked.get), kind
 
 
+def serve_capacity_ceiling() -> typing.Dict[str, typing.Any]:
+    """Static flops ceiling for the serving fleet on THIS process's
+    devices: ``peak_flops_per_s`` is the cost model's per-device peak
+    (``train.flops.peak_flops``) times the local device count, or None
+    on CPU/unknown kinds where no throughput claim is made.  The usage
+    meter divides metered flops/s by this ceiling to report
+    ``capacity_utilization`` on ``/healthz`` — one number, priced from
+    the same table as the roofline verdicts, so capacity reports and
+    graftcost predictions cannot disagree."""
+    import jax
+    from ..train.flops import peak_flops
+    devices = jax.devices()
+    kind = getattr(devices[0], "device_kind", "") if devices else ""
+    peak = peak_flops(kind)
+    return {"device_kind": kind,
+            "n_devices": len(devices),
+            "peak_flops_per_s": (peak * len(devices)) if peak else None}
+
+
 def config_resources(traces: ConfigTraces, device_kind: str = ""
                      ) -> typing.Dict[str, StepResources]:
     from .graph_rules import intended_mesh

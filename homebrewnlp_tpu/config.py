@@ -466,6 +466,16 @@ _DEFAULTS: typing.Dict[str, typing.Any] = dict(
     # iteration time, never below this floor) — the serving twin of the
     # train watchdog; armed only when watchdog_factor > 0
     serve_watchdog_min_stall_s=1.0,
+    # per-tenant usage metering (obs/usage.py; docs/observability.md
+    # "Usage metering & capacity").  usage_top_k: tenants tracked EXACTLY
+    # by the Misra-Gries sketch; the long tail folds into tenant="other"
+    # so /metrics cardinality stays bounded at top_k+1 rows no matter how
+    # many distinct tenants arrive; 0 = metering off
+    usage_top_k=32,
+    # usage_tenant_header: the request header carrying the tenant
+    # identity; values failing the validation charset (or missing) meter
+    # as tenant="anon"
+    usage_tenant_header="X-Tenant",
     equal_debugging_items_per_check=16,
     debug_sample=False,
     default_sleep_duration=0.1,
@@ -663,6 +673,12 @@ class Config:
                              "(the decode-loop stall threshold floor)")
         self.serve_watchdog_min_stall_s = float(
             self.serve_watchdog_min_stall_s)
+        if int(self.usage_top_k) < 0:
+            raise ValueError("usage_top_k must be >= 0 "
+                             "(0 = usage metering off)")
+        self.usage_top_k = int(self.usage_top_k)
+        self.usage_tenant_header = str(self.usage_tenant_header
+                                       or "X-Tenant")
         if self.watchdog_factor < 0:
             raise ValueError("watchdog_factor must be >= 0 "
                              "(0 = watchdog disabled)")

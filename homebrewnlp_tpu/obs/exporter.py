@@ -264,6 +264,10 @@ class _ObsServer(ThreadingHTTPServer):
     #: optional SLO burn-rate summary callable (obs/slo_alerts.py::
     #: SLOAlerts.summary) merged into /healthz as the ``alerts`` block
     alerts_probe: typing.Optional[typing.Callable[[], dict]] = None
+    #: optional per-tenant usage/capacity summary callable
+    #: (obs/usage.py::UsageMeter.summary) merged into /healthz as the
+    #: ``usage`` block the router federates across replicas
+    usage_probe: typing.Optional[typing.Callable[[], dict]] = None
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -317,6 +321,14 @@ class _Handler(BaseHTTPRequestHandler):
                     snap["alerts"] = aprobe()
                 except Exception:  # noqa: BLE001 - must not break the probe
                     snap["alerts"] = None
+            uprobe = getattr(self.server, "usage_probe", None)
+            if uprobe is not None:
+                # per-tenant usage + capacity accounting (obs/usage.py) —
+                # the block graftmeter reads and the router federates
+                try:
+                    snap["usage"] = uprobe()
+                except Exception:  # noqa: BLE001 - must not break the probe
+                    snap["usage"] = None
             status = 503 if snap["status"] == "stalled" else 200
             self._send(status, json.dumps(snap).encode(), "application/json")
         else:
@@ -332,6 +344,8 @@ def start_server(port: int, registry: typing.Optional[MetricsRegistry] = None,
                  slo_probe: typing.Optional[typing.Callable[[], dict]] = None,
                  identity: typing.Optional[dict] = None,
                  alerts_probe: typing.Optional[
+                     typing.Callable[[], dict]] = None,
+                 usage_probe: typing.Optional[
                      typing.Callable[[], dict]] = None) -> _ObsServer:
     """Start the exporter on a daemon thread; ``port=0`` binds an ephemeral
     port (read it back from ``server.server_address[1]``).  ``slo_probe``
@@ -339,13 +353,16 @@ def start_server(port: int, registry: typing.Optional[MetricsRegistry] = None,
     /healthz; ``identity`` (obs/fleet.py) adds the self-describing
     ``identity`` block every fleet-scraped endpoint must carry;
     ``alerts_probe`` (obs/slo_alerts.py::SLOAlerts.summary) adds the SLO
-    burn-rate ``alerts`` block."""
+    burn-rate ``alerts`` block; ``usage_probe``
+    (obs/usage.py::UsageMeter.summary) adds the per-tenant ``usage``
+    block."""
     server = _ObsServer((host, port), _Handler)
     server.registry = registry if registry is not None else REGISTRY
     server.health = health
     server.slo_probe = slo_probe
     server.identity = identity
     server.alerts_probe = alerts_probe
+    server.usage_probe = usage_probe
     thread = threading.Thread(target=server.serve_forever,
                               name="obs-exporter", daemon=True)
     server._thread = thread

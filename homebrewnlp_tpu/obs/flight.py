@@ -60,6 +60,7 @@ def request_trail(rec) -> dict:
     trail = {
         "rid": rec.rid,
         "xid": getattr(rec, "xid", "") or "",
+        "tenant": getattr(rec, "tenant", "") or "",
         "path": rec.path,
         "status": rec.status,
         "queue_depth": rec.queue_depth,
@@ -161,6 +162,7 @@ class FlightRecorder:
         self._last_dump: typing.Dict[str, float] = {}
         self._seq = itertools.count(1)
         self._alerts_probe: typing.Optional[typing.Callable] = None
+        self._usage_probe: typing.Optional[typing.Callable] = None
         #: bundle paths written this process (newest last)
         self.dumps: typing.List[str] = []
 
@@ -170,6 +172,13 @@ class FlightRecorder:
         alert state at the moment of the incident."""
         with self._lock:
             self._alerts_probe = fn
+
+    def set_usage_probe(self, fn: typing.Optional[typing.Callable]
+                        ) -> None:
+        """Attach the usage meter's ``summary`` so bundles carry the
+        per-tenant accounting state at the moment of the incident."""
+        with self._lock:
+            self._usage_probe = fn
 
     # -- hot path ------------------------------------------------------------
     def observe_request(self, rec) -> dict:
@@ -284,12 +293,19 @@ class FlightRecorder:
             requests = list(self._records)
             snapshots = list(self._snapshots)
             probe = self._alerts_probe
+            uprobe = self._usage_probe
         alerts = None
         if probe is not None:
             try:
                 alerts = probe()
             except Exception:  # noqa: BLE001
                 alerts = None
+        usage = None
+        if uprobe is not None:
+            try:
+                usage = uprobe()
+            except Exception:  # noqa: BLE001
+                usage = None
         doc = {
             "schema": BUNDLE_SCHEMA,
             "reason": reason,
@@ -302,6 +318,7 @@ class FlightRecorder:
             "snapshots": snapshots,
             "metrics": metrics,
             "alerts": alerts,
+            "usage": usage,
         }
         if extra:
             doc["extra"] = extra

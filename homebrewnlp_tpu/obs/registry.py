@@ -514,6 +514,13 @@ class MetricsRegistry:
         # and a callback may legitimately touch the same registry
         self._lock = make_rlock("obs.registry.MetricsRegistry._lock")
         self._metrics: typing.Dict[str, _Metric] = {}
+        # render-time collectors: callables returning extra exposition
+        # lines, appended after the registered families.  The hook exists
+        # for CARDINALITY-BOUNDED sources (obs/usage.py's top-K tenant
+        # sketch) — Counter label children are permanent, so an unbounded
+        # label set must never pass through labels()
+        self._collectors: typing.List[typing.Callable[
+            [], typing.Iterable[str]]] = []
 
     def _get_or_make(self, cls, name: str, help_text: str,
                      labelnames: typing.Tuple[str, ...], **kw) -> _Metric:
@@ -565,6 +572,39 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def register_collector(
+            self, fn: typing.Callable[[], typing.Iterable[str]]) -> None:
+        """Add a render-time collector: called on every :meth:`render` /
+        :meth:`render_openmetrics` OUTSIDE the registry lock (a collector
+        takes its own lock; holding both here would pin a lock order the
+        collector's owner never agreed to) and expected to return complete
+        exposition lines (HELP/TYPE + samples, no trailing newline).
+        Idempotent per callable."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def unregister_collector(
+            self, fn: typing.Callable[[], typing.Iterable[str]]) -> None:
+        """Remove a collector; a no-op when it was never registered —
+        shutdown paths detach unconditionally."""
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
+    def _collector_lines(self) -> typing.List[str]:
+        with self._lock:
+            collectors = list(self._collectors)
+        lines: typing.List[str] = []
+        for fn in collectors:
+            try:
+                lines.extend(fn())
+            except Exception:  # noqa: BLE001 - one bad collector must not
+                pass  # take down the whole scrape
+        return lines
+
     def render(self) -> str:
         """Prometheus text exposition (0.0.4): HELP/TYPE headers + samples,
         trailing newline."""
@@ -573,6 +613,7 @@ class MetricsRegistry:
         lines: typing.List[str] = []
         for m in metrics:
             lines.extend(m.render())
+        lines.extend(self._collector_lines())
         return "\n".join(lines) + "\n" if lines else ""
 
     def render_openmetrics(self) -> str:
@@ -587,6 +628,7 @@ class MetricsRegistry:
         lines: typing.List[str] = []
         for m in metrics:
             lines.extend(m.render_openmetrics())
+        lines.extend(self._collector_lines())
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 

@@ -1,0 +1,472 @@
+"""Per-tenant usage metering + capacity accounting for the serving stack.
+
+Every request that enters ``serve/rest.py`` carries a tenant identity (the
+``X-Tenant`` header, validated against :data:`TENANT_RE`, ``anon``
+fallback); when the request finalizes — success, rejection, error, SSE
+disconnect or failover alike, exactly once — the meter folds a
+**UsageRecord** into its accounts: prompt/generated token counts,
+queue-wait, lane-seconds, KV **block-seconds** (blocks held x wall,
+integrated over the engine's lane occupancy), and estimated flops priced
+from the cost model's static prefill/decode step costs
+(``train/flops.py::jaxpr_flops`` over the serve executables' traces).
+
+Cardinality is bounded by construction: a Misra-Gries (Frequent) heavy-
+hitters sketch tracks the top-K tenants with EXACT accumulators and folds
+the long tail into ``tenant="other"`` — a 10k-distinct-tenant drill holds
+at most K+1 rows in memory and on ``/metrics``.  Accounting invariants:
+
+- **totals are exact and monotonic**: every record lands in exactly one
+  row (its own or ``other``), so the sum over all tenant rows equals the
+  overall totals to the token.
+- **per-tenant rows are fold-monotonic**: a tenant never evicted is exact;
+  an evicted tenant's accumulated totals move into ``other`` (the series
+  restarts at 0 if it is re-admitted) — consumers taking scrape deltas
+  must clamp negatives, and reconciliation against client-side counts is
+  exact whenever K covers the live tenant set (graftmeter ``--check``).
+- tokens and flops are counted for status-200 completions only (the
+  counts the client can verify); block/lane-seconds accrue for every
+  admitted request — capacity was consumed whether or not it was billed.
+
+The meter renders its own Prometheus families through the registry's
+collector hook (``obs/registry.py::register_collector``) instead of
+``Counter.labels`` — label children are permanent, which is exactly the
+cardinality leak the sketch exists to prevent.  ``summary()`` feeds the
+``/healthz`` ``usage`` block (metered flops/s and tokens/s against the
+cost-model ceiling, ``capacity_utilization``, projected saturation
+concurrency, per-tenant dominant-resource shares for noisy-neighbor
+attribution); :func:`merge_usage` is the router's exact federation of
+those blocks across replicas (counters sum, top-K re-folds).
+"""
+from __future__ import annotations
+
+import collections
+import re
+import sys
+import time
+import typing
+
+try:
+    from ..sync import make_lock
+except ImportError:  # loaded by file path (tools/supervise.py _load_light)
+    _sync = (sys.modules.get("homebrewnlp_tpu.sync")
+             or sys.modules.get("hbnlp_sync"))
+    if _sync is not None:
+        make_lock = _sync.make_lock
+    else:  # truly standalone: plain lock, no recording
+        import threading
+
+        def make_lock(name: str):
+            return threading.Lock()
+
+
+#: legal tenant identities; anything else (or nothing) becomes ANON —
+#: the charset is prom-label-safe by construction (no quotes/backslashes)
+TENANT_RE = re.compile(r"^[A-Za-z0-9._:-]{1,64}$")
+
+#: the two reserved tenant rows: unauthenticated traffic and the sketch's
+#: long-tail fold target — both invalid as CLIENT-supplied identities so
+#: they can never collide with a real tenant's exact row
+ANON = "anon"
+OTHER = "other"
+
+#: resource dimensions a tenant's dominant share is taken over (DRF-style:
+#: the max of its shares across dimensions)
+_SHARE_DIMS = ("tokens", "kv_block_seconds", "flops")
+
+#: per-tenant accumulator fields; every field sums exactly under folds and
+#: under the router's cross-replica merge
+_ACC_FIELDS = ("requests", "errors", "prompt_tokens", "generated_tokens",
+               "kv_block_seconds", "lane_seconds", "flops",
+               "queue_wait_s_sum", "queue_wait_n")
+
+#: (metric family, HELP, value fn) for the collector rendering; tokens get
+#: the extra ``kind`` label
+_FAMILIES = (
+    ("hbnlp_serve_tenant_requests_total",
+     "Finalized requests by tenant (top-K exact, tail folds to other)",
+     "requests"),
+    ("hbnlp_serve_tenant_errors_total",
+     "Finalized non-200 requests by tenant", "errors"),
+    ("hbnlp_serve_kv_block_seconds_total",
+     "KV cache block-seconds held by tenant (blocks x wall while admitted)",
+     "kv_block_seconds"),
+    ("hbnlp_serve_flops_total",
+     "Estimated flops by tenant (cost-model static prefill/decode prices)",
+     "flops"),
+)
+
+#: samples the rate window retains; each is (perf_counter, flops_total,
+#: tokens_total, lane_seconds_total) appended per finalize — bounded
+_WINDOW_CAP = 256
+
+
+def clean_tenant(raw: typing.Optional[str]) -> str:
+    """The validated tenant identity for a raw ``X-Tenant`` header value:
+    the value itself when it matches :data:`TENANT_RE`, else :data:`ANON`
+    (missing, empty, over-long, bad charset, or a reserved name — a client
+    cannot claim ``other``'s fold row or spoof ``anon`` into a distinct
+    series)."""
+    if not raw:
+        return ANON
+    raw = raw.strip()
+    if raw in (ANON, OTHER):
+        return ANON
+    if not TENANT_RE.match(raw):
+        return ANON
+    return raw
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _new_acc() -> dict:
+    return {k: 0 for k in _ACC_FIELDS}
+
+
+def _fold(dst: dict, src: dict) -> None:
+    for k in _ACC_FIELDS:
+        dst[k] += src[k]
+
+
+class HeavyHitters:
+    """Misra-Gries (Frequent) top-K sketch over tenant names.
+
+    ``admit(name)`` returns ``(tracked, evicted)``: whether ``name`` holds
+    a slot after this arrival, plus the names whose slots a decrement
+    round just freed (their exact accumulators must fold into ``other``).
+    On a miss with a full table every weight drops by 1, zeroed slots are
+    evicted, and the newcomer takes a freed slot when one opened — the
+    standard Frequent guarantee holds: any tenant with true frequency
+    above ``n / (k + 1)`` is tracked, and at most ``k`` slots ever exist.
+    NOT thread-safe; the owning :class:`UsageMeter` serializes access."""
+
+    __slots__ = ("k", "weight")
+
+    def __init__(self, k: int):
+        self.k = max(1, int(k))
+        self.weight: typing.Dict[str, int] = {}
+
+    def admit(self, name: str
+              ) -> typing.Tuple[bool, typing.List[str]]:
+        w = self.weight
+        if name in w:
+            w[name] += 1
+            return True, []
+        if len(w) < self.k:
+            w[name] = 1
+            return True, []
+        evicted = []
+        for key in list(w):
+            w[key] -= 1
+            if w[key] <= 0:
+                del w[key]
+                evicted.append(key)
+        if len(w) < self.k:
+            w[name] = 1
+            return True, evicted
+        return False, evicted
+
+
+class UsageMeter:
+    """The serving process's usage accountant (one per ``serve()``).
+
+    ``finalize(rec, status)`` is the single metering point — called from
+    the REST handler's ``finally`` funnel, it is reached exactly once per
+    request on every exit path and guards against double-finalization via
+    a flag it sets on the record.  ``prom_lines()`` is the registry
+    collector; ``summary()`` the ``/healthz`` usage block."""
+
+    def __init__(self, top_k: int = 32,
+                 capacity: typing.Optional[dict] = None,
+                 pricing: typing.Optional[dict] = None):
+        self._lock = make_lock("obs.usage.UsageMeter._lock")
+        self._sketch = HeavyHitters(top_k)
+        self._tenants: typing.Dict[str, dict] = {}
+        self._other = _new_acc()
+        self._total = _new_acc()
+        self._folds = 0
+        self._capacity = dict(capacity) if capacity else None
+        self._pricing = dict(pricing) if pricing else None
+        self._window: typing.Deque[tuple] = collections.deque(
+            maxlen=_WINDOW_CAP)
+
+    # -- metering ------------------------------------------------------------
+
+    def price(self, prompt_tokens: int, generated_tokens: int
+              ) -> typing.Optional[float]:
+        """Estimated flops for one request under the static price sheet:
+        one prefill executable (fixed padded shape — it runs once per
+        request regardless of prompt length) plus the marginal per-token
+        decode cost (one decode step's flops spread over its lanes and
+        token patch).  None when no pricing is loaded (serialized engine,
+        non-cache-eligible config)."""
+        p = self._pricing
+        if not p:
+            return None
+        return (float(p.get("prefill_flops") or 0.0)
+                + float(p.get("decode_flops_per_token") or 0.0)
+                * max(0, int(generated_tokens)))
+
+    def finalize(self, rec, status: int) -> bool:
+        """Meter one finished request exactly once; returns False when
+        ``rec`` was already finalized (the at-most-once guard — SSE
+        disconnects and failover retries funnel through the same handler
+        ``finally``, and a second call must be a no-op)."""
+        with self._lock:
+            if getattr(rec, "usage_done", False):
+                return False
+            try:
+                rec.usage_done = True
+            except AttributeError:
+                pass  # slotted fakes without the field still meter once
+            tenant = clean_tenant(getattr(rec, "tenant", "") or "")
+            ok = int(status) == 200
+            prompt = max(0, int(getattr(rec, "prompt_tokens", 0) or 0))
+            gen = max(0, int(getattr(rec, "tokens_generated", 0) or 0))
+            try:
+                qw = rec.queue_wait_s()
+            except Exception:  # noqa: BLE001 - fakes/partial records
+                qw = None
+            kvbs = float(getattr(rec, "kv_block_seconds", 0.0) or 0.0)
+            lane_s = float(getattr(rec, "lane_seconds", 0.0) or 0.0)
+            flops = self.price(prompt, gen) if ok else None
+            tracked, evicted = self._sketch.admit(tenant)
+            for name in evicted:
+                acc = self._tenants.pop(name, None)
+                if acc is not None:
+                    _fold(self._other, acc)
+                    self._folds += 1
+            if tracked:
+                acc = self._tenants.setdefault(tenant, _new_acc())
+            else:
+                acc = self._other
+            for dst in (acc, self._total):
+                dst["requests"] += 1
+                dst["errors"] += 0 if ok else 1
+                if ok:
+                    dst["prompt_tokens"] += prompt
+                    dst["generated_tokens"] += gen
+                    if flops is not None:
+                        dst["flops"] += flops
+                dst["kv_block_seconds"] += kvbs
+                dst["lane_seconds"] += lane_s
+                if qw is not None:
+                    dst["queue_wait_s_sum"] += float(qw)
+                    dst["queue_wait_n"] += 1
+            t = self._total
+            self._window.append((time.perf_counter(), t["flops"],
+                                 t["prompt_tokens"] + t["generated_tokens"],
+                                 t["lane_seconds"]))
+        return True
+
+    # -- export --------------------------------------------------------------
+
+    def _rows(self) -> typing.List[typing.Tuple[str, dict]]:
+        rows = sorted(self._tenants.items())
+        if self._other["requests"] > 0:
+            rows.append((OTHER, self._other))
+        return rows
+
+    def prom_lines(self) -> typing.List[str]:
+        """Prometheus text lines for the registry collector hook — one
+        bounded family set, at most K+1 ``tenant`` children each."""
+        with self._lock:
+            rows = [(name, dict(acc)) for name, acc in self._rows()]
+        lines: typing.List[str] = []
+        lines.append("# HELP hbnlp_serve_tokens_total Metered tokens by "
+                     "tenant and kind (status-200 completions only)")
+        lines.append("# TYPE hbnlp_serve_tokens_total counter")
+        for name, acc in rows:
+            for kind, field in (("prompt", "prompt_tokens"),
+                                ("generated", "generated_tokens")):
+                lines.append(
+                    f'hbnlp_serve_tokens_total{{tenant="{name}",'
+                    f'kind="{kind}"}} {_fmt(acc[field])}')
+        for fam, help_text, field in _FAMILIES:
+            lines.append(f"# HELP {fam} {help_text}")
+            lines.append(f"# TYPE {fam} counter")
+            for name, acc in rows:
+                lines.append(f'{fam}{{tenant="{name}"}} {_fmt(acc[field])}')
+        return lines
+
+    def _rates(self) -> typing.Optional[dict]:
+        if len(self._window) < 2:
+            return None
+        t0, f0, tok0, lane0 = self._window[0]
+        t1, f1, tok1, lane1 = self._window[-1]
+        span = t1 - t0
+        if span <= 0:
+            return None
+        return {"window_s": round(span, 3),
+                "flops_per_s": (f1 - f0) / span,
+                "tokens_per_s": (tok1 - tok0) / span,
+                "mean_inflight": (lane1 - lane0) / span}
+
+    def summary(self) -> dict:
+        """The ``/healthz`` ``usage`` block (and the unit the router
+        federates): exact totals, windowed rates, capacity utilization
+        against the cost-model ceiling, and per-tenant attribution."""
+        with self._lock:
+            totals = dict(self._total)
+            rows = [(name, dict(acc)) for name, acc in self._rows()]
+            rates = self._rates()
+            folds = self._folds
+        doc = {"top_k": self._sketch.k,
+               "tracked_tenants": sum(1 for n, _ in rows if n != OTHER),
+               "folds": folds,
+               "totals": totals,
+               "rates": rates,
+               "pricing": dict(self._pricing) if self._pricing else None,
+               "capacity": _capacity_block(self._capacity, rates),
+               "per_tenant": _tenant_block(rows, totals)}
+        return doc
+
+
+def _capacity_block(capacity: typing.Optional[dict],
+                    rates: typing.Optional[dict]) -> typing.Optional[dict]:
+    """Metered load against the static ceiling: ``capacity_utilization``
+    is windowed flops/s over the cost model's peak for this replica's
+    devices; saturation concurrency projects the mean in-flight depth to
+    utilization 1.0 (both None when the ceiling is unknown — CPU hosts
+    price no peak)."""
+    if not capacity:
+        return None
+    out = dict(capacity)
+    peak = out.get("peak_flops_per_s")
+    util = None
+    if rates and peak:
+        util = rates["flops_per_s"] / float(peak)
+    out["capacity_utilization"] = util
+    out["projected_saturation_concurrency"] = (
+        rates["mean_inflight"] / util
+        if util and util > 0 and rates else None)
+    return out
+
+
+def _tenant_block(rows: typing.Sequence[typing.Tuple[str, dict]],
+                  totals: dict) -> typing.Dict[str, dict]:
+    """Per-tenant attribution rows: exact counters, mean queue-wait (the
+    noisy-neighbor symptom) and the DRF-style dominant resource share
+    (the noisy-neighbor cause) — max of the tenant's share across tokens,
+    KV block-seconds and flops."""
+    tot = {"tokens": totals["prompt_tokens"] + totals["generated_tokens"],
+           "kv_block_seconds": totals["kv_block_seconds"],
+           "flops": totals["flops"]}
+    out: typing.Dict[str, dict] = {}
+    for name, acc in rows:
+        mine = {"tokens": acc["prompt_tokens"] + acc["generated_tokens"],
+                "kv_block_seconds": acc["kv_block_seconds"],
+                "flops": acc["flops"]}
+        share = max((mine[d] / tot[d] for d in _SHARE_DIMS if tot[d] > 0),
+                    default=0.0)
+        row = {k: acc[k] for k in _ACC_FIELDS}
+        row["dominant_share"] = round(share, 6)
+        row["queue_wait_mean_s"] = (
+            round(acc["queue_wait_s_sum"] / acc["queue_wait_n"], 6)
+            if acc["queue_wait_n"] else None)
+        out[name] = row
+    return out
+
+
+def merge_usage(blocks: typing.Sequence[typing.Optional[dict]],
+                top_k: int = 32) -> typing.Optional[dict]:
+    """Exact federation of per-replica ``usage`` blocks (the router's
+    fleet view, same discipline as ``obs/fleet.py``'s counter merge):
+    totals and per-tenant counters SUM exactly — each replica's rows are
+    disjoint accounts of disjoint requests — then the merged tenant set
+    re-folds to ``top_k`` (ranked by token volume) so the federated view
+    obeys the same cardinality bound as any single replica.  Rates and
+    capacity ceilings sum across replicas; utilization is recomputed over
+    the summed ceiling.  None when no block is usable."""
+    blocks = [b for b in blocks if isinstance(b, dict)
+              and isinstance(b.get("totals"), dict)]
+    if not blocks:
+        return None
+    totals = _new_acc()
+    tenants: typing.Dict[str, dict] = {}
+    folds = 0
+    for b in blocks:
+        for k in _ACC_FIELDS:
+            totals[k] += b["totals"].get(k, 0)
+        folds += int(b.get("folds", 0) or 0)
+        for name, row in (b.get("per_tenant") or {}).items():
+            acc = tenants.setdefault(name, _new_acc())
+            for k in _ACC_FIELDS:
+                acc[k] += row.get(k, 0)
+    other = tenants.pop(OTHER, _new_acc())
+    ranked = sorted(tenants.items(),
+                    key=lambda kv: (-(kv[1]["prompt_tokens"]
+                                      + kv[1]["generated_tokens"]), kv[0]))
+    kept = ranked[:max(1, int(top_k))]
+    for _, acc in ranked[max(1, int(top_k)):]:
+        _fold(other, acc)
+        folds += 1
+    rows = sorted(kept)
+    if other["requests"] > 0:
+        rows.append((OTHER, other))
+    rates = None
+    rate_blocks = [b["rates"] for b in blocks if b.get("rates")]
+    if rate_blocks:
+        rates = {"window_s": max(r.get("window_s") or 0.0
+                                 for r in rate_blocks),
+                 "flops_per_s": sum(r.get("flops_per_s") or 0.0
+                                    for r in rate_blocks),
+                 "tokens_per_s": sum(r.get("tokens_per_s") or 0.0
+                                     for r in rate_blocks),
+                 "mean_inflight": sum(r.get("mean_inflight") or 0.0
+                                      for r in rate_blocks)}
+    caps = [b["capacity"] for b in blocks if b.get("capacity")]
+    capacity = None
+    if caps:
+        peaks = [c.get("peak_flops_per_s") for c in caps]
+        peak = (sum(p for p in peaks if p) if any(peaks) else None)
+        capacity = {"device_kind": caps[0].get("device_kind"),
+                    "n_devices": sum(int(c.get("n_devices") or 0)
+                                     for c in caps),
+                    "peak_flops_per_s": peak}
+    return {"replicas": len(blocks),
+            "top_k": max(1, int(top_k)),
+            "tracked_tenants": sum(1 for n, _ in rows if n != OTHER),
+            "folds": folds,
+            "totals": totals,
+            "rates": rates,
+            "capacity": _capacity_block(capacity, rates),
+            "per_tenant": _tenant_block(rows, totals)}
+
+
+def price_serve_executables(cfg, params) -> typing.Optional[dict]:
+    """The static flops price sheet for one serve config: trace the
+    engine's decode/prefill bodies over their abstract argument shapes
+    (``serve/engine.py::abstract_exec_args`` — the exact executables the
+    scheduler compiles) and count with the cost model's analytic counter
+    (``train/flops.py::jaxpr_flops``).  The decode step price spreads over
+    its lanes and token patch into a marginal per-generated-token cost;
+    chunked prefill is priced at the monolithic prefill trace (a price,
+    not a measurement — the chunk sum is bitwise the same forward).  None
+    when the config cannot trace (serialized engine, non-cache-eligible
+    stack) — the meter then reports token/block accounts without flops."""
+    try:
+        import functools
+
+        import jax
+
+        from ..serve import engine as serve_engine
+        from ..train.flops import jaxpr_flops
+        patch = max(1, int(cfg.token_patch_size))
+        rows = int(cfg.sequence_length) // patch
+        n_lanes = max(1, int(getattr(cfg, "serve_max_batch", 1)))
+        decode_abs, prefill_abs, _ = serve_engine.abstract_exec_args(
+            cfg, params, rows, n_lanes)
+        dec = functools.partial(serve_engine.decode_body, cfg, rows,
+                                n_lanes, None)
+        pre = functools.partial(serve_engine.prefill_body, cfg, rows)
+        dec_fl = float(jaxpr_flops(jax.make_jaxpr(dec)(*decode_abs)))
+        pre_fl = float(jaxpr_flops(jax.make_jaxpr(pre)(*prefill_abs)))
+        return {"prefill_flops": pre_fl,
+                "decode_step_flops": dec_fl,
+                "decode_flops_per_token": dec_fl / n_lanes / patch,
+                "rows": rows, "n_lanes": n_lanes, "patch": patch}
+    except Exception:  # noqa: BLE001 - pricing is best-effort by contract
+        return None

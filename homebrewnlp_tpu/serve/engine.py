@@ -349,6 +349,10 @@ class _BatchRequest:
                  "top_p", "rec", "out", "t_enq", "cancelled", "admitted",
                  "end", "end_row", "first_gen", "prompt_rows", "tag",
                  "sink", "rstream", "t_admitted",
+                 # KV usage accounting: blocks the allocator granted and
+                 # the wall instant it granted them — every free site
+                 # integrates blocks x held-wall onto the SLO record
+                 "n_blocks", "t_alloc",
                  # chunked-prefill state machine: the padded [1, rows,
                  # patch] token layout chunks are sliced from, the next
                  # chunk's start row, and the rows chunks must cover
@@ -371,6 +375,8 @@ class _BatchRequest:
         self.sink = sink
         self.rstream: typing.Optional[_RowStream] = None
         self.t_admitted: typing.Optional[float] = None
+        self.n_blocks = 0
+        self.t_alloc: typing.Optional[float] = None
         self.padded: typing.Optional[np.ndarray] = None
         self.next_chunk_row = 0
         self.prefill_rows = 0
@@ -738,8 +744,11 @@ class BatchEngine:
                 except ValueError:
                     return
                 req = self._queue[0]
-                if self.allocator.alloc(req.rid, req.end) is None:
+                blocks = self.allocator.alloc(req.rid, req.end)
+                if blocks is None:
                     return
+                req.n_blocks = len(blocks)
+                req.t_alloc = time.perf_counter()
                 self._queue.pop(0)
                 self._pending -= 1
             self._start_request(req, lane, prefill_segs, stall)
@@ -808,6 +817,23 @@ class BatchEngine:
         self._lane_req[lane] = req
         self._arm_lane(req, lane)
 
+    def _settle_kv(self, req: _BatchRequest) -> None:
+        """Integrate KV/lane occupancy onto the SLO record at the instant
+        the blocks go back to the pool — every free site calls this first,
+        so block-seconds is exactly blocks x (free wall - alloc wall) no
+        matter which exit path (finish, prefill failure, cancel, pool
+        loss) released them."""
+        rec = req.rec
+        if rec is None:
+            return
+        now = time.perf_counter()
+        rec.kv_blocks = req.n_blocks
+        if req.t_alloc is not None:
+            rec.kv_block_seconds = req.n_blocks * (now - req.t_alloc)
+        t0 = req.t_admitted if req.t_admitted is not None else req.t_alloc
+        if t0 is not None:
+            rec.lane_seconds = now - t0
+
     def _fail_admission(self, req: _BatchRequest, e: BaseException) -> None:
         """Fail ONE request whose prefill (monolithic or a chunk) raised,
         keep serving: the request is already admitted (deadline-cancel
@@ -816,6 +842,7 @@ class BatchEngine:
         failed dispatch consumed the donated pool (the other lanes' state
         is gone too), escalating to the loop's fail-everything path, which
         reinitializes the pool."""
+        self._settle_kv(req)
         self.allocator.free(req.rid)
         if req.tag:
             slo.unregister_first_token(req.tag)
@@ -980,13 +1007,16 @@ class BatchEngine:
             except Exception:  # noqa: BLE001 - older toolchains
                 pass
             slo.unregister_first_token(req.tag)
+        # settle + engine-done BEFORE publishing (the stream close below or
+        # the out-queue put): the waiting handler's finish() runs the
+        # instant fetch() wakes (serve/interface.py contract) and its usage
+        # finalize must see the KV block-seconds already on the record
+        self._settle_kv(req)
+        if rec is not None:
+            rec.mark_engine_done()
         if req.rstream is not None:
             req.rstream.flush_final(out)
             req.rstream.close()
-        # engine-done BEFORE publishing: the waiting handler's finish()
-        # runs the instant fetch() wakes (serve/interface.py contract)
-        if rec is not None:
-            rec.mark_engine_done()
         if self.tracer is not None and req.t_admitted is not None:
             args = {"rid": req.rid}
             if rec is not None:
@@ -1083,6 +1113,7 @@ class BatchEngine:
             if req.tag:
                 slo.unregister_first_token(req.tag)
                 self._tags[lane] = 0
+            self._settle_kv(req)
             self.allocator.free(req.rid)
             reaped.append((lane, req, generated))
         for lane, req, generated in reaped:
@@ -1091,6 +1122,11 @@ class BatchEngine:
             elif req.sink is not None:
                 req.sink.put(None)
             if req.rec is not None:
+                # the ACTUAL generation, not the plan: a disconnect stops
+                # the lane mid-decode, and metering bills what was decoded
+                plan = max(0, req.end - len(req.prompt))
+                req.rec.tokens_generated = min(plan,
+                                               generated * self.patch)
                 req.rec.mark_engine_done()
             if self.tracer is not None and req.t_admitted is not None:
                 self.tracer.add("occupied", req.t_admitted,
@@ -1189,6 +1225,7 @@ class BatchEngine:
             if req is not None:
                 self._lane_req[lane] = None
                 self._end_row[lane] = 0
+                self._settle_kv(req)
                 self.allocator.free(req.rid)
                 if req.tag:
                     slo.unregister_first_token(req.tag)

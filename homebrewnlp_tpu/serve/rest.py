@@ -26,6 +26,7 @@ from ..config import Config
 from ..obs import exporter as obs_exporter
 from ..obs import spans
 from ..obs.registry import REGISTRY
+from ..obs.usage import clean_tenant
 from ..reliability import faults
 from . import slo as slo_mod
 from .interface import (CompletionEngine, InterfaceWrapper,
@@ -92,6 +93,15 @@ def _request_xid(headers) -> str:
     return xid[:128]
 
 
+def _request_tenant(headers, header_name: str = "X-Tenant") -> str:
+    """Resolve the request's tenant identity from the configured header
+    (``usage_tenant_header``): the validated value, or ``anon`` for
+    missing/invalid/reserved values (obs/usage.py::clean_tenant).  Rides
+    next to the correlation id through log lines, span trails, flight
+    trails, and the usage meter's accounts."""
+    return clean_tenant(headers.get(header_name))
+
+
 class RestAPI:
     def __init__(self, cfg: Config, params: dict):
         self.cfg = cfg
@@ -154,9 +164,19 @@ class RestAPI:
         k, p = effective_truncation(self.cfg, **kwargs)
         return kwargs, {"top_k": k, "top_p": p}
 
+    @staticmethod
+    def _stamp_prompt_tokens(n: int) -> None:
+        # engine-agnostic prompt-size stamp for the usage meter: the
+        # ambient SLO record exists on every handler thread, and the
+        # endpoint is the one place that knows the parsed token count
+        rec = slo_mod.current()
+        if rec is not None:
+            rec.prompt_tokens = int(n)
+
     def token_completion(self, body: dict) -> dict:
         toks = _sanitize_tokens(body.get("prompt", body.get("tokens", [])),
                                 self.cfg.vocab_size)
+        self._stamp_prompt_tokens(len(toks))
         kwargs, echo = self._truncation(body)
         out = self.wrapper.complete(
             toks, float(body.get("temperature", self.cfg.sampling_temperature)),
@@ -165,6 +185,7 @@ class RestAPI:
 
     def completion(self, body: dict) -> dict:
         ids = self.engine.tokenizer.encode(body["prompt"])
+        self._stamp_prompt_tokens(len(ids))
         kwargs, echo = self._truncation(body)
         out = self.wrapper.complete(
             ids, float(body.get("temperature", self.cfg.sampling_temperature)),
@@ -181,6 +202,7 @@ class RestAPI:
     def _stream(self, toks: typing.List[int], body: dict,
                 decode_text: bool, prompt_len: int):
         cfg = self.cfg
+        self._stamp_prompt_tokens(prompt_len)
         kwargs, echo = self._truncation(body)
         sink: "queue.Queue" = queue.Queue()
         fetch = self.wrapper.complete(
@@ -269,6 +291,11 @@ class _ApiServer(ThreadingHTTPServer):
     _lane_probe = None
     _batch_wrapper = None
     _watchdog = None
+    #: (registry, collector fn) pair for the usage meter's render-time
+    #: collector — detached on teardown (the registry outlives the server;
+    #: a still-registered collector would pin the meter and keep stale
+    #: tenant series on /metrics)
+    _usage_collector = None
     #: graceful-drain latch (docs/reliability.md "Serving resilience"):
     #: once set, new completion POSTs answer 503 while in-flight streams
     #: run to completion — flipped by drain(), read lock-free in do_POST
@@ -328,6 +355,13 @@ class _ApiServer(ThreadingHTTPServer):
                 w.set_batch_observer(None)
                 if hasattr(w, "set_step_observer"):
                     w.set_step_observer(None)
+            except Exception:  # noqa: BLE001
+                pass
+        uc, self._usage_collector = self._usage_collector, None
+        if uc is not None:
+            reg, fn = uc
+            try:
+                reg.unregister_collector(fn)
             except Exception:  # noqa: BLE001
                 pass
 
@@ -461,6 +495,32 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                     health, flight=flight,
                     registry=registry if registry is not None else REGISTRY)
                 watchdog.start()
+    # -- per-tenant usage metering (docs/observability.md "Usage metering
+    # & capacity"): every finalized request lands in the meter's bounded
+    # top-K accounts, rendered onto /metrics through the registry's
+    # collector hook and onto /healthz as the `usage` block.  The flops
+    # price sheet is traced once at startup (static step costs — the same
+    # analytic counter graftcost uses); usage_top_k=0 turns it all off.
+    meter = None
+    tenant_header = (str(getattr(cfg, "usage_tenant_header", "X-Tenant")
+                         or "X-Tenant") if cfg is not None else "X-Tenant")
+    usage_top_k = (int(getattr(cfg, "usage_top_k", 0) or 0)
+                   if cfg is not None else 0)
+    if usage_top_k > 0:
+        from ..obs import usage as usage_mod
+        pricing = (usage_mod.price_serve_executables(cfg, params)
+                   if params is not None else None)
+        try:
+            from ..analysis.cost_model import serve_capacity_ceiling
+            capacity = serve_capacity_ceiling()
+        except Exception:  # noqa: BLE001 - the ceiling is evidence
+            capacity = None
+        meter = usage_mod.UsageMeter(usage_top_k, capacity=capacity,
+                                     pricing=pricing)
+        usage_registry = registry if registry is not None else REGISTRY
+        usage_registry.register_collector(meter.prom_lines)
+        if flight is not None:
+            flight.set_usage_probe(meter.summary)
 
     class Handler(BaseHTTPRequestHandler):
         #: in-flight record for the correlation-header hook (end_headers);
@@ -491,6 +551,7 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
             label = f"/{name}" if known else "other"
             rec = serve_slo.begin(label)
             rec.xid = _request_xid(self.headers)
+            rec.tenant = _request_tenant(self.headers, tenant_header)
             self._rec = rec
             prev = slo_mod.set_current(rec)
             status = 500
@@ -580,6 +641,11 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                                  status=str(status)).inc()
                 req_latency.labels(path=label).observe(dt)
                 serve_slo.finish(rec, status)
+                if meter is not None:
+                    try:  # at-most-once: finalize() guards re-entry itself
+                        meter.finalize(rec, status)
+                    except Exception:  # noqa: BLE001 - metering must not 500
+                        pass
                 if flight is not None:
                     try:
                         trail = flight.observe_request(rec)
@@ -595,9 +661,10 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                                        queue_wait_s=rec.queue_wait_s())
                     except Exception:  # noqa: BLE001 - alerting must not 500
                         pass
-                LOG.debug("request id=%d xid=%s method=POST path=%s "
-                          "status=%d latency_ms=%.1f", rec.rid,
-                          rec.xid or "-", label, status, dt * 1e3)
+                LOG.debug("request id=%d xid=%s tenant=%s method=POST "
+                          "path=%s status=%d latency_ms=%.1f", rec.rid,
+                          rec.xid or "-", rec.tenant or "-", label, status,
+                          dt * 1e3)
 
         def _send_json(self, status: int, payload: dict) -> None:
             data = json.dumps(payload, default=str).encode()
@@ -673,6 +740,17 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                     cancel = getattr(gen, "cancel", None)
                     if cancel is not None:
                         cancel()
+                        # the usage finalize in do_POST's finally closes
+                        # this request's books the moment we return; wait
+                        # (bounded) for the reap to settle block-seconds
+                        # onto the record so the abandoned stream is still
+                        # billed the KV capacity it actually held
+                        rec = self._rec
+                        if meter is not None and rec is not None:
+                            deadline = time.monotonic() + 10.0
+                            while (rec.kv_block_seconds is None
+                                   and time.monotonic() < deadline):
+                                time.sleep(0.01)
                     LOG.debug("SSE client disconnected: xid=%s %s",
                               self._rec.xid or "-" if self._rec else "-", e)
                 except Exception as e:  # noqa: BLE001 - headers are out
@@ -697,6 +775,10 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
 
     server = _ApiServer((host, port), Handler)
     server.slo = serve_slo  # tests/bench read summaries off the live server
+    server.usage = meter  # per-tenant usage meter (None when top_k=0)
+    server._usage_collector = ((registry if registry is not None
+                                else REGISTRY, meter.prom_lines)
+                               if meter is not None else None)
     server.flight = flight  # incident bundles / debugz surfaces
     server.alerts = alerts  # SLO burn-rate evaluator (None w/o objectives)
     server.tracer = tracer  # the shared serving span ring
@@ -719,7 +801,9 @@ def serve(cfg: Config, params: dict, host: str = "127.0.0.1",
                 slo_probe=serve_slo.summary,
                 identity=fleet.identity(cfg),
                 alerts_probe=(alerts.summary if alerts is not None
-                              else None))
+                              else None),
+                usage_probe=(meter.summary if meter is not None
+                             else None))
         except OSError:
             server.server_close()  # don't leak the bound REST socket
             raise

@@ -76,6 +76,23 @@ except ImportError:  # standalone: load the registry next to this file
         _sys.modules["hbnlp_obs_registry"] = _reg
     REGISTRY, MetricsRegistry = _reg.REGISTRY, _reg.MetricsRegistry
 
+try:
+    from ..obs import usage as usage_mod
+except ImportError:  # standalone: load the usage meter next to this file
+    import importlib.util as _ilu
+    import os as _os
+    import sys as _sys
+    usage_mod = (_sys.modules.get("homebrewnlp_tpu.obs.usage")
+                 or _sys.modules.get("hbnlp_obs_usage"))
+    if usage_mod is None:
+        _spec = _ilu.spec_from_file_location(
+            "hbnlp_obs_usage",
+            _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                          _os.pardir, "obs", "usage.py"))
+        usage_mod = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(usage_mod)
+        _sys.modules["hbnlp_obs_usage"] = usage_mod
+
 LOG = logging.getLogger("homebrewnlp_tpu.serve.router")
 
 #: response-body relay unit; read1 returns whatever the socket has, so SSE
@@ -297,7 +314,7 @@ class Router:
 
     def status(self) -> dict:
         with self._lock:
-            return {
+            doc = {
                 "status": "draining" if self.draining else "ok",
                 "healthy": sum(1 for s in self.replicas if s.healthy),
                 "replicas": {
@@ -308,6 +325,23 @@ class Router:
                         "reason": s.reason,
                         "inflight": s.inflight,
                     } for s in self.replicas}}
+            usage_blocks = [
+                s.snapshot.get("usage") for s in self.replicas
+                if isinstance(s.snapshot, dict)
+                and isinstance(s.snapshot.get("usage"), dict)]
+        if usage_blocks:
+            # federated per-tenant accounting: counters sum exactly across
+            # replicas, then re-fold to the widest replica's top-K so the
+            # fleet view obeys the same cardinality bound as any one replica
+            try:
+                top_k = max(int(b.get("top_k") or 0)
+                            for b in usage_blocks) or 32
+                merged = usage_mod.merge_usage(usage_blocks, top_k=top_k)
+            except Exception:  # noqa: BLE001 - status must not 500 on this
+                merged = None
+            if merged is not None:
+                doc["usage"] = merged
+        return doc
 
     def merged_trace(self, timeout_s: float = 5.0) -> dict:
         """Fetch every live replica's ``/debugz/trace`` and merge under

@@ -98,7 +98,8 @@ class RequestRecord:
     __slots__ = ("rid", "xid", "path", "t_arrival", "t_parsed", "t_enqueued",
                  "t_started", "t_first_token", "t_engine_done", "t_finished",
                  "queue_depth", "tokens_generated", "status", "token_times",
-                 "_lock")
+                 "tenant", "prompt_tokens", "kv_blocks", "kv_block_seconds",
+                 "lane_seconds", "usage_done", "_lock")
 
     def __init__(self, rid: int, path: str = ""):
         self.rid = rid
@@ -106,6 +107,22 @@ class RequestRecord:
         #: threads through log lines, span trails, and flight bundles so
         #: one grep follows a request across client and server evidence
         self.xid = ""
+        #: validated tenant identity (obs/usage.py::clean_tenant over the
+        #: usage_tenant_header value) — rides next to xid through log
+        #: lines, span tags, flight trails, and the usage meter
+        self.tenant = ""
+        #: prompt token count as parsed (set by the endpoint method —
+        #: engine-agnostic, unlike tokens_generated which each engine sets)
+        self.prompt_tokens: typing.Optional[int] = None
+        #: KV accounting, written once by the engine on the lane's exit
+        #: path: blocks the allocator granted, blocks x wall held, and
+        #: wall occupying a decode lane (admission -> free)
+        self.kv_blocks: typing.Optional[int] = None
+        self.kv_block_seconds: typing.Optional[float] = None
+        self.lane_seconds: typing.Optional[float] = None
+        #: at-most-once guard the usage meter test-and-sets under its own
+        #: lock (obs/usage.py::UsageMeter.finalize)
+        self.usage_done = False
         self.path = path
         self.t_arrival = time.perf_counter()
         self.t_parsed: typing.Optional[float] = None
@@ -573,6 +590,8 @@ class ServeSLO:
         tag = {"id": rec.rid, "path": rec.path, "status": rec.status}
         if rec.xid:
             tag["xid"] = rec.xid
+        if rec.tenant:
+            tag["tenant"] = rec.tenant
         phases = (("serve/request", rec.t_arrival, rec.t_finished),
                   ("serve/parse", rec.t_arrival, rec.t_parsed),
                   ("serve/queue_wait", rec.t_enqueued, rec.t_started),

@@ -178,7 +178,8 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
              response_len: int = 16, temperature: float = 1.0,
              timeout_s: float = 300.0, trace_interval_s: float = 0.05,
              stream: bool = False, xid_prefix: str = "gl",
-             targets: typing.Optional[typing.Sequence[str]] = None
+             targets: typing.Optional[typing.Sequence[str]] = None,
+             tenants: int = 0
              ) -> typing.Tuple[typing.List[dict], typing.List[list], float,
                                bool]:
     """Fire ``n_requests`` at ``url``/token_completion; returns
@@ -208,7 +209,14 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
     lines, span trails, and flight bundles; records keep the id plus the
     client/server wall stamps (``c_send_wall_s``/``c_hdr_wall_s`` and the
     echoed ``s_recv_wall_s``/``s_send_wall_s``) that
-    :func:`estimate_offset` turns into one merged-trace timebase."""
+    :func:`estimate_offset` turns into one merged-trace timebase.
+
+    ``tenants=N`` assigns each request a deterministic tenant identity
+    ``t<i mod N>`` by REQUEST INDEX (no extra randomness — the seeded
+    prompt stream, and therefore every pre-existing fixed-seed corpus,
+    stays byte-identical) and sends it as ``X-Tenant``; records carry the
+    assignment, the client arm of the usage-metering reconciliation
+    (``obs/usage.py``).  0 = no header, the pre-tenancy wire format."""
     bases = [u.rstrip("/") for u in (targets if targets else (url,))]
     lock = threading.Lock()
     records: typing.List[dict] = []
@@ -245,6 +253,8 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
                "t_send_s": round(time.perf_counter() - t_start, 6),
                "status": 0, "tokens_generated": 0,
                "target": base, "replica": base}
+        if tenants > 0:
+            rec["tenant"] = f"t{i % tenants}"
         with lock:
             inflight[0] += 1
         rec["c_send_wall_s"] = time.time()
@@ -253,6 +263,8 @@ def run_load(url: str, corpus: typing.Sequence[typing.Sequence[int]],
             body = {"prompt": prompt, "temperature": temperature,
                     "response_len": response_len}
             req_hdrs = {"X-Request-Id": xid}
+            if tenants > 0:
+                req_hdrs["X-Tenant"] = rec["tenant"]
             if stream:
                 status, out, chunk_ts, hdrs, hdr_wall = _post_stream(
                     endpoint, body, timeout_s, headers=req_hdrs)
@@ -368,6 +380,26 @@ def client_report(records: typing.Sequence[dict],
                                      {"requests": 0, "ok": 0})
         row["requests"] += 1
         row["ok"] += int(r.get("status") == 200)
+    per_tenant: typing.Dict[str, dict] = {}
+    for r in records:
+        tenant = r.get("tenant")
+        if not tenant:
+            continue
+        row = per_tenant.setdefault(str(tenant),
+                                    {"requests": 0, "ok": 0,
+                                     "prompt_tokens": 0,
+                                     "generated_tokens": 0, "_e2e": []})
+        row["requests"] += 1
+        if r.get("status") == 200:
+            # token counts over 200s only — the server's billing rule
+            # (obs/usage.py) and therefore the reconcilable quantity
+            row["ok"] += 1
+            row["prompt_tokens"] += int(r.get("prompt_len") or 0)
+            row["generated_tokens"] += int(r.get("tokens_generated") or 0)
+            if r.get("e2e_s") is not None:
+                row["_e2e"].append(r["e2e_s"])
+    for row in per_tenant.values():
+        row["e2e_s"] = _pcts(row.pop("_e2e"))
     thin = max(1, len(trace) // 200)  # bound the trace the report embeds
     ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
     gaps = [g for r in ok for g in (r.get("itl_gaps") or ())]
@@ -391,6 +423,7 @@ def client_report(records: typing.Sequence[dict],
                           if duration_s > 0 else None),
         "e2e_s": _pcts([r["e2e_s"] for r in ok]),
         "per_replica": per_replica,
+        **({"per_tenant": per_tenant} if per_tenant else {}),
         # peak concurrent in-flight over the run — the chaos-tolerance
         # budget: killing a replica can cost at most the requests that
         # were in flight at the kill (check_ok chaos_tolerant=True)
@@ -619,6 +652,67 @@ def reconcile_report(client: dict, metrics_text: str) -> dict:
     return out
 
 
+def tenant_token_deltas(before_text: str, after_text: str
+                        ) -> typing.Dict[tuple, float]:
+    """``{(tenant, kind): delta}`` of ``hbnlp_serve_tokens_total`` between
+    two /metrics scrapes bracketing a run — the server arm of the usage
+    reconciliation, as run deltas so a long-lived server's prior traffic
+    cannot pollute the comparison.  A tenant evicted from the top-K sketch
+    restarts its series at 0 (obs/usage.py fold semantics), so a negative
+    per-row delta is possible in principle; it is NOT clamped here — exact
+    reconciliation must see it and fail, not paper over it."""
+    out: typing.Dict[tuple, float] = {}
+    for sign, text in ((-1.0, before_text), (1.0, after_text)):
+        for labels, v in parse_prom(text).get("hbnlp_serve_tokens_total",
+                                              []):
+            key = (labels.get("tenant", "?"), labels.get("kind", "?"))
+            out[key] = out.get(key, 0.0) + sign * v
+    return out
+
+
+def usage_reconcile_report(client_per_tenant: typing.Optional[dict],
+                           deltas: typing.Dict[tuple, float]) -> dict:
+    """Usage-metering reconciliation: the client's own per-tenant token
+    counts (200s only — the server's billing rule, obs/usage.py) against
+    the server's metered ``hbnlp_serve_tokens_total{tenant,kind}`` run
+    deltas.  Tolerance is EXACT — both sides count the same tokens, not
+    clocks, so any disagreement is a metering bug, not measurement noise.
+    Defined over a DEDICATED run: foreign traffic, or a top-K fold moving
+    one of our tenants into ``tenant="other"``, surfaces as extra server
+    rows and fails the match rather than being absorbed."""
+    if not client_per_tenant:
+        return {"skipped": "no client tenant assignments (--tenants 0)"}
+    rows: typing.Dict[str, dict] = {}
+    mismatches: typing.Dict[str, dict] = {}
+    for tenant, crow in sorted(client_per_tenant.items()):
+        row: dict = {}
+        for kind, field in (("prompt", "prompt_tokens"),
+                            ("generated", "generated_tokens")):
+            c = int(crow.get(field) or 0)
+            s = int(round(deltas.get((tenant, kind), 0.0)))
+            row[kind] = {"client": c, "server": s}
+            if c != s:
+                mismatches.setdefault(tenant, {})[kind] = row[kind]
+        rows[tenant] = row
+    extra = {f"{tenant}/{kind}": int(round(v))
+             for (tenant, kind), v in sorted(deltas.items())
+             if tenant not in client_per_tenant and v}
+    c_total = sum(int(r.get("prompt_tokens") or 0)
+                  + int(r.get("generated_tokens") or 0)
+                  for r in client_per_tenant.values())
+    s_total = int(round(sum(deltas.values())))
+    out = {"client_tokens_total": c_total,
+           "server_tokens_total": s_total,
+           "per_tenant": rows,
+           "tokens_match": (not mismatches and not extra
+                            and c_total == s_total)}
+    if mismatches:
+        out["mismatches"] = mismatches
+    if extra:
+        out["server_extra_rows"] = extra
+    return out
+
+
 def check_ok(report: dict, max_error_rate: float = 0.0,
              chaos_tolerant: bool = False) -> bool:
     """The ``--check`` verdict as a pure function: the error rate must be
@@ -642,6 +736,13 @@ def check_ok(report: dict, max_error_rate: float = 0.0,
     client = report.get("client") or {}
     if client.get("truncated"):
         return False
+    # usage-metering arm (a --tenants run with a metrics URL): the token
+    # counters must reconcile EXACTLY on chaos and clean runs alike —
+    # failover must not double- or zero-bill a request
+    usage = report.get("usage_reconcile")
+    if isinstance(usage, dict) and "skipped" not in usage \
+            and not usage.get("tokens_match", False):
+        return False
     if chaos_tolerant:
         n = int(client.get("n_requests") or 0)
         n_ok = int(client.get("n_ok") or 0)
@@ -661,9 +762,9 @@ def check_ok(report: dict, max_error_rate: float = 0.0,
 
 # -- per-request log ----------------------------------------------------------
 
-LOG_FIELDS = ("id", "xid", "replica", "t_send_s", "e2e_s", "ttft_s",
-              "status", "prompt_len", "tokens_generated", "retry_after_s",
-              "error")
+LOG_FIELDS = ("id", "xid", "tenant", "replica", "t_send_s", "e2e_s",
+              "ttft_s", "status", "prompt_len", "tokens_generated",
+              "retry_after_s", "error")
 
 
 def write_log(records: typing.Sequence[dict], path: str,
@@ -810,7 +911,8 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
           long_len: int = 0,
           trace_out: typing.Optional[str] = None,
           targets: typing.Optional[typing.Sequence[str]] = None,
-          router_metrics_url: typing.Optional[str] = None) -> dict:
+          router_metrics_url: typing.Optional[str] = None,
+          tenants: int = 0) -> dict:
     """One full run: corpus -> load -> client report -> server scrape ->
     reconciliation.  The importable entry bench.py and the tests share.
     ``long_frac``/``long_len`` thread through to :func:`make_corpus` (the
@@ -820,7 +922,11 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
     robins requests over several base URLs (or a router, see
     :func:`run_load`); ``router_metrics_url`` brackets the run with two
     router /metrics scrapes and adds the :func:`router_report` fleet arm
-    (per-replica outcome deltas + failover-column reconciliation)."""
+    (per-replica outcome deltas + failover-column reconciliation).
+    ``tenants=N`` assigns deterministic tenant identities (run_load) and —
+    when a ``metrics_url`` is given — brackets the run with two server
+    scrapes for the EXACT token reconciliation arm
+    (:func:`usage_reconcile_report`)."""
     corpus = make_corpus(seed, max(8, n_requests), vocab, min_prompt,
                          max_prompt, long_frac=long_frac, long_len=long_len)
     router_before, router_err = None, ""
@@ -830,11 +936,18 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
         except Exception as e:  # noqa: BLE001 - scrape is best-effort
             router_before = None
             router_err = f"{type(e).__name__}: {e}"[:200]
+    usage_before, usage_err = None, ""
+    if tenants > 0 and metrics_url:
+        try:
+            usage_before = fetch_metrics(metrics_url)
+        except Exception as e:  # noqa: BLE001 - scrape is best-effort
+            usage_before = None
+            usage_err = f"{type(e).__name__}: {e}"[:200]
     records, trace, duration, truncated = run_load(
         url, corpus, n_requests, concurrency=concurrency, mode=mode,
         rate=rate, ramp_s=ramp_s, response_len=response_len,
         temperature=temperature, timeout_s=timeout_s, stream=stream,
-        xid_prefix=f"gl{seed:x}", targets=targets)
+        xid_prefix=f"gl{seed:x}", targets=targets, tenants=tenants)
     report = {"url": url, "mode": mode, "concurrency": concurrency,
               "rate": rate, "seed": seed, "response_len": response_len,
               "stream": bool(stream),
@@ -860,6 +973,14 @@ def drive(url: str, metrics_url: typing.Optional[str] = None,
             text = fetch_metrics(metrics_url)
             report["server"] = server_report(text)
             report["reconcile"] = reconcile_report(report["client"], text)
+            if tenants > 0:
+                if usage_before is None:
+                    report["usage_reconcile"] = {
+                        "error": f"pre-run scrape failed: {usage_err}"}
+                else:
+                    report["usage_reconcile"] = usage_reconcile_report(
+                        report["client"].get("per_tenant"),
+                        tenant_token_deltas(usage_before, text))
         except Exception as e:  # noqa: BLE001 - scrape is best-effort
             report["server"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     if trace_out:
@@ -917,6 +1038,13 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
     ap.add_argument("--long-len", type=int, default=0,
                     help="token length of the long prompts --long-frac "
                          "mixes in")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="assign each request a deterministic tenant "
+                         "identity t<i mod N> (X-Tenant header) and add "
+                         "the per-tenant client report + the EXACT token "
+                         "reconciliation arm against the server's usage "
+                         "meter; 0 = no tenancy (default, wire-identical "
+                         "to earlier releases)")
     ap.add_argument("--response-len", type=int, default=16)
     ap.add_argument("--temperature", type=float, default=1.0)
     ap.add_argument("--timeout-s", type=float, default=300.0)
@@ -963,7 +1091,8 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
                        long_len=args.long_len,
                        trace_out=args.trace_out or None,
                        targets=targets or None,
-                       router_metrics_url=router_metrics or None)
+                       router_metrics_url=router_metrics or None,
+                       tenants=max(0, args.tenants))
     except (OSError, ValueError) as e:
         print(f"graftload: {e}", file=sys.stderr)
         return 2
@@ -1000,6 +1129,18 @@ def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
             print("router: " + json.dumps(
                 {k: v for k, v in report["router"].items()
                  if k != "per_replica"}))
+        per_tenant = c.get("per_tenant") or {}
+        if per_tenant:
+            print("tenant         requests  ok  prompt_tok  gen_tok")
+            for name in sorted(per_tenant):
+                row = per_tenant[name]
+                print(f"{name:<14} {row['requests']:>8}  {row['ok']:>2}  "
+                      f"{row['prompt_tokens']:>10}  "
+                      f"{row['generated_tokens']:>7}")
+        if "usage_reconcile" in report:
+            print("usage_reconcile: " + json.dumps(
+                {k: v for k, v in report["usage_reconcile"].items()
+                 if k != "per_tenant"}))
         if "reconcile" in report:
             print("reconcile: " + json.dumps(report["reconcile"]))
         if "trace" in report:

@@ -1,0 +1,328 @@
+#!/usr/bin/env python
+"""graftmeter: per-tenant usage sheet + exact token reconciliation gate.
+
+Tails the obs exporter's usage surface (the ``/healthz`` ``usage`` block
+and the ``hbnlp_serve_*`` per-tenant counter families that
+``obs/usage.py`` renders through the registry collector hook) and prints
+the accountant's one-glance view: metered tokens/flops/KV block-seconds
+by tenant, each tenant's DRF dominant-resource share and mean queue wait
+(noisy-neighbor cause and symptom side by side), and the replica's — or,
+pointed at the router, the FLEET's federated — capacity utilization
+against the cost-model ceiling.
+
+Modes:
+  one-shot     scrape once, print the sheet (default); ``--json`` emits
+               the raw snapshot document instead
+  --window S   scrape twice S seconds apart and rank tenants by LIVE
+               tokens/s from counter deltas (negative deltas — a tenant
+               re-admitted after a top-K fold restarts its series at 0 —
+               clamp to 0 in rates; lifetime columns stay absolute)
+  --top N      show only the N busiest tenant rows (by tokens, or by
+               tokens/s under --window); the fold row ``other`` always
+               prints when present
+  --check      CI gate, exit 1 unless the meter's books balance:
+               (a) the row-sum invariant — token/request counters summed
+               over every tenant row (``other`` included) equal the
+               overall totals EXACTLY, and (b) with ``--load-report`` (a
+               ``graftload --tenants N --json`` document) the client's
+               own per-tenant token counts equal the server's metered
+               totals EXACTLY — counters count tokens, not clocks, so
+               the tolerance is zero.
+
+Usage:
+  python tools/graftmeter.py --metrics-url http://127.0.0.1:9090
+  python tools/graftmeter.py --metrics-url ... --window 5 --top 10
+  python tools/graftmeter.py --metrics-url ... --check \
+      --load-report load_report.json
+
+Exit codes: 0 ok; 1 when ``--check`` finds the books out of balance;
+2 usage/connection errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import typing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from homebrewnlp_tpu.obs.usage import _ACC_FIELDS, OTHER  # noqa: E402
+
+#: integer counter fields the row-sum invariant holds EXACTLY over (python
+#: ints sum associatively); float accumulators get a relative tolerance
+#: for summation-order drift
+_INT_FIELDS = ("requests", "errors", "prompt_tokens", "generated_tokens")
+_FLOAT_TOL = 1e-6
+
+
+def _get_json(url: str, timeout_s: float = 10.0) -> dict:
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        # /healthz answers 503 WITH a body when stalled — the usage block
+        # is still in it and still worth metering
+        body = e.read().decode()
+        try:
+            return json.loads(body)
+        except ValueError:
+            raise e
+
+
+def _get_text(url: str, timeout_s: float = 10.0) -> str:
+    import urllib.request
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return r.read().decode()
+
+
+def scrape(metrics_url: str, timeout_s: float = 10.0) -> dict:
+    """One snapshot: the ``/healthz`` ``usage`` block (totals, rates,
+    capacity, per-tenant attribution) plus the raw per-tenant token
+    counters from ``/metrics`` (the series scrape deltas are taken
+    over)."""
+    import graftload
+    base = metrics_url.rstrip("/")
+    snap: dict = {"wall_time_s": time.time()}
+    hz = _get_json(base + "/healthz", timeout_s)
+    snap["status"] = hz.get("status")
+    snap["usage"] = hz.get("usage")
+    metrics = graftload.parse_prom(_get_text(base + "/metrics", timeout_s))
+    tokens: typing.Dict[str, typing.Dict[str, float]] = {}
+    for labels, v in metrics.get("hbnlp_serve_tokens_total", []):
+        row = tokens.setdefault(labels.get("tenant", "?"), {})
+        kind = labels.get("kind", "?")
+        row[kind] = row.get(kind, 0.0) + v
+    snap["tokens"] = tokens
+    return snap
+
+
+def deltas(prev: dict, cur: dict) -> dict:
+    """Scrape-to-scrape per-tenant token rates.  Negative deltas (a fold
+    restarted a re-admitted tenant's series at 0) clamp to 0 — rates are
+    a live view, not the reconciliation arm, which must NOT clamp
+    (graftload.tenant_token_deltas)."""
+    dt = cur["wall_time_s"] - prev["wall_time_s"]
+    if dt <= 0:
+        return {}
+    out: typing.Dict[str, dict] = {}
+    names = set(prev.get("tokens") or {}) | set(cur.get("tokens") or {})
+    for name in names:
+        a = (prev.get("tokens") or {}).get(name) or {}
+        b = (cur.get("tokens") or {}).get(name) or {}
+        tok = sum(max(0.0, b.get(k, 0.0) - a.get(k, 0.0))
+                  for k in set(a) | set(b))
+        out[name] = {"tokens_per_s": round(tok / dt, 3)}
+    return {"window_s": round(dt, 3), "per_tenant": out}
+
+
+def row_sum_problems(usage: typing.Optional[dict]) -> typing.List[str]:
+    """The meter's own books: every counter summed over the tenant rows
+    (``other`` included) must equal the overall totals — integer fields
+    exactly, float accumulators within summation-order drift.  Any
+    violation is a metering bug (a record landed in a row but not the
+    total, or vice versa)."""
+    if not isinstance(usage, dict) or not isinstance(usage.get("totals"),
+                                                     dict):
+        return ["no usage block on /healthz (usage_top_k=0?)"]
+    totals = usage["totals"]
+    rows = (usage.get("per_tenant") or {}).values()
+    problems = []
+    for field in _ACC_FIELDS:
+        total = totals.get(field, 0)
+        summed = sum(r.get(field, 0) for r in rows)
+        if field in _INT_FIELDS:
+            ok = int(summed) == int(total)
+        else:
+            ok = abs(summed - total) <= _FLOAT_TOL * max(1.0, abs(total))
+        if not ok:
+            problems.append(f"row sum != total for {field}: "
+                            f"{summed} != {total}")
+    return problems
+
+
+def reconcile(load_report: dict, usage: typing.Optional[dict]
+              ) -> typing.Tuple[bool, typing.List[str]]:
+    """The graftload-vs-meter gate as a pure function: ``(ok, reasons)``.
+
+    Prefers the report's own ``usage_reconcile`` arm (run DELTAS bracketing
+    the load — immune to prior traffic); falls back to comparing the
+    client's per-tenant counts against the meter's ABSOLUTE totals, which
+    is exact only on a server that served nothing else — the fallback says
+    so when it fails."""
+    arm = load_report.get("usage_reconcile")
+    if isinstance(arm, dict) and "skipped" not in arm and "error" not in arm:
+        if arm.get("tokens_match", False):
+            return True, []
+        reasons = [f"graftload usage_reconcile mismatch: "
+                   f"client={arm.get('client_tokens_total')} "
+                   f"server={arm.get('server_tokens_total')}"]
+        for tenant, kinds in (arm.get("mismatches") or {}).items():
+            reasons.append(f"  tenant {tenant}: {json.dumps(kinds)}")
+        for key, v in (arm.get("server_extra_rows") or {}).items():
+            reasons.append(f"  unexpected server row {key}: {v}")
+        return False, reasons
+    client = (load_report.get("client") or {}).get("per_tenant")
+    if not client:
+        return False, ["load report has no per-tenant data "
+                       "(run graftload with --tenants N --json)"]
+    if not isinstance(usage, dict):
+        return False, ["no usage block on /healthz to reconcile against"]
+    rows = usage.get("per_tenant") or {}
+    reasons = []
+    for tenant, crow in sorted(client.items()):
+        srow = rows.get(tenant) or {}
+        for field in ("prompt_tokens", "generated_tokens"):
+            c, s = int(crow.get(field) or 0), int(srow.get(field) or 0)
+            if c != s:
+                reasons.append(
+                    f"tenant {tenant} {field}: client={c} server={s} "
+                    "(absolute comparison — exact only on a dedicated "
+                    "server; prefer a report with its usage_reconcile arm)")
+    return not reasons, reasons
+
+
+def _fmtn(v: typing.Optional[float]) -> str:
+    if v is None:
+        return "-"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    if abs(f) >= 1e6:
+        return f"{f:.3e}"
+    return f"{f:.3f}"
+
+
+def render(snap: dict, rates: typing.Optional[dict] = None,
+           top: int = 0) -> str:
+    """The usage sheet: capacity header, then one row per tenant."""
+    lines = []
+    usage = snap.get("usage")
+    if not isinstance(usage, dict):
+        return (f"status={snap.get('status', '?')} — no usage block "
+                "(usage metering off: usage_top_k=0?)")
+    totals = usage.get("totals") or {}
+    all_tokens = ((totals.get("prompt_tokens") or 0)
+                  + (totals.get("generated_tokens") or 0))
+    head = (f"status={snap.get('status', '?')} "
+            f"tenants={usage.get('tracked_tenants')} "
+            f"folds={usage.get('folds')} "
+            f"requests={_fmtn(totals.get('requests'))} "
+            f"tokens={_fmtn(all_tokens)}")
+    if usage.get("replicas") is not None:  # a router's federated block
+        head += f" replicas={usage['replicas']}"
+    lines.append(head)
+    r = usage.get("rates") or {}
+    cap = usage.get("capacity") or {}
+    if r or cap:
+        util = cap.get("capacity_utilization")
+        sat = cap.get("projected_saturation_concurrency")
+        lines.append(
+            f"  capacity: tokens/s={_fmtn(r.get('tokens_per_s'))} "
+            f"flops/s={_fmtn(r.get('flops_per_s'))} "
+            f"peak={_fmtn(cap.get('peak_flops_per_s'))} "
+            f"util={'-' if util is None else f'{util:.4f}'} "
+            f"saturation_conc={'-' if sat is None else f'{sat:.1f}'}")
+    per = usage.get("per_tenant") or {}
+    rate_rows = (rates or {}).get("per_tenant") or {}
+
+    def tokens_of(name: str) -> float:
+        if rate_rows:
+            return rate_rows.get(name, {}).get("tokens_per_s", 0.0)
+        row = per.get(name) or {}
+        return ((row.get("prompt_tokens") or 0)
+                + (row.get("generated_tokens") or 0))
+
+    names = sorted((n for n in per if n != OTHER),
+                   key=lambda n: (-tokens_of(n), n))
+    if top > 0:
+        names = names[:top]
+    if OTHER in per:  # the fold row always prints: it is the tail's account
+        names.append(OTHER)
+    if names:
+        lines.append("  tenant           req  err  prompt_tok  gen_tok"
+                     "    tok/s  kv_blk_s     flops  share  q_wait_s")
+        for name in names:
+            row = per.get(name) or {}
+            rps = rate_rows.get(name, {}).get("tokens_per_s")
+            qw = row.get("queue_wait_mean_s")
+            share = row.get("dominant_share")
+            lines.append(
+                f"  {name:<15} {_fmtn(row.get('requests')):>4} "
+                f"{_fmtn(row.get('errors')):>4} "
+                f"{_fmtn(row.get('prompt_tokens')):>10} "
+                f"{_fmtn(row.get('generated_tokens')):>8} "
+                f"{_fmtn(rps):>8} "
+                f"{_fmtn(row.get('kv_block_seconds')):>9} "
+                f"{_fmtn(row.get('flops')):>9} "
+                f"{'-' if share is None else f'{share:.3f}':>6} "
+                f"{_fmtn(qw):>9}")
+    return "\n".join(lines)
+
+
+def main(argv: typing.Optional[typing.Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--metrics-url", default="",
+                    help="obs exporter (or router) base URL "
+                         "(/healthz + /metrics)")
+    ap.add_argument("--top", type=int, default=0,
+                    help="show only the N busiest tenants (0 = all)")
+    ap.add_argument("--window", type=float, default=0.0,
+                    help="scrape twice this many seconds apart and rank "
+                         "by live tokens/s from counter deltas")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw snapshot as one JSON document")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 unless the row-sum invariant holds and "
+                         "(with --load-report) client/server token "
+                         "counts reconcile EXACTLY")
+    ap.add_argument("--load-report", default="",
+                    help="graftload --tenants N --json report to "
+                         "reconcile against (--check)")
+    args = ap.parse_args(argv)
+    if not args.metrics_url:
+        print("graftmeter: --metrics-url is required", file=sys.stderr)
+        return 2
+    try:
+        snap = scrape(args.metrics_url)
+        rates = None
+        if args.window > 0:
+            time.sleep(args.window)
+            cur = scrape(args.metrics_url)
+            rates = deltas(snap, cur)
+            snap = cur
+        if args.json:
+            print(json.dumps(dict(snap, rates=rates or {}),
+                             sort_keys=True))
+        else:
+            print(render(snap, rates, top=max(0, args.top)))
+    except (OSError, ValueError) as e:
+        print(f"graftmeter: {e}", file=sys.stderr)
+        return 2
+    if args.check:
+        problems = row_sum_problems(snap.get("usage"))
+        if args.load_report:
+            try:
+                with open(args.load_report) as f:
+                    report = json.load(f)
+            except (OSError, ValueError) as e:
+                print(f"graftmeter: {e}", file=sys.stderr)
+                return 2
+            ok, reasons = reconcile(report, snap.get("usage"))
+            if not ok:
+                problems.extend(reasons)
+        for p in problems:
+            print(f"CHECK FAILED: {p}", file=sys.stderr)
+        return 1 if problems else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,11 @@ _retry = _load_light("hbnlp_retry",
                      "homebrewnlp_tpu/reliability/retry.py")
 RetryPolicy = _retry.RetryPolicy
 
+# the usage meter before the router: router.status() federates the
+# replicas' per-tenant usage blocks through obs/usage.py::merge_usage and
+# finds the module through sys.modules when loaded by file path
+_usage = _load_light("hbnlp_obs_usage", "homebrewnlp_tpu/obs/usage.py")
+
 router_mod = _load_light("hbnlp_router", "homebrewnlp_tpu/serve/router.py")
 
 LOG = logging.getLogger("homebrewnlp_tpu.graftserve")

@@ -48,8 +48,8 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
 #: streams, SLO probes, exporter/watchdog, fleet reporter, feeder)
 VALIDATE_SUITES = ("serve_engine_test.py", "serve_chunk_test.py",
                    "serve_slo_test.py", "serve_stream_test.py",
-                   "serve_router_test.py", "obs_test.py",
-                   "fleet_obs_test.py", "data_test.py",
+                   "serve_router_test.py", "serve_usage_test.py",
+                   "obs_test.py", "fleet_obs_test.py", "data_test.py",
                    "flight_test.py")
 
 

@@ -103,6 +103,20 @@ def scrape(metrics_url: typing.Optional[str],
             if labels.get("status", "").startswith("5"))
         for labels, v in metrics.get("hbnlp_serve_inflight", []):
             snap["inflight"] = v
+        # per-tenant usage families (obs/usage.py collector; absent when
+        # usage_top_k=0) — the raw material of the --watch usage pane
+        tenant_tokens: typing.Dict[str, float] = {}
+        for labels, v in metrics.get("hbnlp_serve_tokens_total", []):
+            name = labels.get("tenant", "?")
+            tenant_tokens[name] = tenant_tokens.get(name, 0.0) + v
+        if tenant_tokens:
+            snap["tenant_tokens"] = tenant_tokens
+        tenant_errors: typing.Dict[str, float] = {}
+        for labels, v in metrics.get("hbnlp_serve_tenant_errors_total", []):
+            name = labels.get("tenant", "?")
+            tenant_errors[name] = tenant_errors.get(name, 0.0) + v
+        if tenant_errors:
+            snap["tenant_errors"] = tenant_errors
     if rest_url:
         try:
             snap["flight"] = _get_json(
@@ -124,6 +138,27 @@ def deltas(prev: dict, cur: dict) -> dict:
         a, b = prev.get(key), cur.get(key)
         if a is not None and b is not None:
             out[name] = round(max(0.0, b - a) / dt, 3)
+    # per-tenant pane: live tokens/s plus each tenant's share of the
+    # error-budget burn this window (who is eating the SLO).  Negative
+    # deltas — a tenant re-admitted after a top-K fold restarts its
+    # series at 0 (obs/usage.py) — clamp to 0: this is a live view, not
+    # the reconciliation arm
+    a_tok = prev.get("tenant_tokens") or {}
+    b_tok = cur.get("tenant_tokens") or {}
+    a_err = prev.get("tenant_errors") or {}
+    b_err = cur.get("tenant_errors") or {}
+    err_total = sum(max(0.0, b_err.get(n, 0.0) - a_err.get(n, 0.0))
+                    for n in set(a_err) | set(b_err))
+    tenants = {}
+    for name in set(a_tok) | set(b_tok) | set(a_err) | set(b_err):
+        d_tok = max(0.0, b_tok.get(name, 0.0) - a_tok.get(name, 0.0))
+        row = {"tok_per_s": round(d_tok / dt, 3)}
+        if err_total > 0:
+            d_err = max(0.0, b_err.get(name, 0.0) - a_err.get(name, 0.0))
+            row["burn_share"] = round(d_err / err_total, 3)
+        tenants[name] = row
+    if tenants:
+        out["tenants"] = tenants
     return out
 
 
@@ -181,6 +216,16 @@ def render(snap: dict, rates: typing.Optional[dict] = None) -> str:
         for row in snap.get("burn_rates", ()):
             lines.append(f"  burn {row['objective']}/{row['window']}: "
                          f"{row['rate']}")
+    tenants = (rates or {}).get("tenants") or {}
+    if tenants:  # top tenants by live tokens/s + their burn contribution
+        ranked = sorted(tenants.items(),
+                        key=lambda kv: (-kv[1].get("tok_per_s", 0.0),
+                                        kv[0]))[:5]
+        for name, row in ranked:
+            line = f"  tenant {name:<16} tok/s={row.get('tok_per_s', 0.0)}"
+            if row.get("burn_share") is not None:
+                line += f" burn_share={row['burn_share']}"
+            lines.append(line)
     fl = snap.get("flight")
     if isinstance(fl, dict) and "error" not in fl:
         lines.append(f"  flight: spans={fl.get('n_spans')} "
